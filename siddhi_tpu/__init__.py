@@ -1,0 +1,30 @@
+"""siddhi_tpu — a TPU-native streaming Complex Event Processing framework.
+
+A ground-up re-design of the capabilities of the reference Siddhi engine
+(YangGuang001/siddhi, Java) for TPU: SiddhiQL apps compile to dense tensor
+programs over micro-batches of events; per-key state (window buffers,
+aggregator accumulators, NFA active-state bitmasks) lives in sharded device
+arrays advanced by jit-compiled step functions; scale-out rides
+``jax.sharding`` meshes with XLA collectives.
+
+Public API mirrors the reference surface (SiddhiManager /
+SiddhiAppRuntime / InputHandler / callbacks) so a Siddhi user can switch.
+"""
+
+__version__ = "0.1.0"
+
+from siddhi_tpu.compiler import SiddhiCompiler, SiddhiParserError
+
+
+def __getattr__(name):
+    # Lazy imports keep `import siddhi_tpu` light (no jax import cost) for
+    # pure-compiler uses.
+    if name in ("SiddhiManager",):
+        from siddhi_tpu.core.manager import SiddhiManager
+
+        return SiddhiManager
+    if name in ("SiddhiAppRuntime",):
+        from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
+
+        return SiddhiAppRuntime
+    raise AttributeError(f"module 'siddhi_tpu' has no attribute {name!r}")

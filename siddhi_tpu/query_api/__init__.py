@@ -1,0 +1,70 @@
+"""Query object model (AST/IR) for SiddhiQL on TPU.
+
+TPU-native re-design of the reference L0 layer
+(``modules/siddhi-query-api/src/main/java/io/siddhi/query/api/``,
+see /root/repo/SURVEY.md section 1, row L0).  Pure data: immutable-ish
+dataclasses that the compiler produces and the planner consumes.
+"""
+
+from siddhi_tpu.query_api.attribute import Attribute, AttrType
+from siddhi_tpu.query_api.annotation import Annotation
+from siddhi_tpu.query_api.expression import (
+    Expression,
+    Constant,
+    TimeConstant,
+    Variable,
+    FunctionCall,
+    ArithmeticOp,
+    CompareOp,
+    AndOp,
+    OrOp,
+    NotOp,
+    InOp,
+    IsNull,
+    IsNullStream,
+)
+from siddhi_tpu.query_api.definition import (
+    AbstractDefinition,
+    StreamDefinition,
+    TableDefinition,
+    WindowDefinition,
+    TriggerDefinition,
+    FunctionDefinition,
+    AggregationDefinition,
+)
+from siddhi_tpu.query_api.execution import (
+    Query,
+    Selector,
+    OutputAttribute,
+    OrderByAttribute,
+    SingleInputStream,
+    JoinInputStream,
+    StateInputStream,
+    StreamHandler,
+    Filter,
+    StreamFunction,
+    WindowHandler,
+    StreamStateElement,
+    AbsentStreamStateElement,
+    CountStateElement,
+    LogicalStateElement,
+    NextStateElement,
+    EveryStateElement,
+    OutputStream,
+    InsertIntoStream,
+    ReturnStream,
+    DeleteStream,
+    UpdateStream,
+    UpdateOrInsertStream,
+    SetAttribute,
+    OutputRate,
+    EventOutputRate,
+    TimeOutputRate,
+    SnapshotOutputRate,
+    Partition,
+    PartitionType,
+    ValuePartitionType,
+    RangePartitionType,
+    OnDemandQuery,
+)
+from siddhi_tpu.query_api.app import SiddhiApp

@@ -1,0 +1,538 @@
+"""Parser conformance tests.
+
+Query corpus mirrors the shapes exercised by the reference TestNG suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/*) — SiddhiQL
+string in, AST asserted out.
+"""
+
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler, SiddhiParserError
+from siddhi_tpu.query_api import (
+    AttrType,
+    Constant,
+    TimeConstant,
+    Variable,
+    FunctionCall,
+    CompareOp,
+    AndOp,
+    ArithmeticOp,
+    SingleInputStream,
+    JoinInputStream,
+    StateInputStream,
+    Filter,
+    WindowHandler,
+    StreamStateElement,
+    AbsentStreamStateElement,
+    CountStateElement,
+    LogicalStateElement,
+    NextStateElement,
+    EveryStateElement,
+    InsertIntoStream,
+    ReturnStream,
+    EventOutputRate,
+    TimeOutputRate,
+    SnapshotOutputRate,
+    ValuePartitionType,
+    RangePartitionType,
+)
+
+
+def parse(s):
+    return SiddhiCompiler.parse(s)
+
+
+class TestDefinitions:
+    def test_stream_definition(self):
+        app = parse("define stream StockStream (symbol string, price float, volume long);")
+        d = app.stream_definitions["StockStream"]
+        assert d.attribute_names == ["symbol", "price", "volume"]
+        assert d.attribute_type("price") == AttrType.FLOAT
+        assert d.attribute_type("volume") == AttrType.LONG
+
+    def test_all_attribute_types(self):
+        app = parse(
+            "define stream S (a string, b int, c long, d float, e double, f bool, g object);"
+        )
+        d = app.stream_definitions["S"]
+        assert [a.type for a in d.attributes] == [
+            AttrType.STRING, AttrType.INT, AttrType.LONG,
+            AttrType.FLOAT, AttrType.DOUBLE, AttrType.BOOL, AttrType.OBJECT,
+        ]
+
+    def test_table_definition_with_annotations(self):
+        app = parse(
+            "@primaryKey('symbol') @index('volume') "
+            "define table StockTable (symbol string, price float, volume long);"
+        )
+        d = app.table_definitions["StockTable"]
+        assert d.annotations[0].name == "primaryKey"
+        assert d.annotations[0].element() == "symbol"
+        assert d.annotations[1].element() == "volume"
+
+    def test_window_definition(self):
+        app = parse("define window CheckW (symbol string) length(5) output all events;")
+        d = app.window_definitions["CheckW"]
+        assert d.window_function.name == "length"
+        assert d.window_function.args[0] == Constant(5, AttrType.INT)
+        assert d.output_event_type == "all"
+
+    def test_window_definition_time(self):
+        app = parse("define window W2 (a int) time(2 sec);")
+        d = app.window_definitions["W2"]
+        assert d.window_function.args[0] == TimeConstant(2000)
+        assert d.output_event_type == "current"
+
+    def test_trigger_definitions(self):
+        app = parse(
+            "define trigger T5 at every 5 sec; "
+            "define trigger TStart at 'start'; "
+            "define trigger TCron at '*/5 * * * * ?';"
+        )
+        assert app.trigger_definitions["T5"].at_every_ms == 5000
+        assert app.trigger_definitions["TStart"].at_start
+        assert app.trigger_definitions["TCron"].at_cron == "*/5 * * * * ?"
+
+    def test_function_definition(self):
+        app = parse(
+            "define function concatFn[javascript] return string { var res = ''; return res; };"
+        )
+        f = app.function_definitions["concatFn"]
+        assert f.language == "javascript"
+        assert f.return_type == AttrType.STRING
+        assert "var res" in f.body
+
+    def test_aggregation_definition_range(self):
+        app = parse(
+            "define stream TradeStream (symbol string, price double, volume long, timestamp long); "
+            "define aggregation TradeAggregation "
+            "from TradeStream "
+            "select symbol, avg(price) as avgPrice, sum(volume) as total "
+            "group by symbol "
+            "aggregate by timestamp every sec ... year;"
+        )
+        agg = app.aggregation_definitions["TradeAggregation"]
+        assert agg.durations == ["seconds", "minutes", "hours", "days", "weeks", "months", "years"]
+        assert agg.aggregate_by == "timestamp"
+        assert agg.selector.group_by[0].attribute == "symbol"
+
+    def test_aggregation_definition_list(self):
+        app = parse(
+            "define stream S (a string, ts long); "
+            "define aggregation A from S select a, count() as c "
+            "aggregate by ts every min, hour;"
+        )
+        assert app.aggregation_definitions["A"].durations == ["minutes", "hours"]
+
+    def test_app_annotation(self):
+        app = parse(
+            "@app:name('Test-App') @app:statistics(reporter = 'console', interval = '5') "
+            "define stream S (a int);"
+        )
+        assert app.annotations[0].name == "app:name"
+        assert app.annotations[0].element() == "Test-App"
+        assert app.annotations[1].element("reporter") == "console"
+
+    def test_duplicate_definition_rejected(self):
+        with pytest.raises(Exception):
+            parse("define stream S (a int); define table S (a int);")
+
+
+class TestFilterQueries:
+    def test_simple_filter(self):
+        app = parse(
+            "define stream cseEventStream (symbol string, price float, volume long); "
+            "@info(name = 'query1') "
+            "from cseEventStream[volume < 150] select symbol, price insert into outputStream;"
+        )
+        q = app.queries[0]
+        assert q.annotations[0].element("name") == "query1"
+        s = q.input_stream
+        assert isinstance(s, SingleInputStream)
+        assert s.stream_id == "cseEventStream"
+        f = s.handlers[0]
+        assert isinstance(f, Filter)
+        assert f.expression == CompareOp("<", Variable("volume"), Constant(150, AttrType.INT))
+        assert [a.name for a in q.selector.selection] == ["symbol", "price"]
+        assert isinstance(q.output_stream, InsertIntoStream)
+        assert q.output_stream.target == "outputStream"
+
+    def test_filter_compound_condition(self):
+        app = parse(
+            "define stream S (symbol string, price float, volume long); "
+            "from S[volume < 150 and price > 50.0] select * insert into O;"
+        )
+        f = app.queries[0].input_stream.handlers[0]
+        assert isinstance(f.expression, AndOp)
+
+    def test_math_precedence(self):
+        app = parse(
+            "define stream S (a int, b int, c int); "
+            "from S select a + b * c as x insert into O;"
+        )
+        expr = app.queries[0].selector.selection[0].expression
+        assert isinstance(expr, ArithmeticOp) and expr.op == "+"
+        assert isinstance(expr.right, ArithmeticOp) and expr.right.op == "*"
+
+    def test_select_star_implicit(self):
+        app = parse("define stream S (a int); from S insert into O;")
+        assert app.queries[0].selector.is_select_all
+
+    def test_function_call_namespaced(self):
+        app = parse(
+            "define stream S (a string); "
+            "from S select str:concat(a, '!') as x insert into O;"
+        )
+        e = app.queries[0].selector.selection[0].expression
+        assert isinstance(e, FunctionCall)
+        assert e.namespace == "str" and e.name == "concat"
+
+    def test_stream_qualified_attr(self):
+        app = parse(
+            "define stream S (a int); from S[S.a > 5] select S.a as a insert into O;"
+        )
+        f = app.queries[0].input_stream.handlers[0]
+        assert f.expression.left == Variable("a", stream_id="S")
+
+    def test_insert_event_types(self):
+        app = parse(
+            "define stream S (a int); "
+            "from S#window.length(5) select a insert expired events into O;"
+        )
+        assert app.queries[0].output_stream.event_type == "expired"
+
+    def test_fault_stream_output(self):
+        app = parse("define stream S (a int); from !S select a insert into O;")
+        assert app.queries[0].input_stream.is_fault
+
+
+class TestWindowQueries:
+    def test_length_window(self):
+        app = parse(
+            "define stream S (symbol string, price float); "
+            "from S#window.length(50) select symbol, avg(price) as p "
+            "group by symbol having p > 10 insert into O;"
+        )
+        q = app.queries[0]
+        w = q.input_stream.window
+        assert isinstance(w, WindowHandler) and w.name == "length"
+        assert q.selector.having is not None
+
+    def test_time_window_with_group_order_limit(self):
+        app = parse(
+            "define stream S (symbol string, price float, volume long); "
+            "from S#window.time(1 min) "
+            "select symbol, sum(volume) as v group by symbol "
+            "order by v desc limit 5 offset 1 insert into O;"
+        )
+        sel = app.queries[0].selector
+        assert sel.order_by[0].ascending is False
+        assert sel.limit == Constant(5, AttrType.INT)
+        assert sel.offset == Constant(1, AttrType.INT)
+
+    def test_filter_then_window_then_filter(self):
+        app = parse(
+            "define stream S (a int); "
+            "from S[a > 1]#window.lengthBatch(4)[a < 10] select a insert into O;"
+        )
+        handlers = app.queries[0].input_stream.handlers
+        assert isinstance(handlers[0], Filter)
+        assert isinstance(handlers[1], WindowHandler)
+        assert isinstance(handlers[2], Filter)
+
+    def test_time_value_compound(self):
+        app = parse(
+            "define stream S (a int); "
+            "from S#window.time(1 hour 30 min) select a insert into O;"
+        )
+        w = app.queries[0].input_stream.window
+        assert w.args[0] == TimeConstant(90 * 60 * 1000)
+
+    def test_external_time_window(self):
+        app = parse(
+            "define stream S (ts long, a int); "
+            "from S#window.externalTime(ts, 5 sec) select a insert into O;"
+        )
+        w = app.queries[0].input_stream.window
+        assert w.name == "externalTime"
+        assert w.args[0] == Variable("ts")
+
+
+class TestJoinQueries:
+    def test_simple_join(self):
+        app = parse(
+            "define stream A (symbol string, price float); "
+            "define stream B (symbol string, volume long); "
+            "from A#window.length(10) join B#window.length(20) "
+            "on A.symbol == B.symbol "
+            "select A.symbol as s, price, volume insert into O;"
+        )
+        j = app.queries[0].input_stream
+        assert isinstance(j, JoinInputStream)
+        assert j.join_type == JoinInputStream.JOIN
+        assert j.left.stream_id == "A" and j.right.stream_id == "B"
+        assert isinstance(j.on_condition, CompareOp)
+
+    def test_left_outer_join_with_alias_unidirectional(self):
+        app = parse(
+            "define stream A (s string); define stream B (s string); "
+            "from A#window.time(1 min) as l unidirectional "
+            "left outer join B#window.time(1 min) as r "
+            "on l.s == r.s select l.s as s insert into O;"
+        )
+        j = app.queries[0].input_stream
+        assert j.join_type == JoinInputStream.LEFT_OUTER
+        assert j.trigger == "left"
+        assert j.left.alias == "l" and j.right.alias == "r"
+
+    def test_join_table(self):
+        app = parse(
+            "define stream S (symbol string); define table T (symbol string, price float); "
+            "from S join T on S.symbol == T.symbol select S.symbol as s, T.price as p insert into O;"
+        )
+        j = app.queries[0].input_stream
+        assert isinstance(j, JoinInputStream)
+
+
+class TestPatternQueries:
+    def test_simple_pattern(self):
+        app = parse(
+            "define stream S1 (price float); define stream S2 (price float); "
+            "from e1=S1[price > 20] -> e2=S2[price > e1.price] "
+            "select e1.price as p1, e2.price as p2 insert into O;"
+        )
+        st = app.queries[0].input_stream
+        assert isinstance(st, StateInputStream)
+        assert st.type == StateInputStream.PATTERN
+        nxt = st.state
+        assert isinstance(nxt, NextStateElement)
+        assert isinstance(nxt.element, StreamStateElement)
+        assert nxt.element.event_ref == "e1"
+        assert isinstance(nxt.next, StreamStateElement)
+        # cross-state reference parsed as stream-qualified variable
+        f = nxt.next.stream.handlers[0]
+        assert f.expression.right == Variable("price", stream_id="e1")
+
+    def test_every_pattern_within(self):
+        app = parse(
+            "define stream S (a int); define stream R (a int); "
+            "from every e1=S[a > 1] -> e2=R[a > e1.a] within 10 min "
+            "select e1.a as a1, e2.a as a2 insert into O;"
+        )
+        st = app.queries[0].input_stream
+        assert st.within_ms == 600000
+        assert isinstance(st.state.element, EveryStateElement)
+
+    def test_every_group_pattern(self):
+        app = parse(
+            "define stream S (a int); "
+            "from every (e1=S -> e2=S) -> e3=S select e1.a as x insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        assert isinstance(st, NextStateElement)
+        assert isinstance(st.element, EveryStateElement)
+        assert isinstance(st.element.element, NextStateElement)
+
+    def test_count_pattern(self):
+        app = parse(
+            "define stream TempStream (temp double); "
+            "from e1=TempStream[temp > 39]<1:5> -> e2=TempStream[temp < 35] "
+            "select e1[0].temp as t0, e1[last].temp as tl insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        c = st.element
+        assert isinstance(c, CountStateElement)
+        assert c.min_count == 1 and c.max_count == 5
+        sel = app.queries[0].selector.selection
+        assert sel[0].expression.stream_index == 0
+        assert sel[1].expression.stream_index == -1
+
+    def test_logical_and_pattern(self):
+        app = parse(
+            "define stream A (a int); define stream B (b int); "
+            "from e1=A and e2=B select e1.a as a, e2.b as b insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        assert isinstance(st, LogicalStateElement)
+        assert st.operator == "and"
+
+    def test_absent_pattern(self):
+        app = parse(
+            "define stream A (a int); define stream B (b int); "
+            "from e1=A -> not B for 5 sec select e1.a as a insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        assert isinstance(st.next, AbsentStreamStateElement)
+        assert st.next.waiting_time_ms == 5000
+
+    def test_logical_absent_pattern(self):
+        app = parse(
+            "define stream A (a int); define stream B (b int); "
+            "from not A[a > 1] and e2=B select e2.b as b insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        assert isinstance(st, LogicalStateElement)
+        assert isinstance(st.element1, AbsentStreamStateElement)
+
+
+class TestSequenceQueries:
+    def test_simple_sequence(self):
+        app = parse(
+            "define stream S (price float); "
+            "from e1=S, e2=S[price > e1.price] "
+            "select e1.price as p1, e2.price as p2 insert into O;"
+        )
+        st = app.queries[0].input_stream
+        assert st.type == StateInputStream.SEQUENCE
+        assert isinstance(st.state, NextStateElement)
+
+    def test_kleene_sequence(self):
+        app = parse(
+            "define stream S (a int); "
+            "from every e1=S[a == 1], e2=S[a > 1]+, e3=S[a < 0] "
+            "select e1.a as x, e2[0].a as y insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        assert isinstance(st.element, EveryStateElement)
+        plus = st.next.element
+        assert isinstance(plus, CountStateElement)
+        assert plus.min_count == 1 and plus.max_count == CountStateElement.ANY
+
+    def test_zero_or_more_and_optional(self):
+        app = parse(
+            "define stream S (a int); "
+            "from e1=S, e2=S*, e3=S? , e4=S select e1.a as x insert into O;"
+        )
+        st = app.queries[0].input_stream.state
+        e2 = st.next.element
+        assert e2.min_count == 0 and e2.max_count == CountStateElement.ANY
+        e3 = st.next.next.element
+        assert e3.min_count == 0 and e3.max_count == 1
+
+
+class TestOutputRateAndPartition:
+    def test_event_rate(self):
+        app = parse(
+            "define stream S (a int); "
+            "from S select a output first every 5 events insert into O;"
+        )
+        r = app.queries[0].output_rate
+        assert isinstance(r, EventOutputRate)
+        assert r.type == "first" and r.events == 5
+
+    def test_time_rate_and_snapshot(self):
+        app = parse(
+            "define stream S (a int); "
+            "from S select a output last every 2 sec insert into O; "
+            "from S select a output snapshot every 1 sec insert into O2;"
+        )
+        r0 = app.queries[0].output_rate
+        assert isinstance(r0, TimeOutputRate) and r0.value_ms == 2000 and r0.type == "last"
+        r1 = app.queries[1].output_rate
+        assert isinstance(r1, SnapshotOutputRate) and r1.value_ms == 1000
+
+    def test_value_partition(self):
+        app = parse(
+            "define stream S (symbol string, price float); "
+            "partition with (symbol of S) begin "
+            "@info(name='q') from S select symbol, sum(price) as t insert into O; "
+            "end;"
+        )
+        p = app.execution_elements[0]
+        assert isinstance(p.partition_types[0], ValuePartitionType)
+        assert len(p.queries) == 1
+
+    def test_range_partition(self):
+        app = parse(
+            "define stream S (temp double); "
+            "partition with (temp < 10 as 'low' or temp >= 10 as 'high' of S) begin "
+            "from S select temp insert into #Inner; "
+            "from #Inner select temp insert into O; "
+            "end;"
+        )
+        p = app.execution_elements[0]
+        rt = p.partition_types[0]
+        assert isinstance(rt, RangePartitionType)
+        assert [lbl for _, lbl in rt.ranges] == ["low", "high"]
+        assert p.queries[0].output_stream.is_inner
+
+    def test_return_output(self):
+        q = SiddhiCompiler.parse_query("from S select a return;")
+        assert isinstance(q.output_stream, ReturnStream)
+
+
+class TestOnDemandQueries:
+    def test_find(self):
+        q = SiddhiCompiler.parse_on_demand_query(
+            "from StockTable on price > 40 select symbol, price order by price limit 2"
+        )
+        assert q.type == "find"
+        assert q.input_store == "StockTable"
+        assert q.on_condition is not None
+
+    def test_update(self):
+        q = SiddhiCompiler.parse_on_demand_query(
+            "select 100f as price update StockTable set StockTable.price = price on StockTable.symbol == 'X'"
+        )
+        assert q.type == "update"
+
+    def test_insert(self):
+        q = SiddhiCompiler.parse_on_demand_query(
+            "select 'WSO2' as symbol, 100f as price insert into StockTable"
+        )
+        assert q.type == "insert"
+
+
+class TestMisc:
+    def test_comments_and_variables(self):
+        src = (
+            "-- comment line\n"
+            "/* block\ncomment */\n"
+            "define stream S (a int);\n"
+            "from S select a insert into O;"
+        )
+        app = parse(src)
+        assert len(app.queries) == 1
+
+    def test_update_variables(self):
+        out = SiddhiCompiler.update_variables(
+            "define stream S (a ${T});", env={"T": "int"}
+        )
+        assert out == "define stream S (a int);"
+
+    def test_parse_error_has_location(self):
+        with pytest.raises(SiddhiParserError):
+            parse("define stream S (a int) from")
+
+    def test_is_null(self):
+        app = parse("define stream S (a int); from S[a is null] select a insert into O;")
+        from siddhi_tpu.query_api import IsNull
+
+        f = app.queries[0].input_stream.handlers[0]
+        assert isinstance(f.expression, IsNull)
+
+    def test_in_table(self):
+        app = parse(
+            "define stream S (a int); define table T (a int); "
+            "from S[a in T] select a insert into O;"
+        )
+        from siddhi_tpu.query_api import InOp
+
+        f = app.queries[0].input_stream.handlers[0]
+        assert isinstance(f.expression, InOp)
+        assert f.expression.source_id == "T"
+
+    def test_not_precedence(self):
+        app = parse(
+            "define stream S (a bool, b bool); from S[not a and b] select a insert into O;"
+        )
+        from siddhi_tpu.query_api import NotOp
+
+        f = app.queries[0].input_stream.handlers[0]
+        assert isinstance(f.expression, AndOp)
+        assert isinstance(f.expression.left, NotOp)
+
+    def test_triple_quoted_string(self):
+        app = parse('define stream S (a string); from S[a == """x "y" z"""] select a insert into O;')
+        f = app.queries[0].input_stream.handlers[0]
+        assert f.expression.right.value == 'x "y" z'
