@@ -122,9 +122,10 @@ def tokenize(src: str, script_mode_hint: bool = True) -> List[Token]:
                     depth -= 1
                     if depth == 0:
                         break
-                elif src[j] == '"':
+                elif src[j] in "\"'":
+                    quote = src[j]
                     j += 1
-                    while j < n and src[j] != '"':
+                    while j < n and src[j] != quote:
                         j += 1
                 elif src.startswith("//", j):
                     # script-internal line comment: braces inside don't count

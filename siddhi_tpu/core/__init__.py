@@ -1,0 +1,7 @@
+"""Core runtime: the TPU-native engine.
+
+Re-design of the reference ``siddhi-core`` (SURVEY.md §1 L3): instead of
+pooled linked-list event chunks walked by per-event virtual calls, events
+move as columnar micro-batches (numpy on host, jax arrays on device), and
+each query compiles to a step function over those batches.
+"""

@@ -1,0 +1,110 @@
+"""SiddhiAppRuntime: lifecycle + user API surface of one running app.
+
+Mirrors the reference SiddhiAppRuntime/SiddhiAppRuntimeImpl
+(SiddhiAppRuntimeImpl.java:99 — start :440, shutdown :543, callbacks,
+input handlers).  Snapshot/restore and on-demand queries are wired in by
+their subsystems.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+from siddhi_tpu.core.context import SiddhiAppContext
+from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
+from siddhi_tpu.core.stream import (
+    FunctionQueryCallback,
+    FunctionStreamCallback,
+    InputHandler,
+    InputManager,
+    QueryCallback,
+    StreamCallback,
+    StreamJunction,
+)
+
+
+class SiddhiAppRuntime:
+    def __init__(
+        self,
+        name: str,
+        siddhi_app,
+        app_context: SiddhiAppContext,
+        junctions: Dict[str, StreamJunction],
+        query_runtimes: Dict[str, object],
+        input_manager: InputManager,
+        scheduler,
+    ):
+        self.name = name
+        self.siddhi_app = siddhi_app
+        self.app_context = app_context
+        self.junctions = junctions
+        self.query_runtimes = query_runtimes
+        self.input_manager = input_manager
+        self.scheduler = scheduler
+        self.running = False
+        self._manager = None  # back-ref set by SiddhiManager
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        if self.running:
+            return
+        for j in self.junctions.values():
+            j.start()
+        self.scheduler.start()
+        self.running = True
+
+    def shutdown(self):
+        if not self.running:
+            self.running = False
+        self.scheduler.stop()
+        for j in self.junctions.values():
+            j.stop()
+        self.running = False
+        if self._manager is not None:
+            self._manager._app_runtimes.pop(self.name, None)
+
+    # -- I/O ----------------------------------------------------------------
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        return self.input_manager.get_input_handler(stream_id)
+
+    def add_callback(
+        self,
+        target: str,
+        callback: Union[StreamCallback, QueryCallback, Callable],
+    ):
+        """Attach a callback to a stream (StreamCallback / function taking
+        events list) or to a query by name (QueryCallback / function taking
+        (ts, in_events, out_events))."""
+        if target in self.junctions:
+            if callable(callback) and not isinstance(callback, StreamCallback):
+                callback = FunctionStreamCallback(callback)
+            self.junctions[target].add_callback(callback)
+            return
+        if target in self.query_runtimes:
+            if callable(callback) and not isinstance(callback, QueryCallback):
+                callback = FunctionQueryCallback(callback)
+            self.query_runtimes[target].add_callback(callback)
+            return
+        raise SiddhiAppRuntimeError(
+            f"no stream or query named '{target}' in app '{self.name}'"
+        )
+
+    # Java-style aliases for drop-in familiarity
+    addCallback = add_callback
+    getInputHandler = get_input_handler
+
+    # -- persistence (full implementation arrives with SnapshotService) -----
+
+    def persist(self):
+        raise SiddhiAppRuntimeError(
+            f"app '{self.name}': no persistence store configured "
+            "(SiddhiManager.set_persistence_store)"
+        )
+
+    def get_stream_definitions(self):
+        return self.siddhi_app.stream_definitions
+
+    def query_names(self) -> List[str]:
+        return list(self.query_runtimes)

@@ -1,0 +1,72 @@
+"""Contexts: per-manager and per-app shared services.
+
+Mirrors the reference ``core/config/`` (SiddhiContext / SiddhiAppContext,
+SURVEY.md §2.2 Contexts) minus JVM thread machinery: the TPU build is
+deterministic batch processing, so ThreadBarrier becomes a simple
+processing lock and partition/group-by flow ids become explicit keyed-state
+indices rather than ThreadLocals.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class TimestampGenerator:
+    """Event/wall time source.  In playback mode (@app:playback) current
+    time derives from event timestamps (reference:
+    util/timestamp/TimestampGeneratorImpl.java:31, currentTime :78)."""
+
+    def __init__(self, playback: bool = False, increment_ms: int = 0):
+        self.playback = playback
+        self.increment_ms = increment_ms
+        self._event_time: int = -1
+
+    def current_time(self) -> int:
+        if self.playback:
+            return self._event_time + self.increment_ms if self._event_time >= 0 else 0
+        return int(time.time() * 1000)
+
+    def set_event_time(self, ts: int):
+        if ts > self._event_time:
+            self._event_time = ts
+
+
+class SiddhiContext:
+    """Per-manager shared state: extensions, persistence stores, config
+    (reference: config/SiddhiContext)."""
+
+    def __init__(self):
+        from siddhi_tpu.extension.registry import default_registry
+
+        self.extensions = default_registry()
+        self.persistence_store = None
+        self.config: Dict[str, str] = {}
+        self.attributes: Dict[str, object] = {}
+
+
+class SiddhiAppContext:
+    """Per-app shared state: name, time, scheduler, snapshot service,
+    statistics (reference: config/SiddhiAppContext)."""
+
+    def __init__(self, siddhi_context: SiddhiContext, name: str):
+        self.siddhi_context = siddhi_context
+        self.name = name
+        self.playback = False
+        self.enforce_order = False
+        self.root_metrics_level = "off"
+        self.timestamp_generator = TimestampGenerator()
+        # one re-entrant lock quiesces the whole app for snapshot/restore —
+        # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)
+        self.process_lock = threading.RLock()
+        self.scheduler = None  # set by app runtime
+        self.snapshot_service = None  # set by app runtime
+        self.statistics_manager = None
+        self.exception_listeners: List = []
+
+    def set_playback(self, enabled: bool, increment_ms: int = 0):
+        self.playback = enabled
+        self.timestamp_generator.playback = enabled
+        self.timestamp_generator.increment_ms = increment_ms

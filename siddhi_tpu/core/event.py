@@ -1,0 +1,233 @@
+"""Event model: user-facing Event + columnar EventBatch.
+
+TPU-native replacement for the reference's pooled linked-list event chunks
+(``core/event/``: StreamEvent with 3 Object[] segments + next pointer,
+ComplexEventChunk cursor — StreamEvent.java:37-56).  Here a chunk of
+events is a **columnar micro-batch**: one array per attribute plus
+timestamp and event-type lanes.  Numeric columns are numpy arrays that
+flow into jit-compiled steps unchanged; STRING/OBJECT columns stay host
+side as object arrays (string partition/group-by keys are interned to
+int64 ids by the keyed-state machinery).
+
+Event types mirror ComplexEvent.Type: CURRENT, EXPIRED, TIMER, RESET.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from siddhi_tpu.query_api import AttrType
+from siddhi_tpu.query_api.definition import AbstractDefinition
+
+# event type lanes
+CURRENT = 0
+EXPIRED = 1
+TIMER = 2
+RESET = 3
+
+_TYPE_NAMES = {CURRENT: "CURRENT", EXPIRED: "EXPIRED", TIMER: "TIMER", RESET: "RESET"}
+
+
+class Event:
+    """User-facing event: timestamp (ms) + data tuple.
+
+    Mirrors ``io.siddhi.core.event.Event``.
+    """
+
+    __slots__ = ("timestamp", "data", "is_expired")
+
+    def __init__(self, timestamp: int = -1, data: Optional[Sequence] = None, is_expired: bool = False):
+        self.timestamp = timestamp
+        self.data = list(data) if data is not None else []
+        self.is_expired = is_expired
+
+    def __repr__(self):
+        return f"Event{{timestamp={self.timestamp}, data={self.data}, isExpired={self.is_expired}}}"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Event)
+            and self.timestamp == other.timestamp
+            and self.data == other.data
+            and self.is_expired == other.is_expired
+        )
+
+
+class EventBatch:
+    """Columnar batch of events on one stream.
+
+    columns: attribute name -> np.ndarray (len n)
+    timestamps: int64[n] (ms)
+    types: int8[n] of CURRENT/EXPIRED/TIMER/RESET
+    """
+
+    __slots__ = ("stream_id", "attribute_names", "columns", "timestamps", "types")
+
+    def __init__(
+        self,
+        stream_id: str,
+        attribute_names: List[str],
+        columns: Dict[str, np.ndarray],
+        timestamps: np.ndarray,
+        types: Optional[np.ndarray] = None,
+    ):
+        self.stream_id = stream_id
+        self.attribute_names = attribute_names
+        self.columns = columns
+        self.timestamps = np.asarray(timestamps, dtype=np.int64)
+        n = len(self.timestamps)
+        if types is None:
+            types = np.zeros(n, dtype=np.int8)
+        self.types = np.asarray(types, dtype=np.int8)
+
+    def __len__(self) -> int:
+        return len(self.timestamps)
+
+    @property
+    def size(self) -> int:
+        return len(self.timestamps)
+
+    def mask(self, m: np.ndarray) -> "EventBatch":
+        """Select rows where boolean mask is True."""
+        return EventBatch(
+            self.stream_id,
+            self.attribute_names,
+            {k: v[m] for k, v in self.columns.items()},
+            self.timestamps[m],
+            self.types[m],
+        )
+
+    def take(self, idx: np.ndarray) -> "EventBatch":
+        return EventBatch(
+            self.stream_id,
+            self.attribute_names,
+            {k: v[idx] for k, v in self.columns.items()},
+            self.timestamps[idx],
+            self.types[idx],
+        )
+
+    def with_types(self, t: int) -> "EventBatch":
+        return EventBatch(
+            self.stream_id,
+            self.attribute_names,
+            dict(self.columns),
+            self.timestamps,
+            np.full(len(self), t, dtype=np.int8),
+        )
+
+    def only(self, *event_types: int) -> "EventBatch":
+        m = np.isin(self.types, event_types)
+        if m.all():
+            return self
+        return self.mask(m)
+
+    def copy(self) -> "EventBatch":
+        return EventBatch(
+            self.stream_id,
+            list(self.attribute_names),
+            {k: v.copy() for k, v in self.columns.items()},
+            self.timestamps.copy(),
+            self.types.copy(),
+        )
+
+    @staticmethod
+    def concat(batches: List["EventBatch"]) -> "EventBatch":
+        assert batches
+        if len(batches) == 1:
+            return batches[0]
+        b0 = batches[0]
+        return EventBatch(
+            b0.stream_id,
+            b0.attribute_names,
+            {
+                k: np.concatenate([b.columns[k] for b in batches])
+                for k in b0.attribute_names
+            },
+            np.concatenate([b.timestamps for b in batches]),
+            np.concatenate([b.types for b in batches]),
+        )
+
+    def __repr__(self):
+        return f"EventBatch({self.stream_id}, n={len(self)})"
+
+
+def empty_batch(definition: AbstractDefinition, stream_id: Optional[str] = None) -> EventBatch:
+    cols = {
+        a.name: np.empty(0, dtype=a.type.np_dtype) for a in definition.attributes
+    }
+    return EventBatch(
+        stream_id or definition.id,
+        definition.attribute_names,
+        cols,
+        np.empty(0, dtype=np.int64),
+    )
+
+
+def batch_from_rows(
+    definition: AbstractDefinition,
+    rows: List[Sequence],
+    timestamps: Sequence[int],
+    types: Optional[Sequence[int]] = None,
+    stream_id: Optional[str] = None,
+) -> EventBatch:
+    """Build a columnar batch from row-major data (the converter analog —
+    reference: event/stream/converter/*)."""
+    n = len(rows)
+    n_attrs = len(definition.attributes)
+    for i, r in enumerate(rows):
+        if len(r) != n_attrs:
+            raise ValueError(
+                f"event data {list(r)!r} has {len(r)} values but stream "
+                f"'{definition.id}' expects {n_attrs} attributes"
+            )
+    cols: Dict[str, np.ndarray] = {}
+    for j, attr in enumerate(definition.attributes):
+        dt = attr.type.np_dtype
+        if dt == np.dtype(object):
+            arr = np.empty(n, dtype=object)
+            for i in range(n):
+                arr[i] = rows[i][j]
+        else:
+            arr = np.asarray([rows[i][j] for i in range(n)], dtype=dt) if n else np.empty(0, dtype=dt)
+        cols[attr.name] = arr
+    return EventBatch(
+        stream_id or definition.id,
+        definition.attribute_names,
+        cols,
+        np.asarray(timestamps, dtype=np.int64),
+        np.asarray(types, dtype=np.int8) if types is not None else None,
+    )
+
+
+def batch_from_events(
+    definition: AbstractDefinition, events: List[Event], stream_id: Optional[str] = None
+) -> EventBatch:
+    return batch_from_rows(
+        definition,
+        [e.data for e in events],
+        [e.timestamp for e in events],
+        [EXPIRED if e.is_expired else CURRENT for e in events],
+        stream_id,
+    )
+
+
+def events_from_batch(batch: EventBatch) -> List[Event]:
+    """Convert back to row-major Events for user callbacks/sinks."""
+    out: List[Event] = []
+    names = batch.attribute_names
+    cols = [batch.columns[nm] for nm in names]
+    for i in range(len(batch)):
+        data = [_unbox(c[i]) for c in cols]
+        out.append(
+            Event(int(batch.timestamps[i]), data, is_expired=batch.types[i] == EXPIRED)
+        )
+    return out
+
+
+def _unbox(v):
+    """numpy scalar -> python scalar (keeps callback data plain)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

@@ -1,0 +1,39 @@
+"""Typed runtime exceptions (reference: io/siddhi/core/exception/*)."""
+
+
+class SiddhiAppCreationError(Exception):
+    """Raised when an app fails to plan/compile
+    (reference: SiddhiAppCreationException)."""
+
+
+class SiddhiAppRuntimeError(Exception):
+    """Raised for failures while processing events
+    (reference: SiddhiAppRuntimeException)."""
+
+
+class DefinitionNotExistError(SiddhiAppCreationError):
+    """Unknown stream/table/window referenced
+    (reference: DefinitionNotExistException)."""
+
+
+class StoreQueryCreationError(Exception):
+    """On-demand query failed to plan
+    (reference: OnDemandQueryCreationException)."""
+
+
+class CannotRestoreSiddhiAppStateError(Exception):
+    """Snapshot restore failed
+    (reference: CannotRestoreSiddhiAppStateException)."""
+
+
+class ConnectionUnavailableError(Exception):
+    """Source/Sink transport connection failure; triggers backoff retry
+    (reference: ConnectionUnavailableException)."""
+
+
+class OnErrorAction:
+    """@OnError(action=...) values (reference: StreamJunction.OnErrorAction)."""
+
+    LOG = "log"
+    STREAM = "stream"
+    STORE = "store"

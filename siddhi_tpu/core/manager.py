@@ -1,0 +1,62 @@
+"""SiddhiManager: the top-level entry point.
+
+Mirrors the reference ``io.siddhi.core.SiddhiManager`` (SiddhiManager.java:49):
+holds the per-manager context (extensions, persistence stores) and
+creates/tracks app runtimes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
+from siddhi_tpu.core.context import SiddhiContext
+from siddhi_tpu.query_api import SiddhiApp
+
+
+class SiddhiManager:
+    def __init__(self):
+        self.siddhi_context = SiddhiContext()
+        self._app_runtimes: Dict[str, SiddhiAppRuntime] = {}
+
+    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        from siddhi_tpu.planner.app_planner import AppPlanner
+
+        if isinstance(app, str):
+            app_string = SiddhiCompiler.update_variables(app)
+            siddhi_app = SiddhiCompiler.parse(app_string)
+        else:
+            app_string = ""
+            siddhi_app = app
+        runtime = AppPlanner(siddhi_app, app_string, self.siddhi_context).build()
+        runtime._manager = self
+        self._app_runtimes[runtime.name] = runtime
+        return runtime
+
+    # Java-style alias
+    createSiddhiAppRuntime = create_siddhi_app_runtime
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self._app_runtimes.get(name)
+
+    def get_siddhi_app_runtimes(self):
+        return dict(self._app_runtimes)
+
+    def set_extension(self, name: str, factory, kind: str = "function"):
+        """Register a custom extension: name may be 'ns:name' or 'name'
+        (reference: SiddhiManager.setExtension)."""
+        ns, _, nm = name.rpartition(":")
+        self.siddhi_context.extensions.register(kind, nm, factory, ns or None)
+
+    def set_persistence_store(self, store):
+        self.siddhi_context.persistence_store = store
+
+    def persist(self):
+        for rt in list(self._app_runtimes.values()):
+            rt.persist()
+
+    def shutdown(self):
+        for rt in list(self._app_runtimes.values()):
+            rt.shutdown()
+        self._app_runtimes.clear()

@@ -1,0 +1,451 @@
+"""Query runtime: receiver -> processor chain -> selector -> rate limiter
+-> output callback.
+
+Re-design of the reference ``core/query/`` (QueryRuntimeImpl.java:43,
+ProcessStreamReceiver.java:44, FilterProcessor.java:32,
+QuerySelector.java:44): operators transform columnar batches instead of
+walking pooled event chunks, and per-group aggregation is computed with
+segmented vectorized runs rather than per-event executor calls.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.stream import QueryCallback, StreamJunction
+from siddhi_tpu.ops.aggregators import AggExecutor
+from siddhi_tpu.planner.expr import CompiledExpression, N_KEY, TS_KEY
+from siddhi_tpu.query_api import AttrType
+
+
+def build_env(batch: EventBatch, key_map: Optional[Dict[str, str]] = None) -> Dict:
+    """Build the expression-eval environment from a batch.
+
+    ``key_map`` maps env keys -> batch column names (identity when None).
+    """
+    if key_map is None:
+        env = dict(batch.columns)
+    else:
+        env = {k: batch.columns[v] for k, v in key_map.items()}
+    env[TS_KEY] = batch.timestamps
+    env[N_KEY] = len(batch)
+    return env
+
+
+class Processor:
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        raise NotImplementedError
+
+
+class FilterProcessor(Processor):
+    """Drops rows whose boolean condition is false
+    (reference: query/processor/filter/FilterProcessor.java:32)."""
+
+    def __init__(self, condition: CompiledExpression, key_map: Optional[Dict[str, str]] = None):
+        if condition.type != AttrType.BOOL:
+            raise SiddhiAppCreationError("filter condition must be boolean")
+        self.condition = condition
+        self.key_map = key_map
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        if len(batch) == 0:
+            return batch
+        mask = np.broadcast_to(
+            np.asarray(self.condition.fn(build_env(batch, self.key_map))), (len(batch),)
+        )
+        # control events (RESET/TIMER) always pass through
+        keep = mask | (batch.types >= ev.TIMER)
+        if keep.all():
+            return batch
+        return batch.mask(keep)
+
+
+class WindowChainProcessor(Processor):
+    """Adapts a WindowProcessor into the chain."""
+
+    def __init__(self, window):
+        self.window = window
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        return self.window.process(batch, now)
+
+
+class StreamFunctionChainProcessor(Processor):
+    """#ns:fn(...) stream processors (extension SPI)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        return self.fn.process(batch, now)
+
+
+# ---------------------------------------------------------------------------
+# Selector
+# ---------------------------------------------------------------------------
+
+
+class AggBinding:
+    """One aggregator call inside the select clause: env key it publishes,
+    the executor, and the compiled argument (None == count())."""
+
+    def __init__(self, env_key: str, executor: AggExecutor, arg: Optional[CompiledExpression]):
+        self.env_key = env_key
+        self.executor = executor
+        self.arg = arg
+
+
+class SelectItem:
+    def __init__(self, name: str, compiled: CompiledExpression):
+        self.name = name
+        self.compiled = compiled
+
+
+class QuerySelector:
+    """Projection + group-by + aggregation + having + order-by/limit
+    (reference: query/selector/QuerySelector.java:44,76-205).
+
+    ``batch_mode`` mirrors the reference's batched group-by processing
+    (ProcessingMode.BATCH): with a batch window upstream, only the last
+    row per group of each flush produces output.
+    """
+
+    def __init__(
+        self,
+        output_stream_id: str,
+        items: Optional[List[SelectItem]],  # None == select *
+        output_attribute_names: List[str],
+        aggregations: List[AggBinding],
+        group_keys: List[CompiledExpression],
+        having: Optional[CompiledExpression],
+        order_by: List[Tuple[str, bool]],
+        limit: Optional[int],
+        offset: Optional[int],
+        batch_mode: bool = False,
+    ):
+        self.output_stream_id = output_stream_id
+        self.items = items
+        self.output_attribute_names = output_attribute_names
+        self.aggregations = aggregations
+        self.group_keys = group_keys
+        self.having = having
+        self.order_by = order_by
+        self.limit = limit
+        self.offset = offset
+        self.batch_mode = batch_mode
+        # group key -> {agg index -> state dict}
+        self.group_states: Dict = {}
+
+    # -- state plumbing (snapshot contract) ---------------------------------
+
+    def snapshot(self) -> Dict:
+        return {"group_states": self.group_states}
+
+    def restore(self, state: Dict):
+        self.group_states = state["group_states"]
+
+    # -- processing ---------------------------------------------------------
+
+    def _group_ids(self, env, n) -> List:
+        if not self.group_keys:
+            return [None] * n
+        key_cols = [np.broadcast_to(np.asarray(k.fn(env)), (n,)) for k in self.group_keys]
+        if len(key_cols) == 1:
+            col = key_cols[0]
+            return [col[i].item() if isinstance(col[i], np.generic) else col[i] for i in range(n)]
+        return [
+            tuple(
+                c[i].item() if isinstance(c[i], np.generic) else c[i] for c in key_cols
+            )
+            for i in range(n)
+        ]
+
+    def _agg_outputs(self, env, n, keys, is_remove: bool) -> Dict[str, np.ndarray]:
+        """Segmented per-group aggregation preserving arrival order."""
+        out: Dict[str, np.ndarray] = {}
+        if not self.aggregations:
+            return out
+        # order-preserving group segments
+        segments: Dict = {}
+        for i, k in enumerate(keys):
+            segments.setdefault(k, []).append(i)
+        for ai, binding in enumerate(self.aggregations):
+            if binding.arg is not None:
+                vals = np.broadcast_to(np.asarray(binding.arg.fn(env)), (n,))
+            else:
+                vals = np.ones(n, dtype=np.int64)
+            col: Optional[np.ndarray] = None
+            for gkey, idx_list in segments.items():
+                gstate = self.group_states.setdefault(gkey, {})
+                if ai not in gstate:
+                    gstate[ai] = binding.executor.new_state()
+                idx = np.asarray(idx_list)
+                seg_vals = vals[idx]
+                res = (
+                    binding.executor.remove_run(gstate[ai], seg_vals)
+                    if is_remove
+                    else binding.executor.add_run(gstate[ai], seg_vals)
+                )
+                res = np.asarray(res)
+                if col is None:
+                    col = np.empty(n, dtype=res.dtype if res.dtype != object else object)
+                col[idx] = res
+            out[binding.env_key] = col if col is not None else np.empty(0)
+        return out
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        n = len(batch)
+        if n == 0:
+            return self._empty_output(batch)
+        outputs: List[EventBatch] = []
+        # split into maximal runs of equal event type (CURRENT/EXPIRED/...)
+        change = np.flatnonzero(np.diff(batch.types)) + 1
+        bounds = [0, *change.tolist(), n]
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            run = batch.take(np.arange(s, e))
+            rtype = int(run.types[0])
+            if rtype == ev.RESET:
+                for gstate in self.group_states.values():
+                    for ai, st in gstate.items():
+                        self.aggregations[ai].executor.reset(st)
+                continue
+            if rtype == ev.TIMER:
+                continue
+            outputs.append(self._process_run(run, rtype))
+        outs = [o for o in outputs if len(o)]
+        if not outs:
+            return self._empty_output(batch)
+        result = EventBatch.concat(outs)
+        result = self._order_limit(result)
+        return result
+
+    def _process_run(self, run: EventBatch, rtype: int) -> EventBatch:
+        n = len(run)
+        env = build_env(run)
+        keys = self._group_ids(env, n)
+        env.update(self._agg_outputs(env, n, keys, is_remove=(rtype == ev.EXPIRED)))
+        if self.items is None:
+            out_cols = {nm: run.columns[nm] for nm in self.output_attribute_names}
+        else:
+            out_cols = {}
+            for item in self.items:
+                col = np.asarray(item.compiled.fn(env))
+                if col.ndim == 0:
+                    col = np.broadcast_to(col, (n,)).copy()
+                out_cols[item.name] = col
+        out = EventBatch(
+            self.output_stream_id,
+            self.output_attribute_names,
+            out_cols,
+            run.timestamps,
+            run.types,
+        )
+        # batched group-by: last row per group only
+        keep_idx = None
+        if self.batch_mode and self.group_keys:
+            last_idx: Dict = {}
+            for i, k in enumerate(keys):
+                last_idx[k] = i
+            keep_idx = np.asarray(sorted(last_idx.values()))
+            out = out.take(keep_idx)
+        if self.having is not None:
+            # input columns + aggregate keys first; select outputs override
+            # so an alias shadowing an input attribute sees the output value
+            henv = {
+                k: (v[keep_idx] if keep_idx is not None and isinstance(v, np.ndarray) and v.shape[:1] == (n,) else v)
+                for k, v in env.items()
+            }
+            henv.update(build_env(out))
+            mask = np.broadcast_to(np.asarray(self.having.fn(henv)), (len(out),))
+            out = out.mask(mask)
+        return out
+
+    def _order_limit(self, out: EventBatch) -> EventBatch:
+        if self.order_by:
+            # stable sort by keys right-to-left; descending via dense-rank
+            # negation so ties keep arrival order (a reversed permutation
+            # would reverse ties and break secondary keys)
+            idx = np.arange(len(out))
+            for name, asc in reversed(self.order_by):
+                col = out.columns[name][idx]
+                _, dense = np.unique(col, return_inverse=True)
+                order = np.argsort(dense if asc else -dense, kind="stable")
+                idx = idx[order]
+            out = out.take(idx)
+        if self.offset is not None:
+            out = out.take(np.arange(min(self.offset, len(out)), len(out)))
+        if self.limit is not None:
+            out = out.take(np.arange(0, min(self.limit, len(out))))
+        return out
+
+    def _empty_output(self, batch: EventBatch) -> EventBatch:
+        return EventBatch(
+            self.output_stream_id,
+            self.output_attribute_names,
+            {nm: np.empty(0) for nm in self.output_attribute_names},
+            np.empty(0, dtype=np.int64),
+            np.empty(0, dtype=np.int8),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Output rate limiting (reference: query/output/ratelimit/)
+# ---------------------------------------------------------------------------
+
+
+class OutputRateLimiter:
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        return batch
+
+
+class PassThroughRateLimiter(OutputRateLimiter):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Output callbacks (reference: query/output/callback/)
+# ---------------------------------------------------------------------------
+
+
+class OutputCallback:
+    def send(self, batch: EventBatch, now: int):
+        raise NotImplementedError
+
+
+class InsertIntoStreamCallback(OutputCallback):
+    """Routes selected events into the target junction; expired events
+    become CURRENT on the next stream (reference:
+    InsertIntoStreamCallback.java)."""
+
+    def __init__(self, junction: StreamJunction, event_type: str):
+        self.junction = junction
+        self.event_type = event_type
+
+    def send(self, batch: EventBatch, now: int):
+        if self.event_type == "current":
+            out = batch.only(ev.CURRENT)
+        elif self.event_type == "expired":
+            out = batch.only(ev.EXPIRED)
+        else:
+            out = batch.only(ev.CURRENT, ev.EXPIRED)
+        if len(out) == 0:
+            return
+        out = out.with_types(ev.CURRENT)
+        out.stream_id = self.junction.stream_id
+        self.junction.send(out)
+
+
+class QueryCallbackOutput(OutputCallback):
+    """Feeds user QueryCallbacks with (ts, inEvents, removeEvents)."""
+
+    def __init__(self):
+        self.callbacks: List[QueryCallback] = []
+
+    def send(self, batch: EventBatch, now: int):
+        if not self.callbacks or len(batch) == 0:
+            return
+        cur = batch.only(ev.CURRENT)
+        exp = batch.only(ev.EXPIRED)
+        in_events = events_from_batch(cur) if len(cur) else None
+        out_events = events_from_batch(exp) if len(exp) else None
+        if in_events is None and out_events is None:
+            return
+        ts = int(batch.timestamps[-1])
+        for cb in self.callbacks:
+            cb.receive(ts, in_events, out_events)
+
+
+class FanOutOutput(OutputCallback):
+    def __init__(self, outputs: List[OutputCallback]):
+        self.outputs = outputs
+
+    def send(self, batch: EventBatch, now: int):
+        for o in self.outputs:
+            o.send(batch, now)
+
+
+# ---------------------------------------------------------------------------
+# Receiver + query runtime
+# ---------------------------------------------------------------------------
+
+
+class ProcessStreamReceiver:
+    """Junction subscriber driving one query's chain
+    (reference: query/input/ProcessStreamReceiver.java:99-179)."""
+
+    def __init__(self, query_runtime: "QueryRuntime", chain_index: int = 0):
+        self.query_runtime = query_runtime
+        self.chain_index = chain_index
+
+    def receive(self, batch: EventBatch):
+        self.query_runtime.process(batch, self.chain_index)
+
+
+class QueryRuntime:
+    """One compiled query (reference: QueryRuntimeImpl.java:43)."""
+
+    def __init__(
+        self,
+        name: str,
+        chains: List[List[Processor]],
+        selector: QuerySelector,
+        rate_limiter: OutputRateLimiter,
+        output: OutputCallback,
+        app_context,
+    ):
+        self.name = name
+        self.chains = chains
+        self.selector = selector
+        self.rate_limiter = rate_limiter
+        self.output = output
+        self.app_context = app_context
+        self.callback_output: Optional[QueryCallbackOutput] = None
+        self.latency_tracker = None
+
+    def add_callback(self, cb: QueryCallback):
+        if self.callback_output is None:
+            self.callback_output = QueryCallbackOutput()
+            self.output = FanOutOutput([self.output, self.callback_output])
+        self.callback_output.callbacks.append(cb)
+
+    def process(self, batch: EventBatch, chain_index: int = 0):
+        now = self.app_context.timestamp_generator.current_time()
+        if self.latency_tracker is not None:
+            self.latency_tracker.mark_in(len(batch))
+        try:
+            b = batch
+            for p in self.chains[chain_index]:
+                b = p.process(b, now)
+                if len(b) == 0:
+                    return
+            out = self.selector.process(b, now)
+            out = self.rate_limiter.process(out, now)
+            if out is not None and len(out):
+                self.output.send(out, now)
+        finally:
+            if self.latency_tracker is not None:
+                self.latency_tracker.mark_out(len(batch))
+
+    def on_time(self, now: int, payloads: Optional[EventBatch] = None):
+        """Scheduler tick: run time-window evictions through the tail of
+        the chain."""
+        for ci, chain in enumerate(self.chains):
+            for pi, p in enumerate(chain):
+                if isinstance(p, WindowChainProcessor):
+                    out = p.window.on_time(now)
+                    if out is not None and len(out):
+                        b = out
+                        for q in chain[pi + 1 :]:
+                            b = q.process(b, now)
+                            if len(b) == 0:
+                                break
+                        else:
+                            sel = self.selector.process(b, now)
+                            sel = self.rate_limiter.process(sel, now)
+                            if sel is not None and len(sel):
+                                self.output.send(sel, now)

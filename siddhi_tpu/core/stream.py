@@ -1,0 +1,257 @@
+"""Stream bus: junctions, input handlers, callbacks.
+
+Re-design of the reference ``core/stream/`` (StreamJunction.java:61,
+InputManager.java:33).  A junction is the per-stream pub/sub hub.  The
+default mode is synchronous depth-first fan-out of columnar batches (the
+reference's sync mode, StreamJunction.java:166-178); ``@async`` marks a
+junction for host-side micro-batching: a queue + worker that coalesces
+small sends into larger device-friendly batches (the Disruptor analog,
+StreamJunction.java:276-313).
+
+``@OnError(action='stream')`` routes failures to an auto-defined fault
+stream ``!name`` with the original attributes plus ``_error``
+(reference: StreamJunction.handleError:368-430).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.context import SiddhiAppContext
+from siddhi_tpu.core.event import (
+    Event,
+    EventBatch,
+    batch_from_events,
+    batch_from_rows,
+    events_from_batch,
+)
+from siddhi_tpu.core.exceptions import OnErrorAction, SiddhiAppRuntimeError
+from siddhi_tpu.query_api.definition import StreamDefinition
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class StreamCallback:
+    """User subscriber on a stream (reference:
+    stream/output/StreamCallback.java).  Subclass and override
+    ``receive`` or wrap a plain function via ``FunctionStreamCallback``."""
+
+    stream_id: Optional[str] = None
+
+    def receive(self, events: List[Event]):
+        raise NotImplementedError
+
+    def receive_batch(self, batch: EventBatch):
+        """Columnar fast path; default converts to row events."""
+        self.receive(events_from_batch(batch))
+
+
+class FunctionStreamCallback(StreamCallback):
+    def __init__(self, fn: Callable[[List[Event]], None]):
+        self.fn = fn
+
+    def receive(self, events: List[Event]):
+        self.fn(events)
+
+
+class QueryCallback:
+    """Per-query subscriber receiving (timestamp, current, expired)
+    (reference: query/output/callback/QueryCallback)."""
+
+    def receive(self, timestamp: int, in_events: Optional[List[Event]], out_events: Optional[List[Event]]):
+        raise NotImplementedError
+
+
+class FunctionQueryCallback(QueryCallback):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def receive(self, timestamp, in_events, out_events):
+        self.fn(timestamp, in_events, out_events)
+
+
+class StreamJunction:
+    """Per-stream pub/sub hub carrying columnar batches."""
+
+    def __init__(
+        self,
+        definition: StreamDefinition,
+        app_context: SiddhiAppContext,
+        is_async: bool = False,
+        buffer_size: int = 1024,
+        batch_size_max: Optional[int] = None,
+        on_error: str = OnErrorAction.LOG,
+        fault_junction: Optional["StreamJunction"] = None,
+    ):
+        self.definition = definition
+        self.stream_id = definition.id
+        self.app_context = app_context
+        self.receivers: List = []  # objects with .receive(EventBatch)
+        self.callbacks: List[StreamCallback] = []
+        self.on_error = on_error
+        self.fault_junction = fault_junction
+        self.is_async = is_async
+        self.batch_size_max = batch_size_max or buffer_size
+        self._queue: Optional[queue.Queue] = queue.Queue(maxsize=buffer_size) if is_async else None
+        self._worker: Optional[threading.Thread] = None
+        self._running = False
+        self.throughput_tracker = None  # set when statistics enabled
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self._running = True
+        if self.is_async:
+            self._worker = threading.Thread(
+                target=self._drain, name=f"junction-{self.stream_id}", daemon=True
+            )
+            self._worker.start()
+
+    def stop(self):
+        self._running = False
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+            self._worker = None
+
+    def subscribe(self, receiver):
+        if receiver not in self.receivers:
+            self.receivers.append(receiver)
+
+    def add_callback(self, callback: StreamCallback):
+        callback.stream_id = self.stream_id
+        self.callbacks.append(callback)
+
+    # -- send paths ---------------------------------------------------------
+
+    def send(self, batch: EventBatch):
+        if len(batch) == 0:
+            return
+        if self.throughput_tracker is not None:
+            self.throughput_tracker.add(len(batch))
+        if self.is_async and self._running:
+            self._queue.put(batch)
+            return
+        self._dispatch(batch)
+
+    def _drain(self):
+        """Async worker: coalesce queued batches up to batch_size_max —
+        micro-batching for device efficiency (the StreamHandler batching
+        analog, util/event/handler/StreamHandler.java:57)."""
+        while self._running:
+            item = self._queue.get()
+            if item is None:
+                break
+            batches = [item]
+            total = len(item)
+            while total < self.batch_size_max:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._running = False
+                    break
+                batches.append(nxt)
+                total += len(nxt)
+            self._dispatch(EventBatch.concat(batches))
+
+    def _dispatch(self, batch: EventBatch):
+        for r in self.receivers:
+            try:
+                r.receive(batch)
+            except Exception as e:  # noqa: BLE001 — fault-stream contract
+                self._handle_error(batch, e)
+        if self.callbacks:
+            for cb in self.callbacks:
+                try:
+                    cb.receive_batch(batch)
+                except Exception as e:  # noqa: BLE001
+                    self._handle_error(batch, e)
+
+    def _handle_error(self, batch: EventBatch, e: Exception):
+        if self.on_error == OnErrorAction.STREAM and self.fault_junction is not None:
+            fd = self.fault_junction.definition
+            err = np.empty(len(batch), dtype=object)
+            err[:] = e
+            cols = dict(batch.columns)
+            cols["_error"] = err
+            self.fault_junction.send(
+                EventBatch(fd.id, fd.attribute_names, cols, batch.timestamps, batch.types)
+            )
+            return
+        log.error(
+            "error processing events on stream '%s' in app '%s': %s",
+            self.stream_id,
+            self.app_context.name,
+            e,
+            exc_info=e,
+        )
+        for listener in self.app_context.exception_listeners:
+            listener(e)
+
+
+class InputHandler:
+    """External event entry for one stream (reference:
+    stream/input/InputHandler.java:50-97).  Accepts single events, rows,
+    or lists; stamps timestamps from the app clock when absent."""
+
+    def __init__(self, junction: StreamJunction, app_context: SiddhiAppContext):
+        self.junction = junction
+        self.app_context = app_context
+        self.definition = junction.definition
+
+    def send(self, data: Union[Event, Sequence, List[Event]], timestamp: Optional[int] = None):
+        tsgen = self.app_context.timestamp_generator
+        if isinstance(data, Event):
+            events = [data]
+        elif isinstance(data, list) and data and isinstance(data[0], Event):
+            events = data
+        else:
+            ts = timestamp if timestamp is not None else tsgen.current_time()
+            events = [Event(ts, list(data))]
+        for e in events:
+            if e.timestamp < 0:
+                e.timestamp = tsgen.current_time()
+            tsgen.set_event_time(e.timestamp)
+        batch = batch_from_events(self.definition, events)
+        with self.app_context.process_lock:
+            scheduler = self.app_context.scheduler
+            if scheduler is not None:
+                scheduler.advance(tsgen.current_time())
+            self.junction.send(batch)
+
+    def send_batch(self, batch: EventBatch):
+        for t in batch.timestamps:
+            self.app_context.timestamp_generator.set_event_time(int(t))
+        with self.app_context.process_lock:
+            scheduler = self.app_context.scheduler
+            if scheduler is not None:
+                scheduler.advance(self.app_context.timestamp_generator.current_time())
+            self.junction.send(batch)
+
+
+class InputManager:
+    """Registry of input handlers (reference: stream/input/InputManager.java:33)."""
+
+    def __init__(self, app_context: SiddhiAppContext):
+        self.app_context = app_context
+        self._handlers: Dict[str, InputHandler] = {}
+        self._junctions: Dict[str, StreamJunction] = {}
+
+    def register(self, junction: StreamJunction):
+        self._junctions[junction.stream_id] = junction
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        if stream_id not in self._handlers:
+            if stream_id not in self._junctions:
+                raise SiddhiAppRuntimeError(f"stream '{stream_id}' is not defined")
+            self._handlers[stream_id] = InputHandler(self._junctions[stream_id], self.app_context)
+        return self._handlers[stream_id]

@@ -1,0 +1,5 @@
+"""Extension SPI: pluggable windows, functions, aggregators, sources,
+sinks, mappers, stores (reference: siddhi-annotations @Extension +
+util/SiddhiExtensionLoader, SURVEY.md §2.2 Extension loading)."""
+
+from siddhi_tpu.extension.registry import ExtensionRegistry, extension

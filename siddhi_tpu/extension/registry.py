@@ -1,0 +1,77 @@
+"""Extension registry: namespace:name -> factory, per extension kind.
+
+Replaces the reference's classpath annotation scan
+(util/SiddhiExtensionLoader.java:58 + typed holders under
+util/extension/holder/) with an explicit registry; the ``@extension``
+decorator is the ``@Extension`` annotation analog.  Kinds mirror the
+reference holder types: window, function (scalar), aggregator,
+stream_processor, stream_function, source, sink, source_mapper,
+sink_mapper, table, store, script.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, Optional
+
+KINDS = (
+    "window",
+    "function",
+    "aggregator",
+    "stream_processor",
+    "stream_function",
+    "source",
+    "sink",
+    "source_mapper",
+    "sink_mapper",
+    "table",
+    "store",
+    "script",
+)
+
+
+class ExtensionRegistry:
+    def __init__(self):
+        self._kinds: Dict[str, Dict[str, Callable]] = defaultdict(dict)
+
+    @staticmethod
+    def full_name(namespace: Optional[str], name: str) -> str:
+        return f"{namespace}:{name}" if namespace else name
+
+    def register(self, kind: str, name: str, factory: Callable, namespace: Optional[str] = None):
+        assert kind in KINDS, f"unknown extension kind {kind!r}"
+        self._kinds[kind][self.full_name(namespace, name)] = factory
+
+    def lookup(self, kind: str, name: str, namespace: Optional[str] = None) -> Optional[Callable]:
+        return self._kinds[kind].get(self.full_name(namespace, name))
+
+    def names(self, kind: str):
+        return sorted(self._kinds[kind])
+
+    def copy(self) -> "ExtensionRegistry":
+        r = ExtensionRegistry()
+        for kind, entries in self._kinds.items():
+            r._kinds[kind] = dict(entries)
+        return r
+
+
+# global default registry populated by builtin modules at import time
+_DEFAULT = ExtensionRegistry()
+
+
+def extension(kind: str, name: str, namespace: Optional[str] = None):
+    """Decorator registering a builtin/user extension in the default
+    registry (the @Extension annotation analog)."""
+
+    def wrap(cls):
+        _DEFAULT.register(kind, name, cls, namespace)
+        return cls
+
+    return wrap
+
+
+def default_registry() -> ExtensionRegistry:
+    # import builtin extension modules for their registration side effects
+    import siddhi_tpu.ops.windows  # noqa: F401
+
+    return _DEFAULT.copy()

@@ -1,0 +1,7 @@
+"""Compute operators: windows, aggregators, NFA kernels.
+
+The "native layer" of the TPU build — where the reference has per-event
+Java operators (query/processor/stream/window/*, query/selector/attribute/
+aggregator/*, query/input/stream/state/*), this package has vectorized
+columnar operators whose hot paths are jax-jittable.
+"""

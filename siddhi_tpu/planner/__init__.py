@@ -1,0 +1,8 @@
+"""Planner: query_api AST -> executable runtime graph.
+
+The analog of the reference's ``core/util/parser`` package
+(SiddhiAppParser/QueryParser/ExpressionParser — SURVEY.md §3.1), but the
+product is different: instead of an object graph of per-event processors,
+queries lower to columnar step functions (numpy host path, jax device
+path) wired between stream junctions.
+"""

@@ -1,0 +1,165 @@
+"""App planner: SiddhiApp AST -> SiddhiAppRuntime.
+
+The analog of the reference SiddhiAppParser.parse + SiddhiAppRuntimeBuilder
+(util/parser/SiddhiAppParser.java:91, util/SiddhiAppRuntimeBuilder.java:64):
+wires junctions for every stream definition (plus @OnError fault streams),
+plans queries/partitions, and assembles the runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
+from siddhi_tpu.core.exceptions import (
+    DefinitionNotExistError,
+    OnErrorAction,
+    SiddhiAppCreationError,
+)
+from siddhi_tpu.core.stream import InputManager, StreamJunction
+from siddhi_tpu.query_api import (
+    Attribute,
+    AttrType,
+    Partition,
+    Query,
+    SiddhiApp,
+    SingleInputStream,
+    StreamDefinition,
+)
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.util.scheduler import Scheduler
+
+
+class AppPlanner:
+    def __init__(self, siddhi_app: SiddhiApp, app_string: str, siddhi_context: SiddhiContext):
+        self.siddhi_app = siddhi_app
+        self.app_string = app_string
+        self.siddhi_context = siddhi_context
+        self.extensions = siddhi_context.extensions
+
+        name_ann = find_annotation(siddhi_app.annotations, "app:name")
+        import uuid
+
+        self.name = (name_ann.element() if name_ann else None) or f"app_{uuid.uuid4().hex[:8]}"
+        self.app_context = SiddhiAppContext(siddhi_context, self.name)
+        playback = find_annotation(siddhi_app.annotations, "app:playback")
+        if playback is not None:
+            inc = playback.element("increment")
+            self.app_context.set_playback(True, int(inc) if inc else 0)
+        self.scheduler = Scheduler(self.app_context)
+        self.app_context.scheduler = self.scheduler
+
+        self.junctions: Dict[str, StreamJunction] = {}
+        self.definitions: Dict[str, StreamDefinition] = {}
+        self.query_runtimes: Dict[str, object] = {}
+
+    # -- junction / definition registry -------------------------------------
+
+    @staticmethod
+    def _key(stream_id: str, is_inner: bool = False, is_fault: bool = False) -> str:
+        if is_inner:
+            return "#" + stream_id
+        if is_fault:
+            return "!" + stream_id
+        return stream_id
+
+    def define_stream(self, definition: StreamDefinition, key: Optional[str] = None):
+        key = key or definition.id
+        if key in self.junctions:
+            return self.junctions[key]
+        is_async = False
+        buffer_size = 1024
+        batch_max = None
+        on_error = OnErrorAction.LOG
+        async_ann = find_annotation(definition.annotations, "async")
+        if async_ann is not None:
+            is_async = True
+            bs = async_ann.element("buffer.size")
+            bm = async_ann.element("batch.size.max")
+            buffer_size = int(bs) if bs else 1024
+            batch_max = int(bm) if bm else None
+        onerror_ann = find_annotation(definition.annotations, "OnError")
+        fault_junction = None
+        if onerror_ann is not None and (onerror_ann.element("action") or "log").lower() == "stream":
+            on_error = OnErrorAction.STREAM
+            fault_def = StreamDefinition(
+                id="!" + definition.id,
+                attributes=list(definition.attributes) + [Attribute("_error", AttrType.OBJECT)],
+            )
+            fault_junction = self.define_stream(fault_def, key="!" + definition.id)
+        j = StreamJunction(
+            definition,
+            self.app_context,
+            is_async=is_async,
+            buffer_size=buffer_size,
+            batch_size_max=batch_max,
+            on_error=on_error,
+            fault_junction=fault_junction,
+        )
+        self.junctions[key] = j
+        self.definitions[key] = definition
+        return j
+
+    def get_or_create_junction(
+        self, stream_id: str, fallback_def: StreamDefinition, is_inner=False, is_fault=False
+    ) -> StreamJunction:
+        key = self._key(stream_id, is_inner, is_fault)
+        if key in self.junctions:
+            return self.junctions[key]
+        d = StreamDefinition(id=stream_id, attributes=list(fallback_def.attributes))
+        return self.define_stream(d, key=key)
+
+    def resolve_stream_definition(self, s) -> StreamDefinition:
+        if isinstance(s, SingleInputStream):
+            key = self._key(s.stream_id, s.is_inner, s.is_fault)
+            if key in self.definitions:
+                return self.definitions[key]
+            raise DefinitionNotExistError(
+                f"stream '{key}' is not defined in app '{self.name}'"
+            )
+        raise SiddhiAppCreationError(f"cannot resolve definition for {s!r}")
+
+    def junction_for_input(self, s: SingleInputStream) -> StreamJunction:
+        key = self._key(s.stream_id, s.is_inner, s.is_fault)
+        if key not in self.junctions:
+            raise DefinitionNotExistError(f"stream '{key}' is not defined")
+        return self.junctions[key]
+
+    def table_resolver(self, table_name: str):
+        raise SiddhiAppCreationError(f"tables not supported yet ('IN {table_name}')")
+
+    # -- build --------------------------------------------------------------
+
+    def build(self):
+        from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
+        from siddhi_tpu.planner.query_planner import QueryPlanner
+
+        for d in self.siddhi_app.stream_definitions.values():
+            self.define_stream(d)
+
+        qp = QueryPlanner(self)
+        qi = 0
+        for element in self.siddhi_app.execution_elements:
+            if isinstance(element, Query):
+                qr = qp.plan(element, qi)
+                qi += 1
+                if qr.name in self.query_runtimes:
+                    raise SiddhiAppCreationError(f"duplicate query name '{qr.name}'")
+                self.query_runtimes[qr.name] = qr
+            elif isinstance(element, Partition):
+                raise SiddhiAppCreationError("partitions not supported yet")
+
+        input_manager = InputManager(self.app_context)
+        for key, j in self.junctions.items():
+            if not key.startswith("#"):
+                input_manager.register(j)
+
+        return SiddhiAppRuntime(
+            name=self.name,
+            siddhi_app=self.siddhi_app,
+            app_context=self.app_context,
+            junctions=self.junctions,
+            query_runtimes=self.query_runtimes,
+            input_manager=input_manager,
+            scheduler=self.scheduler,
+        )

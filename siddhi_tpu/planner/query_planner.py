@@ -1,0 +1,283 @@
+"""Query planner: Query AST -> QueryRuntime.
+
+The analog of the reference QueryParser.parse (util/parser/QueryParser.java:90)
++ SingleInputStreamParser + SelectorParser + OutputParser, producing
+columnar processors instead of per-event executor chains.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from siddhi_tpu.core.exceptions import (
+    DefinitionNotExistError,
+    SiddhiAppCreationError,
+)
+from siddhi_tpu.core.query import (
+    AggBinding,
+    FilterProcessor,
+    InsertIntoStreamCallback,
+    PassThroughRateLimiter,
+    ProcessStreamReceiver,
+    QueryCallbackOutput,
+    QueryRuntime,
+    QuerySelector,
+    SelectItem,
+    WindowChainProcessor,
+)
+from siddhi_tpu.ops.aggregators import make_aggregator
+from siddhi_tpu.planner.expr import (
+    AGGREGATOR_NAMES,
+    CompiledExpression,
+    ExpressionCompiler,
+    Scope,
+)
+from siddhi_tpu.query_api import (
+    Annotation,
+    ArithmeticOp,
+    AndOp,
+    Attribute,
+    AttrType,
+    CompareOp,
+    Constant,
+    Expression,
+    Filter,
+    FunctionCall,
+    InOp,
+    InsertIntoStream,
+    IsNull,
+    NotOp,
+    OrOp,
+    OutputAttribute,
+    Query,
+    ReturnStream,
+    Selector,
+    SingleInputStream,
+    StreamDefinition,
+    StreamFunction,
+    Variable,
+    WindowHandler,
+)
+from siddhi_tpu.query_api.annotation import find_annotation
+
+_query_counter = itertools.count()
+
+
+class AggregatorRewrite:
+    """Walks a select expression, replacing aggregator calls with synthetic
+    variables bound to aggregation outputs (the reference instead builds
+    AttributeAggregatorExecutors inline in SelectorParser)."""
+
+    def __init__(self, scope: Scope, compiler: ExpressionCompiler):
+        self.scope = scope
+        self.compiler = compiler
+        self.bindings: List[AggBinding] = []
+
+    def rewrite(self, expr: Expression) -> Expression:
+        if isinstance(expr, FunctionCall) and expr.namespace is None and expr.name in AGGREGATOR_NAMES:
+            key = f"__agg_{len(self.bindings)}"
+            arg: Optional[CompiledExpression] = None
+            if expr.args:
+                if len(expr.args) > 1:
+                    raise SiddhiAppCreationError(f"aggregator '{expr.name}' takes one argument")
+                arg = self.compiler.compile(self.rewrite(expr.args[0]))
+            elif expr.name not in ("count",) and not expr.star:
+                raise SiddhiAppCreationError(f"aggregator '{expr.name}' needs an argument")
+            executor = make_aggregator(expr.name, arg.type if arg is not None else None)
+            self.bindings.append(AggBinding(key, executor, arg))
+            self.scope.add_bare(key, executor.return_type)
+            return Variable(attribute=key)
+        if isinstance(expr, ArithmeticOp):
+            return ArithmeticOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, CompareOp):
+            return CompareOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, AndOp):
+            return AndOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, OrOp):
+            return OrOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, NotOp):
+            return NotOp(self.rewrite(expr.expr))
+        if isinstance(expr, IsNull):
+            return IsNull(self.rewrite(expr.expr))
+        if isinstance(expr, InOp):
+            return InOp(self.rewrite(expr.expr), expr.source_id)
+        if isinstance(expr, FunctionCall):
+            return FunctionCall(
+                expr.namespace, expr.name, tuple(self.rewrite(a) for a in expr.args), expr.star
+            )
+        return expr
+
+
+def scope_for_definition(definition: StreamDefinition, stream_ref: str) -> Scope:
+    scope = Scope()
+    for a in definition.attributes:
+        scope.add(stream_ref, a.name, a.name, a.type)
+    return scope
+
+
+class QueryPlanner:
+    """Plans one query against the app's junction/definition registry."""
+
+    def __init__(self, app_planner):
+        self.app = app_planner  # AppPlanner
+
+    def plan(self, query: Query, query_index: int) -> QueryRuntime:
+        info = find_annotation(query.annotations, "info")
+        name = (info.element("name") if info else None) or f"query_{query_index}"
+
+        in_stream = query.input_stream
+        if isinstance(in_stream, SingleInputStream):
+            return self._plan_single(query, name, in_stream)
+        raise SiddhiAppCreationError(
+            f"query '{name}': input type {type(in_stream).__name__} not supported yet"
+        )
+
+    # -- single stream ------------------------------------------------------
+
+    def _plan_single(self, query: Query, name: str, s: SingleInputStream) -> QueryRuntime:
+        definition = self.app.resolve_stream_definition(s)
+        ref = s.unique_id
+        scope = scope_for_definition(definition, ref)
+        if s.alias and s.alias != s.stream_id:
+            scope.add_alias(s.stream_id, s.alias)
+        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+
+        chain, batch_mode, windows = self._plan_handlers(s, definition, compiler)
+        selector, out_def = self._plan_selector(
+            query.selector, scope, compiler, name, query, batch_mode
+        )
+        output = self._plan_output(query, out_def)
+        rate_limiter = PassThroughRateLimiter()
+
+        qr = QueryRuntime(name, [chain], selector, rate_limiter, output, self.app.app_context)
+        for w in windows:
+            if w.needs_scheduler:
+                self.app.scheduler.register_window(qr, w)
+        junction = self.app.junction_for_input(s)
+        junction.subscribe(ProcessStreamReceiver(qr))
+        return qr
+
+    def _plan_handlers(self, s: SingleInputStream, definition, compiler):
+        chain = []
+        windows = []
+        batch_mode = False
+        for h in s.handlers:
+            if isinstance(h, Filter):
+                chain.append(FilterProcessor(compiler.compile(h.expression)))
+            elif isinstance(h, WindowHandler):
+                factory = self.app.extensions.lookup("window", h.name, h.namespace)
+                if factory is None:
+                    raise SiddhiAppCreationError(f"unknown window '#{'window.'}{h.name}()'")
+                args = [compiler.compile(a) for a in h.args]
+                w = factory(args, definition.attribute_names)
+                windows.append(w)
+                batch_mode = batch_mode or getattr(w, "is_batch", False)
+                chain.append(WindowChainProcessor(w))
+            elif isinstance(h, StreamFunction):
+                factory = self.app.extensions.lookup(
+                    "stream_processor", h.name, h.namespace
+                ) or self.app.extensions.lookup("stream_function", h.name, h.namespace)
+                if factory is None:
+                    raise SiddhiAppCreationError(f"unknown stream function '#{h.name}()'")
+                args = [compiler.compile(a) for a in h.args]
+                from siddhi_tpu.core.query import StreamFunctionChainProcessor
+
+                chain.append(StreamFunctionChainProcessor(factory(args, definition.attribute_names)))
+            else:
+                raise SiddhiAppCreationError(f"unsupported stream handler {h}")
+        return chain, batch_mode, windows
+
+    # -- selector -----------------------------------------------------------
+
+    def _plan_selector(
+        self,
+        sel: Selector,
+        scope: Scope,
+        compiler: ExpressionCompiler,
+        qname: str,
+        query: Query,
+        batch_mode: bool,
+    ) -> Tuple[QuerySelector, StreamDefinition]:
+        out_target = getattr(query.output_stream, "target", None) or f"__ret_{qname}"
+        rewriter = AggregatorRewrite(scope, compiler)
+
+        items: Optional[List[SelectItem]] = None
+        out_attrs: List[Attribute] = []
+        if sel.is_select_all:
+            # select * — passthrough of the input definition
+            in_def = self.app.resolve_stream_definition(query.input_stream)
+            out_attrs = list(in_def.attributes)
+            out_names = in_def.attribute_names
+        else:
+            items = []
+            for oa in sel.selection:
+                rewritten = rewriter.rewrite(oa.expression)
+                compiled = compiler.compile(rewritten)
+                nm = oa.rename or (
+                    oa.expression.attribute
+                    if isinstance(oa.expression, Variable)
+                    else None
+                )
+                if nm is None:
+                    raise SiddhiAppCreationError(
+                        f"query '{qname}': select expression needs 'as <name>'"
+                    )
+                items.append(SelectItem(nm, compiled))
+                out_attrs.append(Attribute(nm, compiled.type))
+            out_names = [i.name for i in items]
+            # output attributes are referencable in having/order-by
+            for a in out_attrs:
+                scope.add_bare(a.name, a.type)
+
+        group_keys = [compiler.compile(g) for g in sel.group_by]
+        having = compiler.compile(rewriter.rewrite(sel.having)) if sel.having is not None else None
+        order_by = []
+        for ob in sel.order_by:
+            if ob.variable.attribute not in out_names:
+                raise SiddhiAppCreationError(
+                    f"order by attribute '{ob.variable.attribute}' not in select output"
+                )
+            order_by.append((ob.variable.attribute, ob.ascending))
+        limit = self._const_int(sel.limit, compiler, "limit")
+        offset = self._const_int(sel.offset, compiler, "offset")
+
+        selector = QuerySelector(
+            out_target,
+            items,
+            out_names,
+            rewriter.bindings,
+            group_keys,
+            having,
+            order_by,
+            limit,
+            offset,
+            batch_mode=batch_mode,
+        )
+        out_def = StreamDefinition(id=out_target, attributes=out_attrs)
+        return selector, out_def
+
+    @staticmethod
+    def _const_int(expr, compiler, what) -> Optional[int]:
+        if expr is None:
+            return None
+        c = compiler.compile(expr)
+        try:
+            return int(c.fn({}))
+        except Exception as e:
+            raise SiddhiAppCreationError(f"{what} must be a constant") from e
+
+    # -- output -------------------------------------------------------------
+
+    def _plan_output(self, query: Query, out_def: StreamDefinition):
+        out = query.output_stream
+        if isinstance(out, InsertIntoStream):
+            junction = self.app.get_or_create_junction(
+                out.target, out_def, is_inner=out.is_inner, is_fault=out.is_fault
+            )
+            return InsertIntoStreamCallback(junction, out.event_type)
+        if isinstance(out, ReturnStream) or out is None:
+            return QueryCallbackOutput()
+        raise SiddhiAppCreationError(
+            f"output type {type(out).__name__} not supported yet"
+        )

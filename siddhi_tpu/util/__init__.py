@@ -1,0 +1,2 @@
+"""Cross-cutting services: scheduler, statistics, persistence, transport
+(the reference ``core/util/`` analog)."""

@@ -1,0 +1,69 @@
+"""Scheduler: time-driven window eviction and triggers.
+
+Replaces the reference's per-event scheduler threads
+(util/Scheduler.java:48 notifyAt + ScheduledExecutorService) with a
+watermark design: every event arrival advances the app watermark and
+fires due window ticks under the app lock; a background timer thread
+covers idle periods in processing-time mode (playback mode is purely
+event-driven, reference: TimestampGeneratorImpl + @app:playback).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+
+class Scheduler:
+    def __init__(self, app_context):
+        self.app_context = app_context
+        # (query_runtime, window) pairs needing time ticks
+        self._windows: List[Tuple[object, object]] = []
+        # plain callbacks: fn(now) -> None, with next_wakeup() -> int|None
+        self._tasks: List[object] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_advance = -1
+
+    def register_window(self, query_runtime, window):
+        self._windows.append((query_runtime, window))
+
+    def register_task(self, task):
+        """task must expose fire(now) and next_wakeup() -> Optional[int]."""
+        self._tasks.append(task)
+
+    # -- event-driven path (called under app lock) --------------------------
+
+    def advance(self, now: int):
+        if now <= self._last_advance:
+            return
+        self._last_advance = now
+        for qr, w in self._windows:
+            wake = w.next_wakeup()
+            if wake is not None and wake <= now:
+                qr.on_time(now)
+        for t in self._tasks:
+            wake = t.next_wakeup()
+            if wake is not None and wake <= now:
+                t.fire(now)
+
+    # -- wall-clock fallback (processing-time mode only) --------------------
+
+    def start(self, tick_ms: int = 50):
+        if self.app_context.playback:
+            return  # event-time only
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, args=(tick_ms,), daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self, tick_ms: int):
+        while not self._stop.wait(tick_ms / 1000.0):
+            now = self.app_context.timestamp_generator.current_time()
+            with self.app_context.process_lock:
+                self.advance(now)

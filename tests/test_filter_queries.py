@@ -1,0 +1,268 @@
+"""End-to-end filter/projection query conformance tests.
+
+Style mirrors the reference TestNG suite (SiddhiQL in, events in,
+asserted events out — e.g. query/FilterTestCase1.java): no mocks, the
+whole engine runs in-process.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run_app(manager, app, stream, rows, out_stream="OutputStream"):
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(out_stream, lambda events: got.extend(events))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for r in rows:
+        h.send(r)
+    rt.shutdown()
+    return got
+
+
+class TestFilter:
+    APP = (
+        "define stream cseEventStream (symbol string, price float, volume long); "
+        "@info(name = 'query1') "
+        "from cseEventStream[volume < 150] "
+        "select symbol, price insert into OutputStream;"
+    )
+
+    def test_basic_filter(self, manager):
+        got = run_app(
+            manager,
+            self.APP,
+            "cseEventStream",
+            [["IBM", 700.0, 100], ["WSO2", 60.5, 200], ["GOOG", 50.0, 30]],
+        )
+        assert [e.data for e in got] == [["IBM", 700.0], ["GOOG", 50.0]]
+
+    def test_compound_condition(self, manager):
+        app = (
+            "define stream S (symbol string, price float, volume long); "
+            "from S[volume < 150 and price > 55.0] select symbol insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [["A", 60.0, 100], ["B", 50.0, 100], ["C", 60.0, 200]])
+        assert [e.data for e in got] == [["A"]]
+
+    def test_string_equality(self, manager):
+        app = (
+            "define stream S (symbol string, price float); "
+            "from S[symbol == 'IBM'] select price insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [["IBM", 10.0], ["X", 20.0], ["IBM", 30.0]])
+        assert [e.data for e in got] == [[10.0], [30.0]]
+
+    def test_not_and_or(self, manager):
+        app = (
+            "define stream S (a int, b int); "
+            "from S[not (a > 5) or b == 0] select a, b insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1, 1], [9, 1], [9, 0]])
+        assert [e.data for e in got] == [[1, 1], [9, 0]]
+
+    def test_math_projection(self, manager):
+        app = (
+            "define stream S (a int, b int); "
+            "from S select a + b * 2 as x, a - b as y, a / b as d, a % b as m "
+            "insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[7, 2]])
+        assert got[0].data == [11, 5, 3, 1]
+
+    def test_java_int_division_semantics(self, manager):
+        app = (
+            "define stream S (a int, b int); "
+            "from S select a / b as d, a % b as m insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[-7, 2], [7, -2], [-7, -2]])
+        # Java: -7/2 == -3 (trunc toward zero), -7%2 == -1 (sign of dividend)
+        assert [e.data for e in got] == [[-3, -1], [-3, 1], [3, -1]]
+
+    def test_float_promotion(self, manager):
+        app = (
+            "define stream S (a int, f float); "
+            "from S select a + f as x insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1, 0.5]])
+        assert got[0].data[0] == pytest.approx(1.5)
+
+    def test_select_star(self, manager):
+        app = "define stream S (a int, b string); from S select * insert into OutputStream;"
+        got = run_app(manager, app, "S", [[5, "x"]])
+        assert got[0].data == [5, "x"]
+
+    def test_chained_queries(self, manager):
+        app = (
+            "define stream S (a int); "
+            "from S[a > 0] select a * 10 as b insert into Mid; "
+            "from Mid[b > 50] select b insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1], [6], [-3], [9]])
+        assert [e.data for e in got] == [[60], [90]]
+
+    def test_multiple_queries_same_stream(self, manager):
+        app = (
+            "define stream S (a int); "
+            "from S[a > 5] select a insert into OutputStream; "
+            "from S[a < 3] select a insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1], [6], [4]])
+        assert sorted(e.data[0] for e in got) == [1, 6]
+
+    def test_if_then_else_and_cast(self, manager):
+        app = (
+            "define stream S (a int); "
+            "from S select ifThenElse(a > 5, 'big', 'small') as size, "
+            "cast(a, 'double') as d insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[10], [2]])
+        assert got[0].data == ["big", 10.0]
+        assert got[1].data == ["small", 2.0]
+
+    def test_query_callback(self, manager):
+        rt = manager.create_siddhi_app_runtime(self.APP)
+        received = []
+        rt.add_callback("query1", lambda ts, ins, outs: received.append((ins, outs)))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["IBM", 700.0, 100])
+        h.send(["WSO2", 60.5, 200])
+        rt.shutdown()
+        assert len(received) == 1
+        ins, outs = received[0]
+        assert outs is None
+        assert [e.data for e in ins] == [["IBM", 700.0]]
+
+    def test_event_timestamp_fn(self, manager):
+        app = (
+            "define stream S (a int); "
+            "from S select eventTimestamp() as ts, a insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("S").send([1], timestamp=12345)
+        rt.shutdown()
+        assert got[0].data == [12345, 1]
+
+    def test_undefined_stream_error(self, manager):
+        from siddhi_tpu.core.exceptions import DefinitionNotExistError
+
+        with pytest.raises(DefinitionNotExistError):
+            manager.create_siddhi_app_runtime(
+                "define stream S (a int); from Missing select a insert into O;"
+            )
+
+    def test_unknown_function_error(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        with pytest.raises(SiddhiAppCreationError):
+            manager.create_siddhi_app_runtime(
+                "define stream S (a int); from S select nosuchfn(a) as x insert into O;"
+            )
+
+
+class TestAggregationsNoWindow:
+    def test_running_sum_count(self, manager):
+        app = (
+            "define stream S (symbol string, price double); "
+            "from S select symbol, sum(price) as total, count() as n "
+            "insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [["A", 1.0], ["A", 2.0], ["A", 3.0]])
+        assert [e.data for e in got] == [["A", 1.0, 1], ["A", 3.0, 2], ["A", 6.0, 3]]
+
+    def test_group_by_running_sum(self, manager):
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S select symbol, sum(v) as total group by symbol "
+            "insert into OutputStream;"
+        )
+        got = run_app(
+            manager, app, "S", [["A", 10], ["B", 1], ["A", 5], ["B", 2]]
+        )
+        assert [e.data for e in got] == [["A", 10], ["B", 1], ["A", 15], ["B", 3]]
+
+    def test_avg_min_max(self, manager):
+        app = (
+            "define stream S (v double); "
+            "from S select avg(v) as a, min(v) as mn, max(v) as mx "
+            "insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[4.0], [2.0], [6.0]])
+        assert got[-1].data == [4.0, 2.0, 6.0]
+
+    def test_having(self, manager):
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S select symbol, sum(v) as total group by symbol "
+            "having total > 10 insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [["A", 5], ["A", 7], ["B", 3]])
+        assert [e.data for e in got] == [["A", 12]]
+
+    def test_agg_in_expression(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select sum(v) * 2 as double_total insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1], [2]])
+        assert [e.data for e in got] == [[2], [6]]
+
+
+class TestLengthWindows:
+    def test_length_window_expiry(self, manager):
+        app = (
+            "define stream S (symbol string, price float); "
+            "from S#window.length(2) select symbol, price insert all events into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [["A", 1.0], ["B", 2.0], ["C", 3.0]])
+        # third arrival expires A first (eviction precedes arrival)
+        assert [e.data for e in got] == [["A", 1.0], ["B", 2.0], ["A", 1.0], ["C", 3.0]]
+
+    def test_length_window_sum(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.length(2) select sum(v) as total insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1], [2], [3], [4]])
+        # windowed running sum over last 2 (evictions subtract first)
+        assert [e.data[0] for e in got] == [1, 3, 5, 7]
+
+    def test_length_batch(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.lengthBatch(2) select sum(v) as total insert into OutputStream;"
+        )
+        got = run_app(manager, app, "S", [[1], [2], [3], [4]])
+        # batches: [1,2] -> totals 1,3 ; reset ; [3,4] -> totals 3,7
+        assert [e.data[0] for e in got] == [1, 3, 3, 7]
+
+    def test_query_callback_remove_events(self, manager):
+        app = (
+            "define stream S (v long); "
+            "@info(name='q') from S#window.length(1) select v insert all events into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        pairs = []
+        rt.add_callback("q", lambda ts, ins, outs: pairs.append((ins, outs)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([1])
+        h.send([2])
+        rt.shutdown()
+        assert [e.data for e in pairs[0][0]] == [[1]]
+        assert pairs[0][1] is None
+        assert [e.data for e in pairs[1][0]] == [[2]]
+        assert [e.data for e in pairs[1][1]] == [[1]]
