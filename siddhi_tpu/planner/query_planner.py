@@ -64,6 +64,18 @@ from siddhi_tpu.query_api.annotation import find_annotation
 _query_counter = itertools.count()
 
 
+class _PatternStreamReceiver:
+    """Junction subscriber feeding one source stream into the NFA
+    (the Pattern/SequenceSingleProcessStreamReceiver analog)."""
+
+    def __init__(self, processor, stream_key: str):
+        self.processor = processor
+        self.stream_key = stream_key
+
+    def receive(self, batch):
+        self.processor.process_stream_batch(self.stream_key, batch)
+
+
 class AggregatorRewrite:
     """Walks a select expression, replacing aggregator calls with synthetic
     variables bound to aggregation outputs (the reference instead builds
@@ -129,9 +141,75 @@ class QueryPlanner:
         in_stream = query.input_stream
         if isinstance(in_stream, SingleInputStream):
             return self._plan_single(query, name, in_stream)
+        from siddhi_tpu.query_api import StateInputStream
+
+        if isinstance(in_stream, StateInputStream):
+            return self._plan_state(query, name, in_stream)
         raise SiddhiAppCreationError(
             f"query '{name}': input type {type(in_stream).__name__} not supported yet"
         )
+
+    # -- pattern / sequence --------------------------------------------------
+
+    def _plan_state(self, query: Query, name: str, st) -> QueryRuntime:
+        from siddhi_tpu.ops.nfa import (
+            NFABuilder,
+            PatternProcessor,
+            PatternScope,
+            _collect_presence,
+        )
+
+        builder = NFABuilder(st, self.app.resolve_stream_definition)
+        nodes = builder.build()
+
+        # selector scope over event refs; bare attrs resolve when unambiguous
+        scope = PatternScope(builder.ref_defs, builder.stream_to_ref, cand_def=None)
+        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+        selector, out_def = self._plan_selector(
+            query.selector, scope, compiler, name, query, batch_mode=False
+        )
+        output = self._plan_output(query, out_def)
+        rate_limiter = PassThroughRateLimiter()
+        qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
+
+        # presence keys used anywhere in the selector expressions
+        presence = {}
+        sel = query.selector
+        exprs = []
+        if sel.selection:
+            exprs.extend(oa.expression for oa in sel.selection)
+        if sel.having is not None:
+            exprs.append(sel.having)
+        for e in exprs:
+            presence.update(_collect_presence(e, builder.ref_defs, builder.stream_to_ref))
+
+        processor = PatternProcessor(
+            nodes=nodes,
+            mode=st.type,
+            within_ms=st.within_ms,
+            ref_defs=builder.ref_defs,
+            output_keys=dict(scope.used_captures),
+            presence_keys=presence,
+            emit=lambda batch: qr.process(batch, 0),
+            out_stream_id=f"#matches_{name}",
+        )
+        qr.pattern_processor = processor
+        self.app.scheduler.register_task(processor)
+
+        # subscribe one receiver per distinct source junction
+        seen = set()
+        for node in nodes:
+            for spec in node.specs:
+                if spec.stream_key in seen:
+                    continue
+                seen.add(spec.stream_key)
+                junction = self.app.junctions.get(spec.stream_key)
+                if junction is None:
+                    raise DefinitionNotExistError(
+                        f"stream '{spec.stream_key}' is not defined"
+                    )
+                junction.subscribe(_PatternStreamReceiver(processor, spec.stream_key))
+        return qr
 
     # -- single stream ------------------------------------------------------
 
@@ -206,6 +284,11 @@ class QueryPlanner:
         out_attrs: List[Attribute] = []
         if sel.is_select_all:
             # select * — passthrough of the input definition
+            if not isinstance(query.input_stream, SingleInputStream):
+                raise SiddhiAppCreationError(
+                    f"query '{qname}': 'select *' needs an explicit select "
+                    "clause for pattern/join inputs"
+                )
             in_def = self.app.resolve_stream_definition(query.input_stream)
             out_attrs = list(in_def.attributes)
             out_names = in_def.attribute_names

@@ -33,6 +33,7 @@ from siddhi_tpu.query_api.definition import (
     AggregationDefinition,
 )
 from siddhi_tpu.query_api.execution import (
+    InputStream,
     Query,
     Selector,
     OutputAttribute,
@@ -44,6 +45,7 @@ from siddhi_tpu.query_api.execution import (
     Filter,
     StreamFunction,
     WindowHandler,
+    StateElement,
     StreamStateElement,
     AbsentStreamStateElement,
     CountStateElement,

@@ -50,6 +50,10 @@ class Scheduler:
     # -- wall-clock fallback (processing-time mode only) --------------------
 
     def start(self, tick_ms: int = 50):
+        now = self.app_context.timestamp_generator.current_time()
+        for t in self._tasks:
+            if hasattr(t, "on_start"):
+                t.on_start(now)
         if self.app_context.playback:
             return  # event-time only
         self._stop.clear()

@@ -1,0 +1,590 @@
+"""Pattern/sequence conformance tests.
+
+Event sequences and expected match counts/values transcribed from the
+reference TestNG corpus: query/pattern/EveryPatternTestCase.java,
+CountPatternTestCase.java, LogicalPatternTestCase.java,
+query/sequence/SequenceTestCase.java — same behavioral contracts, run
+against the TPU engine.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S12 = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+)
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run(manager, app, sends, out="OutputStream"):
+    """sends: list of (stream, row). Returns collected output events."""
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(evs))
+    rt.start()
+    for stream, row in sends:
+        rt.get_input_handler(stream).send(row)
+    rt.shutdown()
+    return got
+
+
+class TestPatterns:
+    def test_simple_pattern(self, manager):
+        # EveryPatternTestCase.testQuery1
+        app = S12 + (
+            "@info(name='query1') "
+            "from e1=Stream1[price>20] -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert [e.data for e in got] == [["WSO2", "IBM"]]
+
+    def test_non_every_ignores_middle_event(self, manager):
+        # EveryPatternTestCase.testQuery2: extra non-continuing event ignored
+        app = S12 + (
+            "from e1=Stream1[price>20] -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert [e.data for e in got] == [["WSO2", "IBM"]]
+
+    def test_non_every_single_match(self, manager):
+        # after a match, non-every patterns stop
+        app = S12 + (
+            "from e1=Stream1[price>20] -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["A", 55.6, 100]),
+            ("Stream2", ["B", 57.7, 100]),
+            ("Stream1", ["C", 55.6, 100]),
+            ("Stream2", ["D", 57.7, 100]),
+        ])
+        assert [e.data for e in got] == [["A", "B"]]
+
+    def test_every_overlapping(self, manager):
+        # EveryPatternTestCase.testQuery3: overlapping instances both match
+        app = S12 + (
+            "from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert sorted(e.data[0] for e in got) == ["GOOG", "WSO2"]
+        assert len(got) == 2
+
+    def test_every_group_non_overlapping(self, manager):
+        # EveryPatternTestCase.testQuery4: every (e1->e3) -> e2
+        app = S12 + (
+            "from every (e1=Stream1[price>20] -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e3.symbol as s3, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream2", ["IBM", 57.7, 100]),
+        ])
+        assert [e.data for e in got] == [["WSO2", "GOOG", "IBM"]]
+
+    def test_every_group_two_pairs(self, manager):
+        # EveryPatternTestCase.testQuery5
+        app = S12 + (
+            "from every (e1=Stream1[price>20] -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e3.symbol as s3, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream1", ["WSO2", 53.6, 100]),
+            ("Stream1", ["GOOG", 53.0, 100]),
+            ("Stream2", ["IBM", 57.7, 100]),
+        ])
+        assert len(got) == 2
+
+    def test_whole_pattern_every_group(self, manager):
+        # EveryPatternTestCase.testQuery7: every (e1 -> e3), no suffix
+        app = S12 + (
+            "from every (e1=Stream1[price>20] -> e3=Stream1[price>20]) "
+            "select e1.symbol as s1, e3.symbol as s3 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["MSFT", 55.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream1", ["WSO2", 53.6, 100]),
+        ])
+        assert [e.data for e in got] == [["MSFT", "WSO2"], ["GOOG", "WSO2"]]
+
+    def test_every_single_state(self, manager):
+        # EveryPatternTestCase.testQuery8
+        app = S12 + (
+            "from every e1=Stream1[price>20] "
+            "select e1.symbol as s1 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["MSFT", 55.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ])
+        assert [e.data for e in got] == [["MSFT"], ["WSO2"]]
+
+    def test_prefix_then_every_group(self, manager):
+        # EveryPatternTestCase.testQuery6: e4 -> every (e1->e3) -> e2
+        app = S12 + (
+            "from e4=Stream1[symbol=='MSFT'] -> every (e1=Stream1[price>20] -> e3=Stream1[price>20]) "
+            "-> e2=Stream2[price>e1.price] "
+            "select e4.symbol as s4, e1.symbol as s1, e3.symbol as s3, e2.symbol as s2 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["MSFT", 55.6, 100]),
+            ("Stream1", ["WSO2", 55.7, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream1", ["WSO2", 53.6, 100]),
+            ("Stream1", ["GOOG", 53.0, 100]),
+            ("Stream2", ["IBM", 57.7, 100]),
+        ])
+        assert len(got) == 2
+        assert all(e.data[0] == "MSFT" for e in got)
+
+
+class TestCountPatterns:
+    APP = S12.replace("symbol string, price float, volume int", "price float, volume int", 1)
+
+    def test_count_greedy(self, manager):
+        # CountPatternTestCase.testQuery1: <2:5>, failing event ignored,
+        # greedy capture, single match with all captures
+        app = (
+            "define stream Stream1 (symbol string, price float, volume int); "
+            "define stream Stream2 (symbol string, price float, volume int); "
+            "from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20] "
+            "select e1[0].price as p0, e1[1].price as p1, e1[2].price as p2, "
+            "e1[3].price as p3, e2.price as p4 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["GOOG", 47.6, 100]),
+            ("Stream1", ["GOOG", 13.7, 100]),
+            ("Stream1", ["GOOG", 47.8, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert len(got) == 1
+        d = got[0].data
+        assert d[0] == pytest.approx(25.6, abs=1e-4)
+        assert d[1] == pytest.approx(47.6, abs=1e-4)
+        assert d[2] == pytest.approx(47.8, abs=1e-4)
+        assert d[3] is None
+        assert d[4] == pytest.approx(45.7, abs=1e-4)
+
+    def test_count_min_not_reached(self, manager):
+        # CountPatternTestCase.testQuery3-style: e2 event before min ignored
+        app = S12 + (
+            "from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20] "
+            "select e1[0].price as p0, e1[1].price as p1, e2.price as p2 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+            ("Stream1", ["GOOG", 47.8, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[0] == pytest.approx(25.6, abs=1e-4)
+        assert got[0].data[1] == pytest.approx(47.8, abs=1e-4)
+        assert got[0].data[2] == pytest.approx(55.7, abs=1e-4)
+
+    def test_count_none_when_min_unmet(self, manager):
+        # CountPatternTestCase.testQuery4: 0 matches
+        app = S12 + (
+            "from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20] "
+            "select e1[0].price as p0, e2.price as p2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+        ])
+        assert got == []
+
+    def test_count_max_cap(self, manager):
+        # CountPatternTestCase.testQuery5: capture capped at 5
+        app = S12 + (
+            "from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20] "
+            "select e1[0].price as p0, e1[4].price as p4, e2.price as pe "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["GOOG", 47.6, 100]),
+            ("Stream1", ["GOOG", 23.7, 100]),
+            ("Stream1", ["GOOG", 24.7, 100]),
+            ("Stream1", ["GOOG", 25.7, 100]),
+            ("Stream1", ["WSO2", 27.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+            ("Stream1", ["GOOG", 47.8, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[0] == pytest.approx(25.6, abs=1e-4)
+        assert got[0].data[1] == pytest.approx(25.7, abs=1e-4)
+
+    def test_count_cross_state_index_filter(self, manager):
+        # CountPatternTestCase.testQuery6: e2 filter references e1[1]
+        app = S12 + (
+            "from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>e1[1].price] "
+            "select e1[0].price as p0, e1[1].price as p1, e2.price as p2 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["GOOG", 47.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[2] == pytest.approx(55.7, abs=1e-4)
+
+    def test_trailing_optional_count_via_next(self, manager):
+        # CountPatternTestCase.testQuery2-style: zero-count middle state
+        app = (
+            "define stream EventStream (symbol string, price float, volume int); "
+            "from e1=EventStream[price >= 50 and volume > 100] -> "
+            "e2=EventStream[price <= 40]<0:5> -> e3=EventStream[volume <= 70] "
+            "select e1.symbol as s1, e2[0].symbol as s2, e3.symbol as s3 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("EventStream", ["IBM", 75.6, 105]),
+            ("EventStream", ["GOOG", 21.0, 61]),
+        ])
+        assert len(got) == 1
+        assert got[0].data == ["IBM", None, "GOOG"]
+
+
+class TestLogicalPatterns:
+    def test_and_pattern(self, manager):
+        app = S12 + (
+            "from e1=Stream1[price>20] and e2=Stream2[price>20] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream2", ["IBM", 45.7, 100]),
+            ("Stream1", ["WSO2", 55.6, 100]),
+        ])
+        assert [e.data for e in got] == [["WSO2", "IBM"]]
+
+    def test_or_pattern(self, manager):
+        app = S12 + (
+            "from e1=Stream1[price>20] or e2=Stream2[price>20] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream2", ["IBM", 45.7, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data == [None, "IBM"]
+
+    def test_and_then_next(self, manager):
+        app = S12 + (
+            "from e1=Stream1[price>20] and e2=Stream2[price>20] -> e3=Stream1[price>e1.price] "
+            "select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["A", 50.0, 100]),
+            ("Stream2", ["B", 45.7, 100]),
+            ("Stream1", ["C", 55.6, 100]),
+        ])
+        assert [e.data for e in got] == [["A", "B", "C"]]
+
+
+class TestWithin:
+    def test_within_expires(self, manager):
+        app = S12 + (
+            "from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price] within 1 sec "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        h1, h2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+        h1.send(["WSO2", 55.6, 100], timestamp=1000)
+        h2.send(["IBM", 55.7, 100], timestamp=2500)  # too late
+        h1.send(["GOOG", 55.6, 100], timestamp=3000)
+        h2.send(["IBM2", 55.7, 100], timestamp=3500)  # in time
+        rt.shutdown()
+        assert [e.data for e in got] == [["GOOG", "IBM2"]]
+
+
+class TestSequences:
+    def test_simple_sequence(self, manager):
+        # SequenceTestCase.testQuery1
+        app = S12 + (
+            "from e1=Stream1[price>20], e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ])
+        assert [e.data for e in got] == [["WSO2", "IBM"]]
+
+    def test_strict_continuity_restart(self, manager):
+        # SequenceTestCase.testQuery2: interrupting event kills + restarts
+        app = S12 + (
+            "from every e1=Stream1[price>20], e2=Stream2[price>e1.price] "
+            "select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 57.6, 100]),
+            ("Stream2", ["IBM", 65.7, 100]),
+        ])
+        assert [e.data for e in got] == [["GOOG", "IBM"]]
+
+    def test_trailing_star_immediate(self, manager):
+        # SequenceTestCase.testQuery3: trailing * emits immediately
+        app = S12 + (
+            "from every e1=Stream1[price>20], e2=Stream2[price>e1.price]* "
+            "select e1.symbol as s1, e2[0].symbol as s2, e2[1].symbol as s3 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["IBM", 55.7, 100]),
+        ])
+        assert len(got) == 2
+        assert got[0].data == ["WSO2", None, None]
+        assert got[1].data == ["IBM", None, None]
+
+    def test_star_collects(self, manager):
+        # SequenceTestCase.testQuery4
+        app = S12 + (
+            "from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price] "
+            "select e1[0].price as p1, e1[1].price as p2, e2.price as p3 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[0] == pytest.approx(55.6, abs=1e-4)
+        assert got[0].data[1] == pytest.approx(55.7, abs=1e-4)
+        assert got[0].data[2] == pytest.approx(57.6, abs=1e-4)
+
+    def test_optional_one(self, manager):
+        # SequenceTestCase.testQuery6: `?` keeps at most one
+        app = S12 + (
+            "from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price] "
+            "select e1[0].price as p1, e2.price as p3 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[0] == pytest.approx(55.7, abs=1e-4)
+
+    def test_or_sequence(self, manager):
+        # SequenceTestCase.testQuery7
+        app = S12 + (
+            "from every e1=Stream2[price>20], e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM'] "
+            "select e1.price as p1, e2.price as p2, e3.price as p3 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream2", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+            ("Stream2", ["WSO2", 57.6, 100]),
+        ])
+        assert len(got) == 2
+
+    def test_or_sequence_absent_branch(self, manager):
+        # SequenceTestCase.testQuery8: e3 branch matches on symbol
+        app = S12 + (
+            "from every e1=Stream2[price>20], e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM'] "
+            "select e1.price as p1, e2.price as p2, e3.price as p3 insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream2", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.0, 100]),
+            ("Stream2", ["WSO2", 57.6, 100]),
+        ])
+        assert len(got) == 2
+
+    def test_plus_sequence(self, manager):
+        # SequenceTestCase.testQuery10
+        app = S12 + (
+            "from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price] "
+            "select e1[0].price as p1, e1[1].price as p2, e2.price as p3 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ])
+        assert len(got) == 1
+        assert got[0].data[0] == pytest.approx(55.6, abs=1e-4)
+        assert got[0].data[1] is None
+        assert got[0].data[2] == pytest.approx(57.6, abs=1e-4)
+
+    def test_peak_detection(self, manager):
+        # SequenceTestCase.testQuery11: classic peak via e2[last] filter
+        app = (
+            "define stream Stream1 (symbol string, price float, volume int); "
+            "from every e1=Stream1[price>20], "
+            "e2=Stream1[(e2[last].price is null and price>=e1.price) or "
+            "((not (e2[last].price is null)) and price>=e2[last].price)]+, "
+            "e3=Stream1[price<e2[last].price] "
+            "select e1.price as p1, e2[0].price as p2, e2[1].price as p3, e3.price as p4 "
+            "insert into OutputStream;"
+        )
+        got = run(manager, app, [
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 35.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["IBM", 47.6, 100]),
+        ])
+        assert len(got) == 1
+        d = got[0].data
+        assert d[0] == pytest.approx(29.6, abs=1e-4)
+        assert d[1] == pytest.approx(35.6, abs=1e-4)
+        assert d[2] == pytest.approx(57.6, abs=1e-4)
+        assert d[3] == pytest.approx(47.6, abs=1e-4)
+
+
+class TestAbsentPatterns:
+    """Expectations from query/pattern/absent/AbsentWithEveryPatternTestCase."""
+
+    def test_absent_fires_after_timeout(self, manager):
+        import time
+
+        app = S12 + (
+            "from every e1=Stream1[price>20] -> not Stream2[price>e1.price] for 100 millisec "
+            "select e1.symbol as s1 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        h1 = rt.get_input_handler("Stream1")
+        h1.send(["WSO2", 55.6, 100])
+        time.sleep(0.02)
+        h1.send(["GOOG", 55.6, 100])
+        time.sleep(0.4)
+        rt.shutdown()
+        assert sorted(e.data[0] for e in got) == ["GOOG", "WSO2"]
+
+    def test_absent_suppressed_by_event(self, manager):
+        import time
+
+        app = S12 + (
+            "from every e1=Stream1[price>20] -> not Stream2[price>e1.price] for 100 millisec "
+            "select e1.symbol as s1 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("Stream1").send(["WSO2", 55.6, 100])
+        rt.get_input_handler("Stream1").send(["GOOG", 55.6, 100])
+        rt.get_input_handler("Stream2").send(["IBM", 55.7, 100])  # kills both
+        time.sleep(0.4)
+        rt.shutdown()
+        assert got == []
+
+    def test_absent_then_more_states(self, manager):
+        import time
+
+        app = S12 + (
+            "define stream Stream3 (symbol string, price float, volume int); "
+            "from every e1=Stream1[price>20] -> not Stream2[price>e1.price] for 100 millisec "
+            "-> e3=Stream3[price>20] "
+            "select e1.symbol as s1, e3.symbol as s3 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("Stream1").send(["WSO2", 55.6, 100])
+        rt.get_input_handler("Stream1").send(["GOOG", 55.6, 100])
+        time.sleep(0.4)
+        rt.get_input_handler("Stream3").send(["IBM", 55.7, 100])
+        rt.shutdown()
+        assert sorted(e.data for e in got) == [["GOOG", "IBM"], ["WSO2", "IBM"]]
+
+    def test_leading_absent(self, manager):
+        import time
+
+        app = S12 + (
+            "from not Stream1[price>10] for 100 millisec -> every e2=Stream2[price>20] "
+            "select e2.symbol as s2 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        time.sleep(0.4)
+        rt.get_input_handler("Stream2").send(["WSO2", 55.6, 100])
+        rt.get_input_handler("Stream2").send(["GOOG", 55.6, 100])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == ["WSO2", "GOOG"]
+
+    def test_leading_absent_violated(self, manager):
+        import time
+
+        app = S12 + (
+            "from not Stream1[price>10] for 100 millisec -> every e2=Stream2[price>20] "
+            "select e2.symbol as s2 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("Stream1").send(["KILL", 55.6, 100])
+        time.sleep(0.4)
+        rt.get_input_handler("Stream2").send(["WSO2", 55.6, 100])
+        rt.shutdown()
+        assert got == []
+
+    def test_logical_and_not(self, manager):
+        # LogicalAbsentPatternTestCase-style: A and not B
+        import time
+
+        app = S12 + (
+            "from e1=Stream1[price>20] and not Stream2[price>20] for 100 millisec "
+            "select e1.symbol as s1 insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("Stream1").send(["WSO2", 55.6, 100])
+        time.sleep(0.4)
+        rt.shutdown()
+        assert [e.data[0] for e in got] == ["WSO2"]
