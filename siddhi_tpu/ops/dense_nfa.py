@@ -1,0 +1,603 @@
+"""Dense vectorized NFA — the TPU hot path.
+
+Replaces the reference's per-event pattern processing
+(StreamPreStateProcessor.processAndReturn:364 — O(pending × states) Java
+object walks under a ReentrantLock per event) with a bit-parallel,
+jit-compiled step over **micro-batches of events across partitions**:
+
+- per-partition NFA state lives in HBM as dense arrays:
+  ``active`` (uint32 bitmask, one bit per chain node), ``first_ts``
+  (within-window anchors), ``counts`` (Kleene counters), ``regs``
+  (captured attribute registers used by cross-state filters/selects);
+- one step gathers the state rows for the batch's partitions, unrolls
+  the node chain in reverse (so an event advances at most one node, the
+  staged-update semantics of the host engine), evaluates all node
+  filters vectorized, and scatters the state back;
+- cost is O(batch × states × regs) independent of the partition count —
+  1M+ partitions are just HBM rows;
+- multi-chip: the partition axis is sharded over a ``jax.sharding.Mesh``
+  (``shard()``); each shard owns its keys so the step needs no
+  cross-device collectives, and emitted matches ride an all-gather only
+  when the caller asks for global emission.
+
+Dense-mode semantics (documented subset of the host engine,
+ops/nfa.py — the planner falls back to the host engine otherwise):
+ - linear chains (stream + count nodes; logical and/or as one node),
+   no absent states, <= 32 nodes;
+ - at most one pending instance per (partition, node) — overlapping
+   `every` instances collapse to the newest arming;
+ - capture references limited to first (``ref.attr``/``ref[0]``) and
+   last (``ref[last]``) events of a count state;
+ - numeric attributes only (string keys are interned to partition ids
+   host-side before the step).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.ops.nfa import ANY, NFABuilder, Node, PatternScope, Spec
+from siddhi_tpu.planner.expr import (
+    CompiledExpression,
+    ExpressionCompiler,
+    N_KEY,
+    TS_KEY,
+)
+from siddhi_tpu.query_api import AttrType, StateInputStream, Variable
+from siddhi_tpu.query_api.definition import StreamDefinition
+
+
+@dataclass
+class RegSlot:
+    ref: str
+    attr: str
+    last: bool  # False: first captured event; True: last captured event
+    index: int
+
+
+class DenseScope(PatternScope):
+    """Filter/selector scope resolving captured refs to register slots."""
+
+    def __init__(self, ref_defs, stream_to_ref, cand_def, alloc: "RegAllocator"):
+        super().__init__(ref_defs, stream_to_ref, cand_def)
+        self.alloc = alloc
+
+    def resolve(self, var: Variable):
+        key, t = super().resolve(var)
+        if key.startswith("__cand."):
+            return key, t
+        # captured reference -> register slot key
+        ref, idx, attr, _t = self.used_captures[key]
+        if idx in (None, 0):
+            slot = self.alloc.slot(ref, attr, last=False)
+        elif idx == -1:
+            slot = self.alloc.slot(ref, attr, last=True)
+        else:
+            raise SiddhiAppCreationError(
+                f"dense NFA supports only first/[0]/[last] capture refs, got index {idx}"
+            )
+        return f"__reg.{slot.index}", t
+
+
+class RegAllocator:
+    def __init__(self):
+        self.slots: Dict[Tuple[str, str, bool], RegSlot] = {}
+
+    def slot(self, ref: str, attr: str, last: bool) -> RegSlot:
+        k = (ref, attr, last)
+        if k not in self.slots:
+            self.slots[k] = RegSlot(ref, attr, last, len(self.slots))
+        return self.slots[k]
+
+    @property
+    def n(self) -> int:
+        return len(self.slots)
+
+
+class DensePatternEngine:
+    """Compiles a lowered node chain into a jitted per-stream step.
+
+    Usage:
+        eng = DensePatternEngine(nodes, ref_defs, stream_to_ref,
+                                 within_ms, n_partitions, select_vars)
+        state = eng.init_state()
+        state, n_matches, out = eng.process(state, stream_key, part_idx,
+                                            cols, ts)
+    """
+
+    def __init__(
+        self,
+        nodes: List[Node],
+        ref_defs: Dict[str, StreamDefinition],
+        stream_to_ref: Dict[str, Optional[str]],
+        within_ms: Optional[int],
+        n_partitions: int,
+        select_vars: List[Variable],
+        select_names: Optional[List[str]] = None,
+        every_start: bool = True,
+        reset_on_emit: bool = True,
+        mesh=None,
+        partition_axis: str = "p",
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.jax, self.jnp = jax, jnp
+        self.nodes = nodes
+        self.ref_defs = ref_defs
+        self.within_ms = within_ms
+        self.n_partitions = n_partitions
+        self.every_start = every_start
+        self.reset_on_emit = reset_on_emit
+        self.mesh = mesh
+        self.partition_axis = partition_axis
+        self.S = len(nodes)
+        if self.S > 32:
+            raise SiddhiAppCreationError("dense NFA supports at most 32 chain nodes")
+        for n in nodes:
+            if n.kind == "absent" or any(s.is_absent for s in n.specs):
+                raise SiddhiAppCreationError("dense NFA does not support absent states")
+            if n.kind == "stream" and n.min_count == 0:
+                raise SiddhiAppCreationError(
+                    "dense NFA does not support optional (min 0) states yet; "
+                    "use the host engine"
+                )
+
+        self.alloc = RegAllocator()
+        self._compile_filters(stream_to_ref)
+        self._warn_integer_precision()
+        self._compile_outputs(select_vars, stream_to_ref, select_names)
+        # capture slots each node writes — computed after BOTH filter and
+        # output compilation so select-only slots get written too
+        self.node_writes: List[List[RegSlot]] = []
+        for node in self.nodes:
+            writes = []
+            for spec in node.specs:
+                for (ref, _attr, _last), slot in self.alloc.slots.items():
+                    if ref == spec.ref:
+                        writes.append(slot)
+            self.node_writes.append(writes)
+        self._step_cache: Dict[str, Callable] = {}
+
+    # -- compilation --------------------------------------------------------
+
+    def _warn_integer_precision(self):
+        import logging
+
+        for (ref, attr, _last) in self.alloc.slots:
+            d = self.ref_defs.get(ref)
+            if d is not None and attr in d.attribute_names and d.attribute_type(attr) in (
+                AttrType.LONG, AttrType.INT,
+            ):
+                logging.getLogger("siddhi_tpu").warning(
+                    "dense NFA stores capture '%s.%s' (%s) in float32 registers; "
+                    "values above 2^24 lose precision — prefer partitioning on "
+                    "identifier attributes instead of filtering on them",
+                    ref, attr, d.attribute_type(attr).value,
+                )
+
+    def _compile_filters(self, stream_to_ref):
+        """Per-node filters compiled against candidate columns + registers."""
+        self.node_filters: List[List[Optional[CompiledExpression]]] = []
+        for node in self.nodes:
+            fs = []
+            for spec in node.specs:
+                if spec.filter_compiled is None:
+                    fs.append(None)
+                    continue
+                # recompile the raw filter against the dense scope
+                scope = DenseScope(self.ref_defs, stream_to_ref, spec.stream_def, self.alloc)
+                compiler = ExpressionCompiler(scope)
+                fs.append(compiler.compile(spec.raw_filter))
+            self.node_filters.append(fs)
+
+    def _compile_outputs(self, select_vars: List[Variable], stream_to_ref, select_names=None):
+        """Selector variables -> (slot index | candidate attr) extractors.
+
+        Output names use the query's `as` aliases when provided."""
+        self.out_spec: List[Tuple[str, object]] = []  # (name, slot|('cand', attr))
+        last_node = self.nodes[-1]
+        last_refs = {s.ref for s in last_node.specs}
+        for vi, var in enumerate(select_vars):
+            ref = var.stream_id
+            if ref not in self.ref_defs and ref in stream_to_ref:
+                ref = stream_to_ref[ref]
+            if ref is None or ref not in self.ref_defs:
+                raise SiddhiAppCreationError(f"cannot resolve select ref '{var.stream_id}'")
+            idx = var.stream_index
+            name = (
+                select_names[vi]
+                if select_names and vi < len(select_names)
+                else f"{ref}.{var.attribute}"
+            )
+            if ref in last_refs and last_node.kind == "stream" and last_node.max_count == 1:
+                # final event: values come from the candidate columns
+                self.out_spec.append((name, ("cand", var.attribute)))
+                continue
+            last = idx == -1
+            if idx not in (None, 0, -1):
+                raise SiddhiAppCreationError(
+                    f"dense NFA supports only first/[0]/[last] select refs, got {idx}"
+                )
+            slot = self.alloc.slot(ref, var.attribute, last)
+            self.out_spec.append((name, slot))
+
+    # -- state --------------------------------------------------------------
+
+    def init_state(self):
+        jnp = self.jnp
+        # one scratch row (index P) absorbs padded/invalid batch rows so
+        # their scatter-back cannot collide with a real partition
+        P, S, R = self.n_partitions + 1, self.S, max(self.alloc.n, 1)
+        active0 = jnp.zeros(P, dtype=jnp.uint32)
+        if not self.every_start:
+            # non-every: node 0 armed once per partition; after a match
+            # reset_on_emit clears it and the partition's automaton is done
+            active0 = active0 | jnp.uint32(1)
+        state = {
+            "active": active0,
+            # relative ms since self.base_ts (int32: ~24 days of horizon),
+            # 0 == unset
+            "first_ts": jnp.zeros((P, S), dtype=jnp.int32),
+            "counts": jnp.zeros((P, S), dtype=jnp.int32),
+            "regs": jnp.zeros((P, S, R), dtype=jnp.float32),
+        }
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+            shardings = {
+                "active": NamedSharding(self.mesh, Pspec(self.partition_axis)),
+                "first_ts": NamedSharding(self.mesh, Pspec(self.partition_axis, None)),
+                "counts": NamedSharding(self.mesh, Pspec(self.partition_axis, None)),
+                "regs": NamedSharding(self.mesh, Pspec(self.partition_axis, None, None)),
+            }
+            state = {k: self.jax.device_put(v, shardings[k]) for k, v in state.items()}
+        return state
+
+    # -- step ---------------------------------------------------------------
+
+    def make_step(self, stream_key: str, jit: bool = True) -> Callable:
+        """Build the step for events of one source stream.
+
+        step(state, part_idx[B] i32, cols {attr: [B] f32}, ts[B] i32
+             relative-ms, valid[B] bool) -> (state, emit[B], out_vals[B, n_out])
+
+        ``jit=False`` returns the raw traceable function (for embedding in
+        shard_map / outer jit).
+        """
+        cache_key = (stream_key, jit)
+        if cache_key in self._step_cache:
+            return self._step_cache[cache_key]
+        jnp = self.jnp
+        S = self.S
+        nodes = self.nodes
+        node_filters = self.node_filters
+        within = self.within_ms
+        every_start = self.every_start
+        reset_on_emit = self.reset_on_emit
+        R = max(self.alloc.n, 1)
+        out_spec = self.out_spec
+
+        def env_for(node_idx, cols, ts, regs_b, spec_idx=0):
+            env = {}
+            spec = nodes[node_idx].specs[spec_idx]
+            for a in spec.stream_def.attribute_names:
+                if a in cols:
+                    env["__cand." + a] = cols[a]
+            for slot in self.alloc.slots.values():
+                env[f"__reg.{slot.index}"] = regs_b[:, node_idx, slot.index]
+            env[TS_KEY] = ts
+            env[N_KEY] = ts.shape[0]
+            return env
+
+        def step(state, part_idx, cols, ts, valid):
+            active_all = state["active"]
+            B = part_idx.shape[0]
+            a = active_all[part_idx]  # [B] uint32
+            first = state["first_ts"][part_idx]  # [B, S]
+            counts = state["counts"][part_idx]  # [B, S]
+            regs = state["regs"][part_idx]  # [B, S, R]
+            emit = jnp.zeros(B, dtype=bool)
+            out_vals = jnp.zeros((B, max(len(out_spec), 1)), dtype=jnp.float32)
+
+            # within-window expiry: clear expired instances (active bits,
+            # in-progress counts and logical side masks alike)
+            if within is not None:
+                expired = (first > 0) & (ts[:, None] - first > within)  # [B,S]
+                for s in range(S):
+                    a = jnp.where(expired[:, s], a & ~jnp.uint32(1 << s), a)
+                counts = jnp.where(expired, 0, counts)
+                first = jnp.where(expired, 0, first)
+
+            for s in reversed(range(S)):
+                node = nodes[s]
+                spec = node.specs[0]
+                if node.kind == "logical":
+                    sides = [i for i, sp in enumerate(node.specs) if sp.stream_key == stream_key]
+                    if not sides:
+                        continue
+                    pending = ((a >> s) & 1).astype(bool)
+                    if s == 0 and every_start:
+                        pending = jnp.ones_like(pending)
+                    for si in sides:
+                        f = node_filters[s][si]
+                        ok = (
+                            jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool)
+                            if f is not None
+                            else jnp.ones_like(pending)
+                        )
+                        fire = pending & ok & valid
+                        # record side in counts bitfield
+                        counts = counts.at[:, s].set(
+                            jnp.where(fire, counts[:, s] | (1 << si), counts[:, s])
+                        )
+                        # capture this side's slots
+                        for slot in self.node_writes[s]:
+                            if slot.ref == node.specs[si].ref and slot.attr in cols:
+                                regs = regs.at[:, s, slot.index].set(
+                                    jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
+                                )
+                        first = first.at[:, s].set(
+                            jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
+                        )
+                    need = (
+                        (counts[:, s] & ((1 << len(node.specs)) - 1))
+                        if node.logical_op == "and"
+                        else counts[:, s]
+                    )
+                    complete = (
+                        (need == (1 << len(node.specs)) - 1)
+                        if node.logical_op == "and"
+                        else (need > 0)
+                    ) & pending & valid
+                    a, first, counts, regs, emit, out_vals = _advance(
+                        s, complete, a, first, counts, regs, emit, out_vals, cols, ts
+                    )
+                    continue
+                if spec.stream_key != stream_key:
+                    continue
+                pending = ((a >> s) & 1).astype(bool)
+                if s == 0 and every_start:
+                    pending = jnp.ones_like(pending)
+                f = node_filters[s][0]
+                ok = (
+                    jnp.asarray(f.fn(env_for(s, cols, ts, regs))).astype(bool)
+                    if f is not None
+                    else jnp.ones(B, dtype=bool)
+                )
+                fire = pending & ok & valid
+                is_count = not (node.min_count == 1 and node.max_count == 1)
+                if is_count:
+                    below_max = (node.max_count == ANY) | (counts[:, s] < node.max_count)
+                    cap = fire & below_max
+                    first_cap = cap & (counts[:, s] == 0)
+                    counts = counts.at[:, s].set(jnp.where(cap, counts[:, s] + 1, counts[:, s]))
+                    for slot in self.node_writes[s]:
+                        if slot.ref != spec.ref or slot.attr not in cols:
+                            continue
+                        upd = cap if slot.last else first_cap
+                        regs = regs.at[:, s, slot.index].set(
+                            jnp.where(upd, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
+                        )
+                    first = first.at[:, s].set(
+                        jnp.where(first_cap & (first[:, s] == 0), ts, first[:, s])
+                    )
+                    advance = cap & (counts[:, s] == max(node.min_count, 1))
+                    a, first, counts, regs, emit, out_vals = _advance(
+                        s, advance, a, first, counts, regs, emit, out_vals, cols, ts
+                    )
+                else:
+                    # capture the node's slots where firing
+                    for slot in self.node_writes[s]:
+                        if slot.ref != spec.ref or slot.attr not in cols:
+                            continue
+                        regs = regs.at[:, s, slot.index].set(
+                            jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
+                        )
+                    first = first.at[:, s].set(
+                        jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
+                    )
+                    if not (s == 0 and every_start):
+                        a = jnp.where(fire, a & ~jnp.uint32(1 << s), a)
+                    a, first, counts, regs, emit, out_vals = _advance(
+                        s, fire, a, first, counts, regs, emit, out_vals, cols, ts
+                    )
+
+            # emission restart
+            if reset_on_emit:
+                a = jnp.where(emit, jnp.uint32(0), a)
+                counts = jnp.where(emit[:, None], 0, counts)
+                first = jnp.where(emit[:, None], 0, first)
+
+            # scatter back (valid rows only)
+            state = {
+                "active": state["active"].at[part_idx].set(
+                    jnp.where(valid, a, state["active"][part_idx])
+                ),
+                "first_ts": state["first_ts"].at[part_idx].set(
+                    jnp.where(valid[:, None], first, state["first_ts"][part_idx])
+                ),
+                "counts": state["counts"].at[part_idx].set(
+                    jnp.where(valid[:, None], counts, state["counts"][part_idx])
+                ),
+                "regs": state["regs"].at[part_idx].set(
+                    jnp.where(valid[:, None, None], regs, state["regs"][part_idx])
+                ),
+            }
+            return state, emit, out_vals
+
+        def _advance(s, mask, a, first, counts, regs, emit, out_vals, cols, ts):
+            """Completing node s: set next bit (copy instance rows) or emit.
+
+            An occupied successor blocks the advance (oldest instance wins;
+            the host engine tracks overlapping instances instead — this is
+            the documented dense-mode approximation)."""
+            if s == S - 1:
+                emit = emit | mask
+                for oi, (_name, src) in enumerate(out_spec):
+                    if isinstance(src, tuple):  # ('cand', attr)
+                        val = cols.get(src[1])
+                        if val is None:
+                            continue
+                        out_vals = out_vals.at[:, oi].set(
+                            jnp.where(mask, val.astype(jnp.float32), out_vals[:, oi])
+                        )
+                    else:
+                        out_vals = out_vals.at[:, oi].set(
+                            jnp.where(mask, regs[:, s, src.index], out_vals[:, oi])
+                        )
+                return a, first, counts, regs, emit, out_vals
+            occupied = (((a >> (s + 1)) & 1) > 0) | (counts[:, s + 1] > 0)
+            mask = mask & ~occupied
+            a = jnp.where(mask, a | jnp.uint32(1 << (s + 1)), a)
+            regs = regs.at[:, s + 1, :].set(
+                jnp.where(mask[:, None], regs[:, s, :], regs[:, s + 1, :])
+            )
+            first = first.at[:, s + 1].set(jnp.where(mask, jnp.where(first[:, s] > 0, first[:, s], ts), first[:, s + 1]))
+            counts = counts.at[:, s + 1].set(jnp.where(mask, 0, counts[:, s + 1]))
+            return a, first, counts, regs, emit, out_vals
+
+        fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
+        self._step_cache[cache_key] = fn
+        return fn
+
+    # -- host wrapper -------------------------------------------------------
+
+    base_ts: Optional[int] = None
+
+    def _rel_ts(self, ts: np.ndarray) -> np.ndarray:
+        if self.base_ts is None:
+            self.base_ts = int(ts[0]) - 1 if len(ts) else 0
+        return (ts - self.base_ts).astype(np.int32)
+
+    def process(self, state, stream_key: str, part_idx: np.ndarray, cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Process a batch, splitting rounds so each partition appears at
+        most once per step (scatter collisions would race).  Rounds are
+        padded to powers of two to bound jit recompilation."""
+        jnp = self.jnp
+        step = self.make_step(stream_key)
+        rel = self._rel_ts(np.asarray(ts, dtype=np.int64))
+        n = len(part_idx)
+        emit_all = np.zeros(n, dtype=bool)
+        out_all = np.zeros((n, max(len(self.out_spec), 1)), dtype=np.float32)
+        for ridx in _collision_rounds(part_idx):
+            b = len(ridx)
+            bp = max(1 << (b - 1).bit_length(), 16)  # pad to pow2, min 16
+            pad = bp - b
+            pi = np.full(bp, self.n_partitions, dtype=np.int32)  # scratch row
+            pi[:b] = part_idx[ridx]
+            tb = np.zeros(bp, dtype=np.int32)
+            tb[:b] = rel[ridx]
+            valid = np.zeros(bp, dtype=bool)
+            valid[:b] = True
+            cb = {}
+            for k, v in cols.items():
+                col = np.zeros(bp, dtype=np.float32)
+                col[:b] = v[ridx].astype(np.float32)
+                cb[k] = jnp.asarray(col)
+            state, emit, out_vals = step(
+                state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
+            )
+            emit_all[ridx] = np.asarray(emit)[:b]
+            out_all[ridx] = np.asarray(out_vals)[:b]
+        return state, emit_all, out_all
+
+    @property
+    def output_names(self) -> List[str]:
+        return [name for name, _ in self.out_spec]
+
+
+def _collision_rounds(part_idx: np.ndarray) -> List[np.ndarray]:
+    """Split indices into rounds where each partition appears at most once,
+    preserving per-partition order."""
+    order = np.argsort(part_idx, kind="stable")
+    sorted_parts = part_idx[order]
+    # occurrence number of each element within its partition group
+    is_new = np.ones(len(part_idx), dtype=bool)
+    is_new[1:] = sorted_parts[1:] != sorted_parts[:-1]
+    group_start = np.maximum.accumulate(np.where(is_new, np.arange(len(part_idx)), 0))
+    occ = np.arange(len(part_idx)) - group_start
+    occ_orig = np.empty(len(part_idx), dtype=np.int64)
+    occ_orig[order] = occ
+    n_rounds = int(occ.max()) + 1 if len(occ) else 0
+    return [np.flatnonzero(occ_orig == r) for r in range(n_rounds)]
+
+
+# ---------------------------------------------------------------------------
+# High-level compile API
+# ---------------------------------------------------------------------------
+
+
+def compile_pattern(
+    app_str: str,
+    query_name: Optional[str] = None,
+    n_partitions: int = 1024,
+    mesh=None,
+    every_start: Optional[bool] = None,
+):
+    """Compile a SiddhiQL pattern query into a DensePatternEngine.
+
+    The partition axis is the implicit per-key replication of the query
+    (the reference's `partition with (key of Stream)` over pattern
+    queries); callers route events to partition ids (interned keys).
+    """
+    from siddhi_tpu.compiler import SiddhiCompiler
+    from siddhi_tpu.query_api.annotation import find_annotation
+
+    app = SiddhiCompiler.parse(app_str)
+    query = None
+    for i, q in enumerate(app.queries):
+        info = find_annotation(q.annotations, "info")
+        nm = (info.element("name") if info else None) or f"query_{i}"
+        if query_name is None or nm == query_name:
+            query = q
+            break
+    if query is None:
+        raise SiddhiAppCreationError(f"query '{query_name}' not found")
+    st = query.input_stream
+    if not isinstance(st, StateInputStream):
+        raise SiddhiAppCreationError("compile_pattern needs a pattern query")
+    if st.type == StateInputStream.SEQUENCE:
+        raise SiddhiAppCreationError(
+            "dense NFA does not implement strict sequence continuity yet; "
+            "use the host engine for ','-sequences"
+        )
+
+    def resolve(s):
+        d = app.stream_definitions.get(s.stream_id)
+        if d is None:
+            raise SiddhiAppCreationError(f"stream '{s.stream_id}' is not defined")
+        return d
+
+    builder = NFABuilder(st, resolve)
+    nodes = builder.build()
+    if every_start is None:
+        every_start = any(n.rearm_to is not None for n in nodes)
+
+    select_vars = []
+    select_names = []
+    if query.selector.selection:
+        for oa in query.selector.selection:
+            if not isinstance(oa.expression, Variable) or oa.expression.stream_id is None:
+                raise SiddhiAppCreationError(
+                    "dense NFA select items must be event references (e1.attr)"
+                )
+            select_vars.append(oa.expression)
+            select_names.append(oa.name)
+
+    return DensePatternEngine(
+        nodes=nodes,
+        ref_defs=builder.ref_defs,
+        stream_to_ref=builder.stream_to_ref,
+        within_ms=st.within_ms,
+        n_partitions=n_partitions,
+        select_vars=select_vars,
+        select_names=select_names,
+        every_start=every_start,
+        mesh=mesh,
+    )

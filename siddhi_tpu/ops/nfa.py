@@ -87,6 +87,9 @@ class Spec:
     filter_presence_keys: Dict[str, Tuple[str, int]] = field(default_factory=dict)
     is_absent: bool = False
     waiting_ms: Optional[int] = None
+    # un-compiled filter expression (re-compiled by the dense engine
+    # against register slots)
+    raw_filter: object = None
 
 
 @dataclass
@@ -341,6 +344,7 @@ class NFABuilder:
                 expr = AndOp(expr, f)
             scope = PatternScope(self.ref_defs, self.stream_to_ref, cand_def=d)
             compiler = ExpressionCompiler(scope)
+            spec.raw_filter = expr
             spec.filter_compiled = compiler.compile(expr)
             spec.filter_capture_keys = {
                 k: (r, i, a) for k, (r, i, a, _t) in scope.used_captures.items()
