@@ -63,7 +63,7 @@ class EventBatch:
     types: int8[n] of CURRENT/EXPIRED/TIMER/RESET
     """
 
-    __slots__ = ("stream_id", "attribute_names", "columns", "timestamps", "types")
+    __slots__ = ("stream_id", "attribute_names", "columns", "timestamps", "types", "aux")
 
     def __init__(
         self,
@@ -81,6 +81,9 @@ class EventBatch:
         if types is None:
             types = np.zeros(n, dtype=np.int8)
         self.types = np.asarray(types, dtype=np.int8)
+        # side-channel metadata (e.g. group keys) — row-aligned lists/arrays;
+        # NOT propagated by mask/take/concat unless the producer re-attaches
+        self.aux: Dict[str, object] = {}
 
     def __len__(self) -> int:
         return len(self.timestamps)

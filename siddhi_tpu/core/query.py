@@ -245,14 +245,20 @@ class QuerySelector:
             run.timestamps,
             run.types,
         )
-        # batched group-by: last row per group only
+        out.aux["group_keys"] = list(keys)
+        # batched processing (reference ProcessingMode.BATCH): with group-by
+        # emit the last row per group; with aggregators but no group-by emit
+        # only the final row of the flush
         keep_idx = None
-        if self.batch_mode and self.group_keys:
+        if self.batch_mode and (self.group_keys or self.aggregations):
             last_idx: Dict = {}
             for i, k in enumerate(keys):
                 last_idx[k] = i
             keep_idx = np.asarray(sorted(last_idx.values()))
+            gk = out.aux.get("group_keys")
             out = out.take(keep_idx)
+            if gk is not None:
+                out.aux["group_keys"] = [gk[i] for i in keep_idx]
         if self.having is not None:
             # input columns + aggregate keys first; select outputs override
             # so an alias shadowing an input attribute sees the output value
@@ -262,7 +268,10 @@ class QuerySelector:
             }
             henv.update(build_env(out))
             mask = np.broadcast_to(np.asarray(self.having.fn(henv)), (len(out),))
+            gk = out.aux.get("group_keys")
             out = out.mask(mask)
+            if gk is not None:
+                out.aux["group_keys"] = [k for k, m in zip(gk, mask) if m]
         return out
 
     def _order_limit(self, out: EventBatch) -> EventBatch:
@@ -302,9 +311,172 @@ class OutputRateLimiter:
     def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
         return batch
 
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return None
+
+    def next_wakeup(self) -> Optional[int]:
+        return None
+
+    def snapshot(self) -> Dict:
+        return {}
+
+    def restore(self, state: Dict):
+        pass
+
 
 class PassThroughRateLimiter(OutputRateLimiter):
     pass
+
+
+class EventRateLimiter(OutputRateLimiter):
+    """`output <all|first|last> every N events` (reference:
+    ratelimit/event/*PerEventOutputRateLimiter)."""
+
+    def __init__(self, n: int, mode: str):
+        self.n = n
+        self.mode = mode  # all | first | last
+        self._count = 0
+        self._held: List[EventBatch] = []
+
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        outs: List[EventBatch] = []
+        for i in range(len(batch)):
+            row = batch.take(np.asarray([i]))
+            pos = self._count % self.n
+            self._count += 1
+            if self.mode == "first":
+                if pos == 0:
+                    outs.append(row)
+            elif self.mode == "last":
+                if pos == self.n - 1:
+                    outs.append(row)
+            else:  # all: release held chunk every n events
+                self._held.append(row)
+                if pos == self.n - 1:
+                    outs.extend(self._held)
+                    self._held = []
+        return EventBatch.concat(outs) if outs else None
+
+    def snapshot(self):
+        return {"count": self._count, "held": self._held}
+
+    def restore(self, state):
+        self._count, self._held = state["count"], state["held"]
+
+
+class TimeRateLimiter(OutputRateLimiter):
+    """`output <all|first|last> every <t>` (reference:
+    ratelimit/time/*TimeOutputRateLimiter)."""
+
+    def __init__(self, ms: int, mode: str):
+        self.ms = ms
+        self.mode = mode
+        self._held: List[EventBatch] = []
+        self._first_sent = False
+        self._last: Optional[EventBatch] = None
+        self._window_end: Optional[int] = None
+
+    def _roll(self, now: int):
+        if self._window_end is None:
+            self._window_end = now + self.ms
+
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        self._roll(now)
+        out = self.on_time(now)
+        res: List[EventBatch] = [out] if out is not None else []
+        if self.mode == "first":
+            if not self._first_sent and len(batch):
+                self._first_sent = True
+                res.append(batch.take(np.asarray([0])))
+        elif self.mode == "last":
+            if len(batch):
+                self._last = batch.take(np.asarray([len(batch) - 1]))
+        else:
+            self._held.append(batch)
+        return EventBatch.concat(res) if res else None
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        if self._window_end is None or now < self._window_end:
+            return None
+        outs: List[EventBatch] = []
+        while now >= self._window_end:
+            if self.mode == "all" and self._held:
+                outs.extend(self._held)
+                self._held = []
+            elif self.mode == "last" and self._last is not None:
+                outs.append(self._last)
+                self._last = None
+            self._first_sent = False
+            self._window_end += self.ms
+        return EventBatch.concat(outs) if outs else None
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._window_end
+
+    def snapshot(self):
+        return {
+            "held": self._held, "first_sent": self._first_sent,
+            "last": self._last, "end": self._window_end,
+        }
+
+    def restore(self, state):
+        self._held = state["held"]
+        self._first_sent = state["first_sent"]
+        self._last = state["last"]
+        self._window_end = state["end"]
+
+
+class SnapshotRateLimiter(OutputRateLimiter):
+    """`output snapshot every <t>`: periodically re-emits the latest
+    output per group key (reference: ratelimit/snapshot/
+    WrappedSnapshotOutputRateLimiter, simplified to last-value
+    snapshots)."""
+
+    def __init__(self, ms: int, group_names: Optional[List[str]] = None):
+        self.ms = ms
+        self.group_names = group_names or []
+        self._latest: Dict = {}
+        self._window_end: Optional[int] = None
+
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        if self._window_end is None:
+            self._window_end = now + self.ms
+        cur = batch.only(ev.CURRENT)
+        group_keys = batch.aux.get("group_keys")
+        if group_keys is not None and len(group_keys) == len(batch):
+            # align to the CURRENT subset
+            cur_mask = np.isin(batch.types, (ev.CURRENT,))
+            group_keys = [k for k, m in zip(group_keys, cur_mask) if m]
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            if group_keys is not None:
+                key = group_keys[i]
+            elif self.group_names:
+                key = tuple(
+                    row.columns[g][0] if g in row.columns else None for g in self.group_names
+                )
+            else:
+                key = None
+            self._latest[key] = row
+        return self.on_time(now)
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        if self._window_end is None or now < self._window_end:
+            return None
+        outs: List[EventBatch] = []
+        while now >= self._window_end:
+            outs = list(self._latest.values())  # latest snapshot only
+            self._window_end += self.ms
+        return EventBatch.concat(outs) if outs else None
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._window_end
+
+    def snapshot(self):
+        return {"latest": self._latest, "end": self._window_end}
+
+    def restore(self, state):
+        self._latest, self._window_end = state["latest"], state["end"]
 
 
 # ---------------------------------------------------------------------------

@@ -198,3 +198,648 @@ class LengthBatchWindow(WindowProcessor):
     def restore(self, state):
         self._pending = state["pending"]
         self._last_flushed = state["last"]
+
+
+@extension("window", "time")
+class TimeWindow(WindowProcessor):
+    """Sliding time window (reference: TimeWindowProcessor): each event
+    expires ``t`` ms after arrival; evictions fire on scheduler ticks."""
+
+    needs_scheduler = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.time_ms = self._const_int(args[0], "time window duration")
+        self._buf: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        expired = self._expire(now)
+        if len(cur):
+            self._buf = EventBatch.concat([self._buf, cur])
+        parts = [b for b in (expired, cur) if b is not None and len(b)]
+        return EventBatch.concat(parts) if parts else _empty_like(cur)
+
+    def _expire(self, now: int) -> Optional[EventBatch]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        dead = self._buf.timestamps + self.time_ms <= now
+        if not dead.any():
+            return None
+        expired = self._buf.mask(dead).with_types(ev.EXPIRED)
+        expired.timestamps = np.full(len(expired), now, dtype=np.int64)
+        self._buf = self._buf.mask(~dead)
+        return expired
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._expire(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        return int(self._buf.timestamps.min()) + self.time_ms
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buf
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "timeBatch")
+class TimeBatchWindow(WindowProcessor):
+    """Tumbling time window (reference: TimeBatchWindowProcessor): collects
+    events per period, flushes CURRENT at each boundary and expires the
+    previous flush."""
+
+    needs_scheduler = True
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.time_ms = self._const_int(args[0], "timeBatch window duration")
+        self._pending: Optional[EventBatch] = None
+        self._last_flushed: Optional[EventBatch] = None
+        self._window_end: Optional[int] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._pending is None:
+            self._pending = _empty_like(cur)
+        if self._window_end is None and len(cur):
+            self._window_end = int(cur.timestamps[0]) + self.time_ms
+        out = self._maybe_flush(now)
+        if len(cur):
+            self._pending = EventBatch.concat([self._pending, cur])
+            if self._window_end is None:
+                # flush above went idle; this arrival starts a new period
+                self._window_end = int(cur.timestamps[0]) + self.time_ms
+        return out if out is not None else _empty_like(cur)
+
+    def _maybe_flush(self, now: int) -> Optional[EventBatch]:
+        if self._window_end is None or now < self._window_end:
+            return None
+        outs: List[EventBatch] = []
+        while self._window_end is not None and now >= self._window_end:
+            flush = self._pending
+            self._pending = _empty_like(flush)
+            if self._last_flushed is not None and len(self._last_flushed):
+                exp = self._last_flushed.with_types(ev.EXPIRED)
+                exp.timestamps = np.full(len(exp), self._window_end, dtype=np.int64)
+                outs.append(exp)
+            if len(flush) or (self._last_flushed is not None and len(self._last_flushed)):
+                outs.append(reset_marker(flush, self._window_end))
+            if len(flush):
+                outs.append(flush)
+            self._last_flushed = flush
+            if len(self._pending) == 0 and len(flush) == 0:
+                self._window_end = None  # go idle until next event
+            else:
+                self._window_end += self.time_ms
+        return EventBatch.concat(outs) if outs else None
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._maybe_flush(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._window_end
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._pending
+
+    def snapshot(self):
+        return {"pending": self._pending, "last": self._last_flushed, "end": self._window_end}
+
+    def restore(self, state):
+        self._pending, self._last_flushed, self._window_end = (
+            state["pending"], state["last"], state["end"]
+        )
+
+
+@extension("window", "externalTime")
+class ExternalTimeWindow(WindowProcessor):
+    """Sliding window over an event-time attribute (reference:
+    ExternalTimeWindowProcessor) — expiry driven purely by arriving
+    events' timestamps, no scheduler."""
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        # args: (timestamp variable, duration)
+        self.ts_expr = args[0]
+        self.time_ms = self._const_int(args[1], "externalTime duration")
+        # buffer of (1-row EventBatch, external ts), insertion-ordered;
+        # external timestamps are monotone in practice, so expiry pops the
+        # front — O(evictions) per batch, no full-buffer copies
+        from collections import deque
+
+        self._buf = deque()
+
+    def _event_ts(self, batch: EventBatch) -> np.ndarray:
+        from siddhi_tpu.core.query import build_env
+
+        return np.broadcast_to(
+            np.asarray(self.ts_expr.fn(build_env(batch))), (len(batch),)
+        ).astype(np.int64)
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        outs: List[EventBatch] = []
+        ets = self._event_ts(cur) if len(cur) else np.empty(0, dtype=np.int64)
+        for i in range(len(cur)):
+            t_i = int(ets[i])
+            cutoff = t_i - self.time_ms
+            while self._buf and self._buf[0][1] <= cutoff:
+                row, _ = self._buf.popleft()
+                exp = row.with_types(ev.EXPIRED)
+                exp.timestamps = np.full(len(exp), t_i, dtype=np.int64)
+                outs.append(exp)
+            row = cur.take(np.asarray([i]))
+            outs.append(row)
+            self._buf.append((row, t_i))
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def buffered(self) -> Optional[EventBatch]:
+        if not self._buf:
+            return None
+        return EventBatch.concat([r for r, _ in self._buf])
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "externalTimeBatch")
+class ExternalTimeBatchWindow(WindowProcessor):
+    """Tumbling window over an event-time attribute (reference:
+    ExternalTimeBatchWindowProcessor)."""
+
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.ts_expr = args[0]
+        self.time_ms = self._const_int(args[1], "externalTimeBatch duration")
+        self.start_ts = self._const_int(args[2], "start time") if len(args) > 2 else None
+        self._pending: Optional[EventBatch] = None
+        self._last_flushed: Optional[EventBatch] = None
+        self._window_end: Optional[int] = None
+
+    def _event_ts(self, batch: EventBatch) -> np.ndarray:
+        from siddhi_tpu.core.query import build_env
+
+        return np.broadcast_to(
+            np.asarray(self.ts_expr.fn(build_env(batch))), (len(batch),)
+        ).astype(np.int64)
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._pending is None:
+            self._pending = _empty_like(cur)
+        outs: List[EventBatch] = []
+        ets = self._event_ts(cur) if len(cur) else np.empty(0, dtype=np.int64)
+        for i in range(len(cur)):
+            t_i = int(ets[i])
+            if self._window_end is None:
+                base = self.start_ts if self.start_ts is not None else t_i
+                self._window_end = base + self.time_ms
+            while t_i >= self._window_end:
+                flush = self._pending
+                self._pending = _empty_like(flush)
+                if self._last_flushed is not None and len(self._last_flushed):
+                    exp = self._last_flushed.with_types(ev.EXPIRED)
+                    exp.timestamps = np.full(len(exp), self._window_end, dtype=np.int64)
+                    outs.append(exp)
+                if len(flush):
+                    outs.append(reset_marker(flush, self._window_end))
+                    outs.append(flush)
+                # empty windows also replace the last flush, so an old batch
+                # cannot be re-expired on every empty period
+                self._last_flushed = flush
+                self._window_end += self.time_ms
+            row = cur.take(np.asarray([i]))
+            self._pending = EventBatch.concat([self._pending, row])
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._pending
+
+    def snapshot(self):
+        return {"pending": self._pending, "last": self._last_flushed, "end": self._window_end}
+
+    def restore(self, state):
+        self._pending, self._last_flushed, self._window_end = (
+            state["pending"], state["last"], state["end"]
+        )
+
+
+@extension("window", "timeLength")
+class TimeLengthWindow(WindowProcessor):
+    """Sliding window bounded by both time and count (reference:
+    TimeLengthWindowProcessor)."""
+
+    needs_scheduler = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.time_ms = self._const_int(args[0], "timeLength duration")
+        self.length = self._const_int(args[1], "timeLength size")
+        self._buf: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        outs: List[EventBatch] = []
+        exp = self._expire_time(now)
+        if exp is not None and len(exp):
+            outs.append(exp)
+        for i in range(len(cur)):
+            if len(self._buf) >= self.length:
+                evict = self._buf.take(np.asarray([0])).with_types(ev.EXPIRED)
+                evict.timestamps = np.full(1, now, dtype=np.int64)
+                outs.append(evict)
+                self._buf = self._buf.take(np.arange(1, len(self._buf)))
+            row = cur.take(np.asarray([i]))
+            outs.append(row)
+            self._buf = EventBatch.concat([self._buf, row])
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def _expire_time(self, now: int) -> Optional[EventBatch]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        dead = self._buf.timestamps + self.time_ms <= now
+        if not dead.any():
+            return None
+        expired = self._buf.mask(dead).with_types(ev.EXPIRED)
+        expired.timestamps = np.full(len(expired), now, dtype=np.int64)
+        self._buf = self._buf.mask(~dead)
+        return expired
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._expire_time(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        return int(self._buf.timestamps.min()) + self.time_ms
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buf
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "delay")
+class DelayWindow(WindowProcessor):
+    """Holds events for ``t`` ms, then releases them as CURRENT
+    (reference: DelayWindowProcessor)."""
+
+    needs_scheduler = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.time_ms = self._const_int(args[0], "delay duration")
+        self._buf: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        out = self._release(now)
+        if len(cur):
+            self._buf = EventBatch.concat([self._buf, cur])
+        return out if out is not None else _empty_like(cur)
+
+    def _release(self, now: int) -> Optional[EventBatch]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        due = self._buf.timestamps + self.time_ms <= now
+        if not due.any():
+            return None
+        released = self._buf.mask(due)  # stays CURRENT
+        self._buf = self._buf.mask(~due)
+        return released
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._release(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        if self._buf is None or len(self._buf) == 0:
+            return None
+        return int(self._buf.timestamps.min()) + self.time_ms
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "sort")
+class SortWindow(WindowProcessor):
+    """Keeps the N smallest/largest events by sort keys (reference:
+    SortWindowProcessor): when over capacity, evicts the greatest (asc)
+    or smallest (desc) as EXPIRED."""
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.length = self._const_int(args[0], "sort window size")
+        # remaining args: key expressions with optional 'asc'/'desc' consts
+        self.keys: List[Tuple[object, bool]] = []
+        i = 1
+        while i < len(args):
+            expr = args[i]
+            asc = True
+            if i + 1 < len(args):
+                try:
+                    nxt = args[i + 1].fn({})
+                    if isinstance(nxt, str) and nxt.lower() in ("asc", "desc"):
+                        asc = nxt.lower() == "asc"
+                        i += 1
+                except Exception:
+                    pass
+            self.keys.append((expr, asc))
+            i += 1
+        self._buf: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        from siddhi_tpu.core.query import build_env
+
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        outs: List[EventBatch] = []
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            outs.append(row)
+            self._buf = EventBatch.concat([self._buf, row])
+            if len(self._buf) > self.length:
+                order = self._sorted_order()
+                evict_pos = order[-1]
+                evict = self._buf.take(np.asarray([evict_pos])).with_types(ev.EXPIRED)
+                evict.timestamps = np.full(1, now, dtype=np.int64)
+                outs.append(evict)
+                keep = np.ones(len(self._buf), dtype=bool)
+                keep[evict_pos] = False
+                self._buf = self._buf.mask(keep)
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def _sorted_order(self) -> np.ndarray:
+        from siddhi_tpu.core.query import build_env
+
+        env = build_env(self._buf)
+        idx = np.arange(len(self._buf))
+        for expr, asc in reversed(self.keys):
+            col = np.broadcast_to(np.asarray(expr.fn(env)), (len(self._buf),))
+            _, dense = np.unique(col[idx], return_inverse=True)
+            order = np.argsort(dense if asc else -dense, kind="stable")
+            idx = idx[order]
+        return idx
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buf
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "frequent")
+class FrequentWindow(WindowProcessor):
+    """Misra-Gries frequent-event window (reference:
+    FrequentWindowProcessor): keeps events whose key is among the N
+    highest-frequency keys; evicted keys' events expire."""
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.n = self._const_int(args[0], "frequent count")
+        self.key_exprs = list(args[1:])  # empty: whole-row key
+        self.attribute_names = attribute_names
+        self._counts: Dict = {}
+        self._rows: Dict = {}  # key -> latest row (1-row EventBatch)
+
+    def _key_of(self, row: EventBatch):
+        from siddhi_tpu.core.query import build_env
+
+        def unbox(v):
+            return v.item() if isinstance(v, np.generic) else v
+
+        if self.key_exprs:
+            env = build_env(row)
+            return tuple(
+                unbox(np.asarray(e.fn(env)).reshape(-1)[0]) for e in self.key_exprs
+            )
+        return tuple(unbox(row.columns[a][0]) for a in row.attribute_names)
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        outs: List[EventBatch] = []
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            key = self._key_of(row)
+            if key in self._counts:
+                self._counts[key] += 1
+                self._rows[key] = row
+                outs.append(row)
+            elif len(self._counts) < self.n:
+                self._counts[key] = 1
+                self._rows[key] = row
+                outs.append(row)
+            else:
+                # decrement all; evict zeros (Misra-Gries)
+                for k in list(self._counts):
+                    self._counts[k] -= 1
+                    if self._counts[k] == 0:
+                        del self._counts[k]
+                        evict = self._rows.pop(k).with_types(ev.EXPIRED)
+                        evict.timestamps = np.full(1, now, dtype=np.int64)
+                        outs.append(evict)
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def snapshot(self):
+        return {"counts": self._counts, "rows": self._rows}
+
+    def restore(self, state):
+        self._counts, self._rows = state["counts"], state["rows"]
+
+
+@extension("window", "lossyFrequent")
+class LossyFrequentWindow(WindowProcessor):
+    """Lossy-counting frequent window (reference:
+    LossyFrequentWindowProcessor(support, [error], keys...))."""
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.support = float(args[0].fn({}))
+        i = 1
+        self.error = self.support / 10.0
+        if len(args) > 1:
+            try:
+                v = args[1].fn({})
+                if isinstance(v, (float, np.floating)):
+                    self.error = float(v)
+                    i = 2
+            except Exception:
+                pass
+        self.key_exprs = list(args[i:])
+        self.attribute_names = attribute_names
+        self._counts: Dict = {}
+        self._deltas: Dict = {}
+        self._rows: Dict = {}
+        self._total = 0
+
+    _key_of = FrequentWindow._key_of
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        outs: List[EventBatch] = []
+        for i in range(len(cur)):
+            self._total += 1
+            bucket = int(np.ceil(self._total * self.error))
+            row = cur.take(np.asarray([i]))
+            key = self._key_of(row)
+            if key in self._counts:
+                self._counts[key] += 1
+            else:
+                self._counts[key] = 1
+                self._deltas[key] = bucket - 1
+            self._rows[key] = row
+            # emit current if above support threshold
+            if self._counts[key] >= (self.support - self.error) * self._total:
+                outs.append(row)
+            # periodic pruning
+            for k in list(self._counts):
+                if self._counts[k] + self._deltas[k] <= bucket:
+                    del self._counts[k]
+                    self._deltas.pop(k, None)
+                    evict = self._rows.pop(k).with_types(ev.EXPIRED)
+                    evict.timestamps = np.full(1, now, dtype=np.int64)
+                    outs.append(evict)
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def snapshot(self):
+        return {
+            "counts": self._counts, "deltas": self._deltas,
+            "rows": self._rows, "total": self._total,
+        }
+
+    def restore(self, state):
+        self._counts = state["counts"]
+        self._deltas = state["deltas"]
+        self._rows = state["rows"]
+        self._total = state["total"]
+
+
+@extension("window", "batch")
+class BatchWindow(WindowProcessor):
+    """Chunk-per-arrival window (reference: BatchWindowProcessor): each
+    arriving chunk expires the previous chunk."""
+
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self._last: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if len(cur) == 0:
+            return cur
+        outs: List[EventBatch] = []
+        if self._last is not None and len(self._last):
+            exp = self._last.with_types(ev.EXPIRED)
+            exp.timestamps = np.full(len(exp), now, dtype=np.int64)
+            outs.append(exp)
+        outs.append(reset_marker(cur, now))
+        outs.append(cur)
+        self._last = cur
+        return EventBatch.concat(outs)
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._last
+
+    def snapshot(self):
+        return {"last": self._last}
+
+    def restore(self, state):
+        self._last = state["last"]
+
+
+@extension("window", "session")
+class SessionWindow(WindowProcessor):
+    """Session window with gap timeout (reference:
+    SessionWindowProcessor(gap, [key])): events buffer per session key;
+    a session closes when no event arrives for ``gap`` ms, expiring its
+    events."""
+
+    needs_scheduler = True
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        self.gap_ms = self._const_int(args[0], "session gap")
+        self.key_expr = args[1] if len(args) > 1 else None
+        self._sessions: Dict = {}  # key -> (EventBatch, last_ts)
+
+    def _keys(self, batch: EventBatch) -> List:
+        from siddhi_tpu.core.query import build_env
+
+        if self.key_expr is None:
+            return [None] * len(batch)
+        col = np.broadcast_to(
+            np.asarray(self.key_expr.fn(build_env(batch))), (len(batch),)
+        )
+        return [v.item() if isinstance(v, np.generic) else v for v in col]
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        outs: List[EventBatch] = []
+        exp = self._close_due(now)
+        if exp is not None:
+            outs.append(exp)
+        keys = self._keys(cur)
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            k = keys[i]
+            buf, _ = self._sessions.get(k, (None, 0))
+            buf = row if buf is None else EventBatch.concat([buf, row])
+            self._sessions[k] = (buf, int(row.timestamps[0]))
+            outs.append(row)
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def _close_due(self, now: int) -> Optional[EventBatch]:
+        closed: List[EventBatch] = []
+        for k, (buf, last_ts) in list(self._sessions.items()):
+            if last_ts + self.gap_ms <= now:
+                exp = buf.with_types(ev.EXPIRED)
+                exp.timestamps = np.full(len(exp), now, dtype=np.int64)
+                closed.append(exp)
+                del self._sessions[k]
+        return EventBatch.concat(closed) if closed else None
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._close_due(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        if not self._sessions:
+            return None
+        return min(last + self.gap_ms for _, last in self._sessions.values())
+
+    def snapshot(self):
+        return {"sessions": self._sessions}
+
+    def restore(self, state):
+        self._sessions = state["sessions"]

@@ -16,6 +16,7 @@ from siddhi_tpu.core.exceptions import (
 )
 from siddhi_tpu.core.query import (
     AggBinding,
+    EventRateLimiter,
     FilterProcessor,
     InsertIntoStreamCallback,
     PassThroughRateLimiter,
@@ -24,6 +25,8 @@ from siddhi_tpu.core.query import (
     QueryRuntime,
     QuerySelector,
     SelectItem,
+    SnapshotRateLimiter,
+    TimeRateLimiter,
     WindowChainProcessor,
 )
 from siddhi_tpu.ops.aggregators import make_aggregator
@@ -62,6 +65,22 @@ from siddhi_tpu.query_api import (
 from siddhi_tpu.query_api.annotation import find_annotation
 
 _query_counter = itertools.count()
+
+
+class _RateLimiterTask:
+    """Scheduler task flushing time-based rate limiters."""
+
+    def __init__(self, qr, limiter):
+        self.qr = qr
+        self.limiter = limiter
+
+    def next_wakeup(self):
+        return self.limiter.next_wakeup()
+
+    def fire(self, now: int):
+        out = self.limiter.on_time(now)
+        if out is not None and len(out):
+            self.qr.output.send(out, now)
 
 
 class _PatternStreamReceiver:
@@ -169,8 +188,10 @@ class QueryPlanner:
             query.selector, scope, compiler, name, query, batch_mode=False
         )
         output = self._plan_output(query, out_def)
-        rate_limiter = PassThroughRateLimiter()
+        rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+            self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
 
         # presence keys used anywhere in the selector expressions
         presence = {}
@@ -226,15 +247,36 @@ class QueryPlanner:
             query.selector, scope, compiler, name, query, batch_mode
         )
         output = self._plan_output(query, out_def)
-        rate_limiter = PassThroughRateLimiter()
+        rate_limiter = self._plan_rate_limiter(query)
 
         qr = QueryRuntime(name, [chain], selector, rate_limiter, output, self.app.app_context)
         for w in windows:
             if w.needs_scheduler:
                 self.app.scheduler.register_window(qr, w)
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+            self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
         junction = self.app.junction_for_input(s)
         junction.subscribe(ProcessStreamReceiver(qr))
         return qr
+
+    def _plan_rate_limiter(self, query: Query):
+        from siddhi_tpu.query_api import (
+            EventOutputRate,
+            SnapshotOutputRate,
+            TimeOutputRate,
+        )
+
+        r = query.output_rate
+        if r is None:
+            return PassThroughRateLimiter()
+        if isinstance(r, EventOutputRate):
+            return EventRateLimiter(r.events, r.type)
+        if isinstance(r, TimeOutputRate):
+            return TimeRateLimiter(r.value_ms, r.type)
+        if isinstance(r, SnapshotOutputRate):
+            group_names = [g.attribute for g in query.selector.group_by]
+            return SnapshotRateLimiter(r.value_ms, group_names)
+        raise SiddhiAppCreationError(f"unsupported output rate {r}")
 
     def _plan_handlers(self, s: SingleInputStream, definition, compiler):
         chain = []

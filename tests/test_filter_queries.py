@@ -246,8 +246,8 @@ class TestLengthWindows:
             "from S#window.lengthBatch(2) select sum(v) as total insert into OutputStream;"
         )
         got = run_app(manager, app, "S", [[1], [2], [3], [4]])
-        # batches: [1,2] -> totals 1,3 ; reset ; [3,4] -> totals 3,7
-        assert [e.data[0] for e in got] == [1, 3, 3, 7]
+        # batch mode: one aggregate per flush (reference batched selector)
+        assert [e.data[0] for e in got] == [3, 7]
 
     def test_query_callback_remove_events(self, manager):
         app = (

@@ -1,0 +1,321 @@
+"""Window conformance tests (reference: query/window/*TestCase.java).
+
+Time-driven windows run in playback mode (@app:playback) so event
+timestamps drive the clock deterministically.
+"""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run_pb(manager, app, sends, out="OutputStream"):
+    """Playback-mode run; sends = [(row, ts)]."""
+    rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row, ts in sends:
+        h.send(row, timestamp=ts)
+    rt.shutdown()
+    return got
+
+
+class TestTimeWindow:
+    APP = (
+        "define stream S (symbol string, v long); "
+        "from S#window.time(1 sec) select symbol, sum(v) as total "
+        "insert all events into OutputStream;"
+    )
+
+    def test_sliding_time_sum(self, manager):
+        got = run_pb(manager, self.APP, [
+            (["A", 10], 1000),
+            (["B", 20], 1500),
+            (["C", 30], 2100),  # A (ts 1000) expired at 2000 <= 2100
+        ])
+        # outputs: A(10), B(30), expired-A(20), C(50)
+        totals = [e.data[1] for e in got]
+        assert totals == [10, 30, 20, 50]
+
+    def test_wall_clock_expiry(self, manager):
+        app = (
+            "define stream S (v long); "
+            "@info(name='q') from S#window.time(100 millisec) select v "
+            "insert expired events into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("S").send([7])
+        time.sleep(0.4)  # background scheduler tick fires expiry
+        rt.shutdown()
+        assert [e.data for e in got] == [[7]]
+
+
+class TestTimeBatchWindow:
+    def test_tumbling_flush(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.timeBatch(1 sec) select sum(v) as total "
+            "insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 1400),
+            ([3], 2000),  # flush [1,2] at 2000, start new window
+            ([4], 2500),
+            ([5], 3100),  # flush [3,4]
+        ])
+        assert [e.data[0] for e in got] == [3, 7]
+
+    def test_group_by_batch_mode(self, manager):
+        app = (
+            "define stream S (sym string, v long); "
+            "from S#window.timeBatch(1 sec) select sym, sum(v) as t group by sym "
+            "insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 1], 1000),
+            (["B", 10], 1200),
+            (["A", 2], 1400),
+            (["X", 0], 2100),  # triggers flush of window 1
+            (["Y", 0], 3200),  # flush window 2 (X)
+        ])
+        first = {tuple(e.data) for e in got[:2]}
+        assert first == {("A", 3), ("B", 10)}
+
+
+class TestExternalTime:
+    def test_external_time_sliding(self, manager):
+        app = (
+            "define stream S (ts long, v long); "
+            "from S#window.externalTime(ts, 1 sec) select sum(v) as total "
+            "insert all events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1000, 10], 1),
+            ([1500, 20], 2),
+            ([2100, 30], 3),  # expires ts=1000 row
+        ])
+        totals = [e.data[0] for e in got]
+        assert totals == [10, 30, 20, 50]
+
+    def test_external_time_batch(self, manager):
+        app = (
+            "define stream S (ts long, v long); "
+            "from S#window.externalTimeBatch(ts, 1 sec) select sum(v) as total "
+            "insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1000, 1], 1),
+            ([1400, 2], 2),
+            ([2000, 3], 3),
+            ([2500, 4], 4),
+            ([3100, 5], 5),
+        ])
+        assert [e.data[0] for e in got] == [3, 7]
+
+
+class TestSortWindow:
+    def test_sort_keeps_smallest(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.sort(2, v) select v insert expired events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([50], 1000),
+            ([20], 1100),
+            ([40], 1200),  # evicts 50 (largest)
+            ([10], 1300),  # evicts 40
+        ])
+        assert [e.data[0] for e in got] == [50, 40]
+
+    def test_sort_desc(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.sort(2, v, 'desc') select v insert expired events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([50], 1000),
+            ([20], 1100),
+            ([40], 1200),  # desc keeps largest: evicts 20
+        ])
+        assert [e.data[0] for e in got] == [20]
+
+
+class TestDelayWindow:
+    def test_delay_releases_later(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.delay(1 sec) select v insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 1500),
+            ([3], 2100),  # releases v=1 (due at 2000)
+            ([4], 2600),  # releases v=2
+        ])
+        assert [e.data[0] for e in got] == [1, 2]
+
+
+class TestTimeLengthWindow:
+    def test_length_bound(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.timeLength(10 sec, 2) select v "
+            "insert expired events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 1100),
+            ([3], 1200),  # length 2 exceeded -> expire v=1
+        ])
+        assert [e.data[0] for e in got] == [1]
+
+    def test_time_bound(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.timeLength(1 sec, 10) select v "
+            "insert expired events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 2100),  # v=1 expired by time
+        ])
+        assert [e.data[0] for e in got] == [1]
+
+
+class TestFrequentWindows:
+    def test_frequent(self, manager):
+        app = (
+            "define stream S (sym string, v long); "
+            "from S#window.frequent(1, sym) select sym, v insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 1], 1000),
+            (["A", 2], 1100),
+            (["B", 3], 1200),  # decrements A, no emit for B
+            (["A", 4], 1300),
+        ])
+        assert [e.data[0] for e in got] == ["A", "A", "A"]
+
+    def test_batch_window(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.batch() select sum(v) as t insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        from siddhi_tpu.core.event import Event
+
+        h = rt.get_input_handler("S")
+        h.send([Event(1000, [1]), Event(1000, [2])])  # one chunk
+        h.send([Event(1100, [5]), Event(1100, [6])])  # next chunk expires prev
+        rt.shutdown()
+        # batch mode: only the final aggregate per chunk
+        assert [e.data[0] for e in got] == [3, 11]
+
+
+class TestSessionWindow:
+    def test_session_close_by_gap(self, manager):
+        app = (
+            "define stream S (user string, v long); "
+            "from S#window.session(1 sec, user) select user, v "
+            "insert expired events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["u1", 1], 1000),
+            (["u1", 2], 1500),
+            (["u2", 9], 1800),
+            (["u1", 3], 3000),  # u1 session (last 1500) closed at 2500; u2 (1800) closed at 2800
+        ])
+        datas = [tuple(e.data) for e in got]
+        assert ("u1", 1) in datas and ("u1", 2) in datas and ("u2", 9) in datas
+
+
+class TestOutputRateLimiting:
+    def test_first_every_n_events(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select v output first every 3 events insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(7):
+            h.send([i])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [0, 3, 6]
+
+    def test_last_every_n_events(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select v output last every 3 events insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(6):
+            h.send([i])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [2, 5]
+
+    def test_all_every_n_events(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select v output all every 2 events insert into OutputStream;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        chunks = []
+        rt.add_callback("OutputStream", lambda evs: chunks.append([e.data[0] for e in evs]))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(5):
+            h.send([i])
+        rt.shutdown()
+        assert chunks == [[0, 1], [2, 3]]
+
+    def test_time_rate_last(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select v output last every 1 sec insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 1400),
+            ([3], 2100),  # period [1000,2000) flushes last=2
+        ])
+        assert [e.data[0] for e in got] == [2]
+
+    def test_snapshot_rate(self, manager):
+        app = (
+            "define stream S (sym string, v long); "
+            "from S select sym, sum(v) as t group by sym "
+            "output snapshot every 1 sec insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 1], 1000),
+            (["B", 5], 1200),
+            (["A", 2], 1500),
+            (["X", 0], 2100),  # snapshot of latest per group
+        ])
+        datas = {tuple(e.data) for e in got}
+        assert ("A", 3) in datas and ("B", 5) in datas
