@@ -33,6 +33,7 @@ class SiddhiAppRuntime:
         query_runtimes: Dict[str, object],
         input_manager: InputManager,
         scheduler,
+        tables: Optional[Dict[str, object]] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -41,6 +42,7 @@ class SiddhiAppRuntime:
         self.query_runtimes = query_runtimes
         self.input_manager = input_manager
         self.scheduler = scheduler
+        self.tables = tables or {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
 

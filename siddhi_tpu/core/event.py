@@ -92,24 +92,35 @@ class EventBatch:
     def size(self) -> int:
         return len(self.timestamps)
 
+    def _carry_group_keys(self, out: "EventBatch", sel) -> "EventBatch":
+        gk = self.aux.get("group_keys")
+        if gk is not None and len(gk) == len(self):
+            if isinstance(sel, np.ndarray) and sel.dtype == bool:
+                out.aux["group_keys"] = [k for k, m in zip(gk, sel) if m]
+            else:
+                out.aux["group_keys"] = [gk[int(i)] for i in sel]
+        return out
+
     def mask(self, m: np.ndarray) -> "EventBatch":
         """Select rows where boolean mask is True."""
-        return EventBatch(
+        out = EventBatch(
             self.stream_id,
             self.attribute_names,
             {k: v[m] for k, v in self.columns.items()},
             self.timestamps[m],
             self.types[m],
         )
+        return self._carry_group_keys(out, m)
 
     def take(self, idx: np.ndarray) -> "EventBatch":
-        return EventBatch(
+        out = EventBatch(
             self.stream_id,
             self.attribute_names,
             {k: v[idx] for k, v in self.columns.items()},
             self.timestamps[idx],
             self.types[idx],
         )
+        return self._carry_group_keys(out, idx)
 
     def with_types(self, t: int) -> "EventBatch":
         return EventBatch(
@@ -141,7 +152,7 @@ class EventBatch:
         if len(batches) == 1:
             return batches[0]
         b0 = batches[0]
-        return EventBatch(
+        out = EventBatch(
             b0.stream_id,
             b0.attribute_names,
             {
@@ -151,6 +162,12 @@ class EventBatch:
             np.concatenate([b.timestamps for b in batches]),
             np.concatenate([b.types for b in batches]),
         )
+        if all(
+            b.aux.get("group_keys") is not None and len(b.aux["group_keys"]) == len(b)
+            for b in batches
+        ):
+            out.aux["group_keys"] = [k for b in batches for k in b.aux["group_keys"]]
+        return out
 
     def __repr__(self):
         return f"EventBatch({self.stream_id}, n={len(self)})"

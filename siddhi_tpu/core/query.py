@@ -255,10 +255,7 @@ class QuerySelector:
             for i, k in enumerate(keys):
                 last_idx[k] = i
             keep_idx = np.asarray(sorted(last_idx.values()))
-            gk = out.aux.get("group_keys")
             out = out.take(keep_idx)
-            if gk is not None:
-                out.aux["group_keys"] = [gk[i] for i in keep_idx]
         if self.having is not None:
             # input columns + aggregate keys first; select outputs override
             # so an alias shadowing an input attribute sees the output value
@@ -268,10 +265,7 @@ class QuerySelector:
             }
             henv.update(build_env(out))
             mask = np.broadcast_to(np.asarray(self.having.fn(henv)), (len(out),))
-            gk = out.aux.get("group_keys")
             out = out.mask(mask)
-            if gk is not None:
-                out.aux["group_keys"] = [k for k, m in zip(gk, mask) if m]
         return out
 
     def _order_limit(self, out: EventBatch) -> EventBatch:
@@ -339,23 +333,27 @@ class EventRateLimiter(OutputRateLimiter):
         self._held: List[EventBatch] = []
 
     def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
-        outs: List[EventBatch] = []
-        for i in range(len(batch)):
-            row = batch.take(np.asarray([i]))
-            pos = self._count % self.n
-            self._count += 1
-            if self.mode == "first":
-                if pos == 0:
-                    outs.append(row)
-            elif self.mode == "last":
-                if pos == self.n - 1:
-                    outs.append(row)
-            else:  # all: release held chunk every n events
-                self._held.append(row)
-                if pos == self.n - 1:
-                    outs.extend(self._held)
-                    self._held = []
-        return EventBatch.concat(outs) if outs else None
+        n = len(batch)
+        if n == 0:
+            return None
+        if self.mode in ("first", "last"):
+            pos = (self._count + np.arange(n)) % self.n
+            self._count += n
+            target = 0 if self.mode == "first" else self.n - 1
+            out = batch.mask(pos == target)
+            return out if len(out) else None
+        # all: hold rows, release complete groups of n
+        self._count += n
+        self._held.append(batch)
+        total = sum(len(b) for b in self._held)
+        k = (total // self.n) * self.n
+        if k == 0:
+            return None
+        merged = EventBatch.concat(self._held)
+        out = merged.take(np.arange(k))
+        rest = merged.take(np.arange(k, total))
+        self._held = [rest] if len(rest) else []
+        return out
 
     def snapshot(self):
         return {"count": self._count, "held": self._held}

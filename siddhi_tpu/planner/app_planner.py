@@ -52,6 +52,7 @@ class AppPlanner:
         self.junctions: Dict[str, StreamJunction] = {}
         self.definitions: Dict[str, StreamDefinition] = {}
         self.query_runtimes: Dict[str, object] = {}
+        self.tables: Dict[str, object] = {}  # name -> InMemoryTable
 
     # -- junction / definition registry -------------------------------------
 
@@ -126,7 +127,11 @@ class AppPlanner:
         return self.junctions[key]
 
     def table_resolver(self, table_name: str):
-        raise SiddhiAppCreationError(f"tables not supported yet ('IN {table_name}')")
+        """Membership-test provider for `expr IN Table` conditions."""
+        table = self.tables.get(table_name)
+        if table is None:
+            raise SiddhiAppCreationError(f"'IN {table_name}': table is not defined")
+        return table.contains_fn()
 
     # -- build --------------------------------------------------------------
 
@@ -136,6 +141,11 @@ class AppPlanner:
 
         for d in self.siddhi_app.stream_definitions.values():
             self.define_stream(d)
+
+        from siddhi_tpu.table import InMemoryTable
+
+        for td in self.siddhi_app.table_definitions.values():
+            self.tables[td.id] = InMemoryTable(td)
 
         qp = QueryPlanner(self)
         qi = 0
@@ -162,4 +172,5 @@ class AppPlanner:
             query_runtimes=self.query_runtimes,
             input_manager=input_manager,
             scheduler=self.scheduler,
+            tables=self.tables,
         )

@@ -86,6 +86,17 @@ class Scope:
         select aliases referencable from having/order-by)."""
         self._bare[name] = (name, attr_type)
 
+    def add_bare_key(self, name: str, key: str, attr_type: AttrType):
+        """Register an unqualified name bound to an explicit env key."""
+        self._bare[name] = (key, attr_type)
+
+    def clone(self) -> "Scope":
+        s = Scope()
+        s._bare = dict(self._bare)
+        s._qualified = dict(self._qualified)
+        s.stream_refs = set(self.stream_refs)
+        return s
+
     def add_alias(self, alias: str, stream_ref: str):
         """Make `alias.attr` resolve like `stream_ref.attr`."""
         self.stream_refs.add(alias)

@@ -160,13 +160,121 @@ class QueryPlanner:
         in_stream = query.input_stream
         if isinstance(in_stream, SingleInputStream):
             return self._plan_single(query, name, in_stream)
-        from siddhi_tpu.query_api import StateInputStream
+        from siddhi_tpu.query_api import JoinInputStream, StateInputStream
 
         if isinstance(in_stream, StateInputStream):
             return self._plan_state(query, name, in_stream)
+        if isinstance(in_stream, JoinInputStream):
+            return self._plan_join(query, name, in_stream)
         raise SiddhiAppCreationError(
             f"query '{name}': input type {type(in_stream).__name__} not supported yet"
         )
+
+    # -- join ----------------------------------------------------------------
+
+    def _plan_join(self, query: Query, name: str, j) -> QueryRuntime:
+        from siddhi_tpu.core.join import JoinRuntime, JoinSide, JoinStreamReceiver
+        from siddhi_tpu.query_api import JoinInputStream
+
+        sides = []
+        batch_mode = False
+        for s in (j.left, j.right):
+            if not isinstance(s, SingleInputStream):
+                raise SiddhiAppCreationError(
+                    f"query '{name}': join side {type(s).__name__} not supported"
+                )
+            table = self.app.tables.get(s.stream_id)
+            ref = s.alias or s.stream_id
+            if table is not None:
+                if s.handlers:
+                    raise SiddhiAppCreationError(
+                        f"query '{name}': table '{s.stream_id}' cannot take "
+                        "filters/windows in a join"
+                    )
+                sides.append(
+                    JoinSide(ref, table.definition, [], None, table=table, triggers=False)
+                )
+                continue
+            definition = self.app.resolve_stream_definition(s)
+            # side-local scope: handler expressions see bare side attrs
+            side_scope = scope_for_definition(definition, ref)
+            side_compiler = ExpressionCompiler(side_scope, table_resolver=self.app.table_resolver)
+            chain, b_mode, windows = self._plan_handlers(s, definition, side_compiler)
+            batch_mode = batch_mode or b_mode
+            window = None
+            filters = []
+            for p in chain:
+                if isinstance(p, WindowChainProcessor):
+                    if window is not None:
+                        raise SiddhiAppCreationError(
+                            f"query '{name}': one window per join side"
+                        )
+                    window = p.window
+                else:
+                    filters.append(p)
+            sides.append(JoinSide(ref, definition, filters, window))
+        left, right = sides
+        if left.ref == right.ref:
+            raise SiddhiAppCreationError(
+                f"query '{name}': join sides need distinct names/aliases"
+            )
+        if left.table is not None and right.table is not None:
+            raise SiddhiAppCreationError(
+                f"query '{name}': cannot join two tables in a stream query"
+            )
+
+        # unidirectional trigger
+        if j.trigger == "left":
+            right.triggers = False
+        elif j.trigger == "right":
+            left.triggers = False
+
+        # an outer join can only preserve a side that triggers — otherwise
+        # unmatched rows of that side would silently never be emitted
+        preserve_left = j.join_type in (JoinInputStream.LEFT_OUTER, JoinInputStream.FULL_OUTER)
+        preserve_right = j.join_type in (JoinInputStream.RIGHT_OUTER, JoinInputStream.FULL_OUTER)
+        if (preserve_left and not left.triggers) or (preserve_right and not right.triggers):
+            raise SiddhiAppCreationError(
+                f"query '{name}': outer join preserves a side that never "
+                "triggers (table side or disabled by 'unidirectional')"
+            )
+
+        # join scope: qualified by ref (and by raw stream id when unambiguous)
+        scope = Scope()
+        for side, src in ((left, j.left), (right, j.right)):
+            for a in side.definition.attributes:
+                scope.add(side.ref, a.name, side.qualified_key(a.name), a.type)
+            if src.stream_id != side.ref:
+                scope.add_alias(src.stream_id, side.ref)
+        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+        condition = compiler.compile(j.on_condition) if j.on_condition is not None else None
+        if condition is not None and condition.type != AttrType.BOOL:
+            raise SiddhiAppCreationError(f"query '{name}': 'on' condition must be boolean")
+
+        selector, out_def = self._plan_selector(
+            query.selector, scope, compiler, name, query, batch_mode,
+            star_sources=[left, right],
+        )
+        output = self._plan_output(query, out_def)
+        rate_limiter = self._plan_rate_limiter(query)
+        qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+            self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
+
+        jr = JoinRuntime(
+            left, right, j.join_type, condition,
+            emit=lambda batch, now: qr.process(batch, 0),
+            out_stream_id=f"#join_{name}",
+        )
+        qr.join_runtime = jr
+        if any(s.window is not None and getattr(s.window, "needs_scheduler", False) for s in sides):
+            self.app.scheduler.register_task(jr)
+        for side, src, is_left in ((left, j.left, True), (right, j.right, False)):
+            if side.table is not None:
+                continue
+            junction = self.app.junction_for_input(src)
+            junction.subscribe(JoinStreamReceiver(jr, is_left, self.app.app_context))
+        return qr
 
     # -- pattern / sequence --------------------------------------------------
 
@@ -318,13 +426,32 @@ class QueryPlanner:
         qname: str,
         query: Query,
         batch_mode: bool,
+        star_sources=None,
     ) -> Tuple[QuerySelector, StreamDefinition]:
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{qname}"
         rewriter = AggregatorRewrite(scope, compiler)
 
         items: Optional[List[SelectItem]] = None
         out_attrs: List[Attribute] = []
-        if sel.is_select_all:
+        if sel.is_select_all and star_sources is not None:
+            # join 'select *': all attrs of both sides, plain names
+            items = []
+            for side in star_sources:
+                for a in side.definition.attributes:
+                    if any(o.name == a.name for o in out_attrs):
+                        raise SiddhiAppCreationError(
+                            f"query '{qname}': 'select *' is ambiguous — "
+                            f"attribute '{a.name}' exists on both join sides"
+                        )
+                    compiled = compiler.compile(
+                        Variable(stream_id=side.ref, attribute=a.name)
+                    )
+                    items.append(SelectItem(a.name, compiled))
+                    out_attrs.append(Attribute(a.name, a.type))
+            out_names = [i.name for i in items]
+            for a in out_attrs:
+                scope.add_bare(a.name, a.type)
+        elif sel.is_select_all:
             # select * — passthrough of the input definition
             if not isinstance(query.input_stream, SingleInputStream):
                 raise SiddhiAppCreationError(
@@ -395,12 +522,56 @@ class QueryPlanner:
     # -- output -------------------------------------------------------------
 
     def _plan_output(self, query: Query, out_def: StreamDefinition):
+        from siddhi_tpu.query_api import DeleteStream, UpdateOrInsertStream, UpdateStream
+        from siddhi_tpu.table import (
+            CompiledTableCondition,
+            DeleteTableCallback,
+            InsertIntoTableCallback,
+            UpdateOrInsertTableCallback,
+            UpdateTableCallback,
+            compile_set_clause,
+        )
+
         out = query.output_stream
         if isinstance(out, InsertIntoStream):
+            table = self.app.tables.get(out.target)
+            if table is not None and not out.is_inner and not out.is_fault:
+                return InsertIntoTableCallback(
+                    table, out.event_type, [a.name for a in out_def.attributes]
+                )
             junction = self.app.get_or_create_junction(
                 out.target, out_def, is_inner=out.is_inner, is_fault=out.is_fault
             )
             return InsertIntoStreamCallback(junction, out.event_type)
+        if isinstance(out, (DeleteStream, UpdateStream, UpdateOrInsertStream)):
+            table = self.app.tables.get(out.target)
+            if table is None:
+                raise SiddhiAppCreationError(
+                    f"'{out.target}' is not a defined table (delete/update "
+                    "targets must be tables)"
+                )
+            # condition + set expressions see the query's *output* attrs
+            out_scope = Scope()
+            for a in out_def.attributes:
+                out_scope.add_bare(a.name, a.type)
+            condition = CompiledTableCondition(
+                table, out.on_condition, out_scope, table_resolver=self.app.table_resolver
+            )
+            if isinstance(out, DeleteStream):
+                return DeleteTableCallback(table, condition, out.event_type)
+            set_ops = compile_set_clause(
+                table,
+                out.set_clause,
+                out_scope,
+                [a.name for a in out_def.attributes],
+                table_resolver=self.app.table_resolver,
+            )
+            if isinstance(out, UpdateOrInsertStream):
+                return UpdateOrInsertTableCallback(
+                    table, condition, set_ops, out.event_type,
+                    [a.name for a in out_def.attributes],
+                )
+            return UpdateTableCallback(table, condition, set_ops, out.event_type)
         if isinstance(out, ReturnStream) or out is None:
             return QueryCallbackOutput()
         raise SiddhiAppCreationError(

@@ -1,0 +1,18 @@
+from siddhi_tpu.table.table import CompiledTableCondition, InMemoryTable
+from siddhi_tpu.table.callbacks import (
+    DeleteTableCallback,
+    InsertIntoTableCallback,
+    UpdateOrInsertTableCallback,
+    UpdateTableCallback,
+    compile_set_clause,
+)
+
+__all__ = [
+    "CompiledTableCondition",
+    "InMemoryTable",
+    "DeleteTableCallback",
+    "InsertIntoTableCallback",
+    "UpdateOrInsertTableCallback",
+    "UpdateTableCallback",
+    "compile_set_clause",
+]

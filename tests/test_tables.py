@@ -1,0 +1,208 @@
+"""Table conformance tests.
+
+Modeled on the reference table test corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/table/
+InsertIntoTableTestCase / DeleteFromTableTestCase / UpdateFromTableTestCase
+/ UpdateOrInsertTableTestCase / IndexedTableTestCase): SiddhiQL string in,
+events in, asserted table contents / query outputs out.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def table_rows(runtime, name):
+    t = runtime.tables[name]
+    b = t.rows_batch()
+    return sorted(
+        tuple(b.columns[nm][i] for nm in b.attribute_names) for i in range(len(b))
+    )
+
+
+def test_insert_into_table(manager):
+    app = (
+        "define stream StockStream (symbol string, price float, volume long); "
+        "define table StockTable (symbol string, price float, volume long); "
+        "from StockStream insert into StockTable;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 10])
+    assert table_rows(rt, "StockTable") == [("IBM", 75.6, 10), ("WSO2", 55.6, 100)]
+
+
+def test_insert_with_projection(manager):
+    app = (
+        "define stream S (symbol string, price float, volume long); "
+        "define table T (symbol string, volume long); "
+        "from S select symbol, volume insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    rt.get_input_handler("S").send(["WSO2", 55.6, 100])
+    assert table_rows(rt, "T") == [("WSO2", 100)]
+
+
+def test_delete_on_condition(manager):
+    app = (
+        "define stream StockStream (symbol string, price float, volume long); "
+        "define stream DeleteStockStream (symbol string); "
+        "define table StockTable (symbol string, price float, volume long); "
+        "from StockStream insert into StockTable; "
+        "from DeleteStockStream delete StockTable on StockTable.symbol == symbol;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("DeleteStockStream").send(["IBM"])
+    assert table_rows(rt, "StockTable") == [("WSO2", 55.6, 100)]
+
+
+def test_update_on_condition(manager):
+    app = (
+        "define stream StockStream (symbol string, price float, volume long); "
+        "define stream UpdateStream (symbol string, price float); "
+        "define table StockTable (symbol string, price float, volume long); "
+        "from StockStream insert into StockTable; "
+        "from UpdateStream update StockTable set StockTable.price = price "
+        "on StockTable.symbol == symbol;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("UpdateStream").send(["IBM", 99.0])
+    assert table_rows(rt, "StockTable") == [("IBM", 99.0, 10), ("WSO2", 55.6, 100)]
+
+
+def test_update_without_set_copies_matching_attrs(manager):
+    app = (
+        "define stream StockStream (symbol string, price float, volume long); "
+        "define stream UpdateStream (symbol string, price float, volume long); "
+        "define table StockTable (symbol string, price float, volume long); "
+        "from StockStream insert into StockTable; "
+        "from UpdateStream update StockTable on StockTable.symbol == symbol;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("UpdateStream").send(["WSO2", 77.7, 200])
+    assert table_rows(rt, "StockTable") == [("WSO2", 77.7, 200)]
+
+
+def test_update_or_insert(manager):
+    app = (
+        "define stream UpsertStream (symbol string, price float, volume long); "
+        "define table StockTable (symbol string, price float, volume long); "
+        "from UpsertStream update or insert into StockTable "
+        "set StockTable.price = price, StockTable.volume = volume "
+        "on StockTable.symbol == symbol;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("UpsertStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 10])
+    h.send(["WSO2", 57.6, 300])
+    assert table_rows(rt, "StockTable") == [("IBM", 75.6, 10), ("WSO2", 57.6, 300)]
+
+
+def test_in_table_condition(manager):
+    app = (
+        "define stream StockStream (symbol string, price float); "
+        "define stream CheckStream (symbol string); "
+        "@PrimaryKey('symbol') "
+        "define table StockTable (symbol string, price float); "
+        "from StockStream insert into StockTable; "
+        "@info(name='q') "
+        "from CheckStream[symbol in StockTable] insert into OutStream;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = []
+    rt.add_callback("OutStream", lambda events: got.extend(e.data for e in events))
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6])
+    rt.get_input_handler("CheckStream").send(["WSO2"])
+    rt.get_input_handler("CheckStream").send(["IBM"])
+    assert got == [["WSO2"]]
+
+
+def test_primary_key_upsert_semantics(manager):
+    """Insert with an existing primary key replaces the row."""
+    app = (
+        "define stream S (symbol string, price float); "
+        "@PrimaryKey('symbol') "
+        "define table T (symbol string, price float); "
+        "from S insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["WSO2", 1.0])
+    h.send(["WSO2", 2.0])
+    h.send(["IBM", 3.0])
+    assert table_rows(rt, "T") == [("IBM", 3.0), ("WSO2", 2.0)]
+
+
+def test_indexed_delete_uses_index(manager):
+    app = (
+        "define stream S (symbol string, price float); "
+        "define stream D (symbol string); "
+        "@Index('symbol') "
+        "define table T (symbol string, price float); "
+        "from S insert into T; "
+        "from D delete T on T.symbol == symbol;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    for sym, p in [("A", 1.0), ("B", 2.0), ("A", 3.0), ("C", 4.0)]:
+        rt.get_input_handler("S").send([sym, p])
+    rt.get_input_handler("D").send(["A"])
+    assert table_rows(rt, "T") == [("B", 2.0), ("C", 4.0)]
+    # index maintained after delete
+    t = rt.tables["T"]
+    assert set(t.indexes["symbol"].keys()) == {"B", "C"}
+
+
+def test_multi_attr_primary_key_probe(manager):
+    app = (
+        "define stream S (a string, b int, v double); "
+        "define stream D (a string, b int); "
+        "@PrimaryKey('a','b') "
+        "define table T (a string, b int, v double); "
+        "from S insert into T; "
+        "from D delete T on T.a == a and T.b == b;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    for row in [["x", 1, 1.0], ["x", 2, 2.0], ["y", 1, 3.0]]:
+        rt.get_input_handler("S").send(row)
+    rt.get_input_handler("D").send(["x", 2])
+    assert table_rows(rt, "T") == [("x", 1, 1.0), ("y", 1, 3.0)]
+
+
+def test_delete_with_compound_condition_scan(manager):
+    app = (
+        "define stream D (threshold double); "
+        "define stream S (symbol string, price double); "
+        "define table T (symbol string, price double); "
+        "from S insert into T; "
+        "from D delete T on T.price < threshold;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    for row in [["A", 10.0], ["B", 20.0], ["C", 30.0]]:
+        rt.get_input_handler("S").send(row)
+    rt.get_input_handler("D").send([25.0])
+    assert table_rows(rt, "T") == [("C", 30.0)]
