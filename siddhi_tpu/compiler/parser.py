@@ -1242,3 +1242,27 @@ class Parser:
         self.next()
         self.expect_sym("]")
         return int(t.value)
+
+
+def parse_time_string(s: str) -> int:
+    """Annotation time value ('10 sec', '1 hour 30 min') -> milliseconds.
+    The whole string must be consumed — partial matches ('1.5 min') are
+    errors, not silent misparses."""
+    import re
+
+    from siddhi_tpu.compiler.tokenizer import TIME_UNITS
+
+    pattern = re.compile(r"\s*(\d+)\s*([a-zA-Z]+)")
+    total = 0
+    pos = 0
+    matched = False
+    while m := pattern.match(s, pos):
+        ms = TIME_UNITS.get(m.group(2).lower())
+        if ms is None:
+            raise SiddhiParserError(f"unknown time unit '{m.group(2)}' in '{s}'")
+        total += int(m.group(1)) * ms
+        pos = m.end()
+        matched = True
+    if not matched or s[pos:].strip():
+        raise SiddhiParserError(f"expected a time value, got '{s}'")
+    return total

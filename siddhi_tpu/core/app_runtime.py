@@ -34,6 +34,8 @@ class SiddhiAppRuntime:
         input_manager: InputManager,
         scheduler,
         tables: Optional[Dict[str, object]] = None,
+        named_windows: Optional[Dict[str, object]] = None,
+        partitions: Optional[Dict[str, object]] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -43,6 +45,8 @@ class SiddhiAppRuntime:
         self.input_manager = input_manager
         self.scheduler = scheduler
         self.tables = tables or {}
+        self.named_windows = named_windows or {}
+        self.partitions = partitions or {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
 

@@ -46,6 +46,7 @@ class JoinSide:
         filters: List,
         window,
         table=None,
+        named_window=None,
         triggers: bool = True,
     ):
         self.ref = ref
@@ -53,6 +54,7 @@ class JoinSide:
         self.filters = filters
         self.window = window
         self.table = table
+        self.named_window = named_window
         self.triggers = triggers
 
     def buffered(self) -> Optional[EventBatch]:
@@ -60,6 +62,8 @@ class JoinSide:
             return self.table.rows_batch()
         if self.window is not None:
             return self.window.buffered()
+        if self.named_window is not None:
+            return self.named_window.buffered()
         return None  # pure stream side buffers nothing
 
     def qualified_key(self, attr: str) -> str:
@@ -112,6 +116,13 @@ class JoinRuntime:
             wout = side.window.process(b, now)
             expired = wout.only(ev.EXPIRED)
             if side.triggers and len(expired):
+                j = self._join(side, expired, other, ev.EXPIRED)
+                if j is not None:
+                    outs.append(j)
+        elif side.named_window is not None and side.triggers:
+            # a named-window source delivers its own EXPIRED flow
+            expired = b.only(ev.EXPIRED)
+            if len(expired):
                 j = self._join(side, expired, other, ev.EXPIRED)
                 if j is not None:
                     outs.append(j)

@@ -53,6 +53,8 @@ class AppPlanner:
         self.definitions: Dict[str, StreamDefinition] = {}
         self.query_runtimes: Dict[str, object] = {}
         self.tables: Dict[str, object] = {}  # name -> InMemoryTable
+        self.named_windows: Dict[str, object] = {}  # name -> NamedWindowRuntime
+        self.trigger_runtimes: Dict[str, object] = {}
 
     # -- junction / definition registry -------------------------------------
 
@@ -147,8 +149,46 @@ class AppPlanner:
         for td in self.siddhi_app.table_definitions.values():
             self.tables[td.id] = InMemoryTable(td)
 
+        from siddhi_tpu.core.trigger import TriggerRuntime
+        from siddhi_tpu.core.window import NamedWindowRuntime
+        from siddhi_tpu.planner.expr import ExpressionCompiler, Scope
+
+        for wd in self.siddhi_app.window_definitions.values():
+            fn = wd.window_function
+            if fn is None:
+                raise SiddhiAppCreationError(
+                    f"window '{wd.id}': missing window function"
+                )
+            factory = self.extensions.lookup("window", fn.name, fn.namespace)
+            if factory is None:
+                raise SiddhiAppCreationError(
+                    f"window '{wd.id}': unknown window '{fn.name}()'"
+                )
+            wscope = Scope()
+            for a in wd.attributes:
+                wscope.add(wd.id, a.name, a.name, a.type)
+            wcompiler = ExpressionCompiler(wscope)
+            args = [wcompiler.compile(a) for a in fn.args]
+            w = factory(args, wd.attribute_names)
+            junction = self.define_stream(
+                StreamDefinition(id=wd.id, attributes=list(wd.attributes)),
+            )
+            nwr = NamedWindowRuntime(wd, w, junction, self.app_context)
+            self.named_windows[wd.id] = nwr
+            self.scheduler.register_task(nwr)
+
+        for td in self.siddhi_app.trigger_definitions.values():
+            junction = self.junctions[td.id]  # trigger defines its stream
+            tr = TriggerRuntime(td, junction, self.app_context)
+            self.trigger_runtimes[td.id] = tr
+            self.scheduler.register_task(tr)
+
+        from siddhi_tpu.core.partition import PartitionRuntime
+
         qp = QueryPlanner(self)
         qi = 0
+        pi = 0
+        self.partition_runtimes: Dict[str, object] = {}
         for element in self.siddhi_app.execution_elements:
             if isinstance(element, Query):
                 qr = qp.plan(element, qi)
@@ -157,11 +197,13 @@ class AppPlanner:
                     raise SiddhiAppCreationError(f"duplicate query name '{qr.name}'")
                 self.query_runtimes[qr.name] = qr
             elif isinstance(element, Partition):
-                raise SiddhiAppCreationError("partitions not supported yet")
+                pr = PartitionRuntime(element, self, pi)
+                pi += 1
+                self.partition_runtimes[pr.name] = pr
 
         input_manager = InputManager(self.app_context)
         for key, j in self.junctions.items():
-            if not key.startswith("#"):
+            if not key.startswith("#") and key not in self.named_windows:
                 input_manager.register(j)
 
         return SiddhiAppRuntime(
@@ -173,4 +215,6 @@ class AppPlanner:
             input_manager=input_manager,
             scheduler=self.scheduler,
             tables=self.tables,
+            named_windows=self.named_windows,
+            partitions=self.partition_runtimes,
         )

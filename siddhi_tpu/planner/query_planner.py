@@ -212,7 +212,17 @@ class QueryPlanner:
                     window = p.window
                 else:
                     filters.append(p)
-            sides.append(JoinSide(ref, definition, filters, window))
+            nw = (
+                self.app.named_windows.get(s.stream_id)
+                if not (s.is_inner or s.is_fault)
+                else None
+            )
+            if window is None and nw is not None:
+                sides.append(
+                    JoinSide(ref, definition, filters, None, named_window=nw)
+                )
+            else:
+                sides.append(JoinSide(ref, definition, filters, window))
         left, right = sides
         if left.ref == right.ref:
             raise SiddhiAppCreationError(
@@ -534,6 +544,13 @@ class QueryPlanner:
 
         out = query.output_stream
         if isinstance(out, InsertIntoStream):
+            from siddhi_tpu.core.window import InsertIntoWindowCallback
+
+            nw = self.app.named_windows.get(out.target)
+            if nw is not None and not out.is_inner and not out.is_fault:
+                return InsertIntoWindowCallback(
+                    nw, out.event_type, [a.name for a in out_def.attributes]
+                )
             table = self.app.tables.get(out.target)
             if table is not None and not out.is_inner and not out.is_fault:
                 return InsertIntoTableCallback(

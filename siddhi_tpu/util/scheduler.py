@@ -32,6 +32,18 @@ class Scheduler:
         """task must expose fire(now) and next_wakeup() -> Optional[int]."""
         self._tasks.append(task)
 
+    def unregister_window(self, query_runtime, window):
+        try:
+            self._windows.remove((query_runtime, window))
+        except ValueError:
+            pass
+
+    def unregister_task(self, task):
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass
+
     # -- event-driven path (called under app lock) --------------------------
 
     def advance(self, now: int):

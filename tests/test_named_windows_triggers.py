@@ -1,0 +1,147 @@
+"""Named window + trigger conformance tests.
+
+Modeled on the reference window/ (15 named-window test classes, e.g.
+WindowTestCase, JoinWindowTestCase) and query/trigger/TriggerTestCase.
+"""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.trigger import CronSchedule
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def collect_stream(rt, stream):
+    got = []
+    rt.add_callback(stream, lambda events: got.extend(e.data for e in events))
+    return got
+
+
+def test_named_window_shared_by_queries(manager):
+    app = (
+        "define stream S (sym string, v int); "
+        "define window W (sym string, v int) length(2) output all events; "
+        "from S insert into W; "
+        "@info(name='sum') from W select sum(v) as total insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = collect_stream(rt, "T")
+    h = rt.get_input_handler("S")
+    h.send(["a", 10])
+    h.send(["b", 20])
+    h.send(["c", 30])  # evicts a -> expired(a) reduces sum; window = {b, c}
+    assert got[-1] == [50]
+
+
+def test_named_window_join(manager):
+    app = (
+        "define stream S (sym string); "
+        "define stream Q (sym string); "
+        "define window W (sym string) length(5); "
+        "from S insert into W; "
+        "from Q join W as w on Q.sym == w.sym "
+        "select Q.sym as sym insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = collect_stream(rt, "Out")
+    rt.get_input_handler("S").send(["X"])
+    rt.get_input_handler("Q").send(["X"])
+    rt.get_input_handler("Q").send(["Y"])
+    assert got == [["X"]]
+
+
+def test_window_cannot_get_input_handler(manager):
+    app = (
+        "define stream S (v int); "
+        "define window W (v int) length(2); "
+        "from S insert into W;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    with pytest.raises(Exception):
+        rt.get_input_handler("W")
+
+
+def test_start_trigger(manager):
+    app = (
+        "define trigger T at 'start'; "
+        "from T select triggered_time insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    got = collect_stream(rt, "Out")
+    rt.start()
+    assert len(got) == 1 and got[0][0] > 0
+
+
+def test_periodic_trigger(manager):
+    app = (
+        "define trigger T at every 100 milliseconds; "
+        "from T select triggered_time insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    got = collect_stream(rt, "Out")
+    rt.start()
+    time.sleep(0.45)
+    rt.shutdown()
+    assert 2 <= len(got) <= 6
+    times = [g[0] for g in got]
+    assert times == sorted(times)
+
+
+def test_trigger_feeds_queries_like_a_stream(manager):
+    app = (
+        "define stream S (v int); "
+        "define trigger T at every 100 milliseconds; "
+        "from T#window.length(1) join S#window.length(10) "
+        "select S.v as v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    got = collect_stream(rt, "Out")
+    rt.start()
+    rt.get_input_handler("S").send([42])
+    time.sleep(0.3)
+    rt.shutdown()
+    assert [42] in got
+
+
+# -- cron schedule unit coverage (CronTrigger analog) -----------------------
+
+
+def test_cron_every_five_seconds():
+    c = CronSchedule("*/5 * * * * ?")
+    t0 = 1_700_000_000_000  # some epoch ms
+    f1 = c.next_fire(t0)
+    assert f1 is not None and (f1 // 1000) % 5 == 0 and f1 > t0
+    f2 = c.next_fire(f1)
+    assert f2 - f1 == 5000
+
+
+def test_cron_unix_five_field_daily():
+    c = CronSchedule("30 2 * * *")  # 02:30:00 daily
+    t0 = 1_700_000_000_000
+    f1 = c.next_fire(t0)
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(f1 / 1000, datetime.timezone.utc)
+    assert (dt.hour, dt.minute, dt.second) == (2, 30, 0)
+    f2 = c.next_fire(f1)
+    assert f2 - f1 == 86_400_000
+
+
+def test_cron_day_of_week():
+    c = CronSchedule("0 0 12 ? * MON")
+    f1 = c.next_fire(1_700_000_000_000)
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(f1 / 1000, datetime.timezone.utc)
+    assert dt.weekday() == 0 and dt.hour == 12
