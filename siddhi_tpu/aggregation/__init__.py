@@ -1,0 +1,3 @@
+from siddhi_tpu.aggregation.runtime import AggregationRuntime
+
+__all__ = ["AggregationRuntime"]

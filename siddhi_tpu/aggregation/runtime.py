@@ -1,0 +1,706 @@
+"""Incremental aggregation: ``define aggregation A from S select ...
+group by ... aggregate by ts every sec ... year``.
+
+Re-design of the reference ``core/aggregation/`` (AggregationRuntime.java:81,
+IncrementalExecutor.java:48, util/parser/AggregationParser.java:93): instead
+of a chain of per-duration IncrementalExecutor objects each holding a
+BaseIncrementalValueStore and forwarding expired buckets via linked-list
+event chunks, ingestion is **vectorized bucketed reduction**: a micro-batch
+is bucketed by truncated timestamp + group key with one ``np.unique`` pass,
+base values (sum/count/min/max/last/set) are segment-reduced per bucket, and
+completed buckets cascade up the duration ladder (sec -> min -> ... -> year)
+by merging base values — the same decomposition the reference's
+IncrementalAttributeAggregators perform (avg = sum+count, stdDev =
+sum+sumSq+count, AvgIncrementalAttributeAggregator etc.).
+
+Query access (joins ``on ... within ... per ...`` and on-demand queries)
+stitches finished buckets with in-memory running buckets of the chosen and
+all finer durations, mirroring AggregationRuntime.compileExpression's
+table + in-memory union (aggregation/AggregationRuntime.java:181).
+
+Timezone: bucket boundaries are computed in UTC (the reference's default
+aggregation timezone is GMT).  Calendar durations (months/years) truncate
+via numpy datetime64, matching GregorianCalendar month/year roll.
+"""
+
+from __future__ import annotations
+
+import re as _re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.query import build_env
+from siddhi_tpu.planner.expr import (
+    AGGREGATOR_NAMES,
+    CompiledExpression,
+    ExpressionCompiler,
+    Scope,
+)
+from siddhi_tpu.query_api import (
+    AggregationDefinition,
+    ArithmeticOp,
+    AndOp,
+    Attribute,
+    AttrType,
+    CompareOp,
+    Expression,
+    FunctionCall,
+    InOp,
+    IsNull,
+    NotOp,
+    OrOp,
+    StreamDefinition,
+    Variable,
+)
+
+DURATIONS = ["seconds", "minutes", "hours", "days", "weeks", "months", "years"]
+
+_FIXED_MS = {
+    "seconds": 1_000,
+    "minutes": 60_000,
+    "hours": 3_600_000,
+    "days": 86_400_000,
+    "weeks": 604_800_000,
+}
+
+AGG_START_TS = "AGG_TIMESTAMP"
+
+
+def bucket_starts(ts_ms: np.ndarray, duration: str) -> np.ndarray:
+    """Truncate epoch-ms timestamps to their bucket start for a duration.
+
+    Fixed durations use modulo arithmetic (weeks anchor on the epoch-Thursday
+    like java.util.Calendar's WEEK truncation anchors are locale-dependent;
+    we anchor ISO-style on Monday).  months/years truncate on the UTC
+    calendar via datetime64.
+    """
+    ts_ms = np.asarray(ts_ms, dtype=np.int64)
+    if duration in _FIXED_MS:
+        w = _FIXED_MS[duration]
+        if duration == "weeks":
+            # epoch (1970-01-01) was a Thursday; shift so weeks start Monday
+            shift = 3 * 86_400_000
+            return (ts_ms + shift) // w * w - shift
+        return ts_ms // w * w
+    dt = ts_ms.astype("datetime64[ms]")
+    unit = "M" if duration == "months" else "Y"
+    return dt.astype(f"datetime64[{unit}]").astype("datetime64[ms]").astype(np.int64)
+
+
+def bucket_end(start_ms: int, duration: str) -> int:
+    """Exclusive end of the bucket that starts at start_ms."""
+    if duration in _FIXED_MS:
+        return int(start_ms) + _FIXED_MS[duration]
+    dt = np.int64(start_ms).astype("datetime64[ms]")
+    unit = "M" if duration == "months" else "Y"
+    nxt = dt.astype(f"datetime64[{unit}]") + 1
+    return int(nxt.astype("datetime64[ms]").astype(np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Base-field decomposition
+# ---------------------------------------------------------------------------
+
+
+class BaseField:
+    """One incrementally-mergeable accumulator column.
+
+    op: 'sum' | 'count' | 'min' | 'max' | 'last' | 'set'
+    The merge of two partial buckets is op-specific (add / add / min / max /
+    later-wins / union) — this is what makes the sec->year cascade exact.
+    """
+
+    __slots__ = ("name", "op", "arg", "type")
+
+    def __init__(self, name: str, op: str, arg: Optional[CompiledExpression], type_: AttrType):
+        self.name = name
+        self.op = op
+        self.arg = arg
+        self.type = type_
+
+
+_NUMERIC_WIDE = {
+    AttrType.INT: AttrType.LONG,
+    AttrType.LONG: AttrType.LONG,
+    AttrType.FLOAT: AttrType.DOUBLE,
+    AttrType.DOUBLE: AttrType.DOUBLE,
+}
+
+
+class IncrementalRewrite:
+    """Decomposes select-clause aggregator calls into base fields and
+    rewrites the expression to reference them (the analog of the reference's
+    IncrementalAttributeAggregator.getBaseAttributes /
+    getNewMeta rewrite in AggregationParser.java:420-560)."""
+
+    def __init__(self, compiler: ExpressionCompiler, final_scope: Scope):
+        self.compiler = compiler
+        self.final_scope = final_scope
+        self.fields: Dict[str, BaseField] = {}
+
+    def _field(self, op: str, arg_expr: Optional[Expression], type_: AttrType) -> str:
+        key = f"__{op}_{'' if arg_expr is None else repr(arg_expr)}"
+        if key in self.fields:
+            return self.fields[key].name
+        name = f"_{op.upper()}{len(self.fields)}"
+        arg = self.compiler.compile(arg_expr) if arg_expr is not None else None
+        self.fields[key] = BaseField(name, op, arg, type_)
+        self.final_scope.add_bare(name, type_)
+        return name
+
+    def _one_arg(self, call: FunctionCall) -> Expression:
+        if len(call.args) != 1:
+            raise SiddhiAppCreationError(
+                f"aggregation: '{call.name}' takes exactly one argument"
+            )
+        return call.args[0]
+
+    def rewrite(self, expr: Expression) -> Expression:
+        if isinstance(expr, FunctionCall) and expr.namespace is None and expr.name in AGGREGATOR_NAMES:
+            name = expr.name
+            if name == "count":
+                return Variable(attribute=self._field("count", None, AttrType.LONG))
+            if name in ("sum", "avg", "stdDev"):
+                a = self._one_arg(expr)
+                at = self.compiler.compile(a).type
+                if at not in _NUMERIC_WIDE:
+                    raise SiddhiAppCreationError(f"aggregation: {name}() needs a numeric argument")
+                sum_v = Variable(attribute=self._field("sum", a, _NUMERIC_WIDE[at]))
+                if name == "sum":
+                    return sum_v
+                cnt_v = Variable(attribute=self._field("count", None, AttrType.LONG))
+                if name == "avg":
+                    return ArithmeticOp("/", sum_v, cnt_v)
+                sq = ArithmeticOp("*", a, a)
+                sumsq_v = Variable(attribute=self._field("sum", sq, AttrType.DOUBLE))
+                mean = ArithmeticOp("/", sum_v, cnt_v)
+                var = ArithmeticOp(
+                    "-", ArithmeticOp("/", sumsq_v, cnt_v), ArithmeticOp("*", mean, mean)
+                )
+                # clamp float-rounding negatives before the root
+                from siddhi_tpu.query_api import Constant
+
+                var = FunctionCall(None, "maximum", (var, Constant(0.0, AttrType.DOUBLE)))
+                return FunctionCall(None, "sqrt", (var,))
+            if name in ("min", "max", "minForever", "maxForever"):
+                # Forever variants degrade to per-bucket min/max: inside the
+                # cascade the merge (min-of-mins) already gives the running
+                # extremum over any queried range.
+                a = self._one_arg(expr)
+                at = self.compiler.compile(a).type
+                if at not in _NUMERIC_WIDE:
+                    raise SiddhiAppCreationError(f"aggregation: {name}() needs a numeric argument")
+                op = "min" if name in ("min", "minForever") else "max"
+                return Variable(attribute=self._field(op, a, at))
+            if name == "distinctCount":
+                a = self._one_arg(expr)
+                return Variable(attribute=self._field("set", a, AttrType.LONG))
+            raise SiddhiAppCreationError(
+                f"aggregation: aggregator '{name}' is not incrementally mergeable"
+            )
+        if isinstance(expr, ArithmeticOp):
+            return ArithmeticOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, CompareOp):
+            return CompareOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, AndOp):
+            return AndOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, OrOp):
+            return OrOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, NotOp):
+            return NotOp(self.rewrite(expr.expr))
+        if isinstance(expr, IsNull):
+            return IsNull(self.rewrite(expr.expr))
+        if isinstance(expr, InOp):
+            return InOp(self.rewrite(expr.expr), expr.source_id)
+        if isinstance(expr, FunctionCall):
+            return FunctionCall(
+                expr.namespace, expr.name, tuple(self.rewrite(a) for a in expr.args), expr.star
+            )
+        return expr
+
+
+# ---------------------------------------------------------------------------
+# Bucket store
+# ---------------------------------------------------------------------------
+
+
+class _Bucket:
+    """Per (duration, bucket_start, group_key) base accumulator row."""
+
+    __slots__ = ("values", "last_ts")
+
+    def __init__(self):
+        self.values: Dict[str, object] = {}
+        self.last_ts = -1
+
+
+def _merge_value(op: str, old, new, old_ts: int, new_ts: int):
+    if old is None:
+        return new
+    if new is None:
+        return old
+    if op in ("sum", "count"):
+        return old + new
+    if op == "min":
+        return min(old, new)
+    if op == "max":
+        return max(old, new)
+    if op == "set":
+        return old | new
+    # 'last': later timestamp wins
+    return new if new_ts >= old_ts else old
+
+
+class _DurationStore:
+    """All buckets of one duration: running (in-memory, may still receive
+    events) and finished (flushed by the cascade — the analog of the
+    reference's per-duration backing table)."""
+
+    def __init__(self, duration: str):
+        self.duration = duration
+        self.running: Dict[Tuple[int, Tuple], _Bucket] = {}
+        self.finished: Dict[Tuple[int, Tuple], _Bucket] = {}
+
+    def merge_into(self, target: Dict, key: Tuple[int, Tuple], values: Dict, last_ts: int,
+                   ops: Dict[str, str]):
+        b = target.get(key)
+        if b is None:
+            b = target[key] = _Bucket()
+        for fname, v in values.items():
+            b.values[fname] = _merge_value(ops[fname], b.values.get(fname), v, b.last_ts, last_ts)
+        if last_ts > b.last_ts:
+            b.last_ts = last_ts
+
+
+# ---------------------------------------------------------------------------
+# Runtime
+# ---------------------------------------------------------------------------
+
+
+class AggregationRuntime:
+    """Executes one ``define aggregation``.
+
+    Subscribes to the input stream junction; per batch performs the bucketed
+    reduction into the finest duration's running store; a watermark (max
+    event time seen) drives the flush cascade.  ``find`` serves joins and
+    on-demand queries.
+    """
+
+    def __init__(self, definition: AggregationDefinition, app_planner):
+        self.definition = definition
+        self.name = definition.id
+        self.app_context = app_planner.app_context
+        s = definition.input_stream
+        in_def = app_planner.resolve_stream_definition(s)
+        self.input_stream_id = s.stream_id
+        declared = [d for d in DURATIONS if d in definition.durations]
+        if not declared:
+            raise SiddhiAppCreationError(f"aggregation '{self.name}': no durations")
+        # Fill the min..max range along the NESTING chain (sec..day, month,
+        # year).  Weeks do not nest inside months, so 'weeks' is a side
+        # branch fed from days (or finer) — never part of the month/year
+        # cascade.  (The reference keeps a linear executor chain and shares
+        # this constraint via its duration validation.)
+        chain = [d for d in DURATIONS if d != "weeks"]
+        chain_declared = [d for d in declared if d != "weeks"]
+        if chain_declared:
+            lo = chain.index(chain_declared[0])
+            hi = chain.index(chain_declared[-1])
+            self.chain = chain[lo : hi + 1]
+        else:
+            self.chain = []
+        self.has_weeks = "weeks" in declared
+        self.durations = list(self.chain)
+        if self.has_weeks:
+            self.durations = sorted(
+                self.durations + ["weeks"], key=DURATIONS.index
+            )
+
+        ref = s.alias or s.stream_id
+        scope = Scope()
+        for a in in_def.attributes:
+            scope.add(ref, a.name, a.name, a.type)
+        self.compiler = ExpressionCompiler(scope)
+
+        # aggregate by <attr> (defaults to event arrival timestamp)
+        self.ts_compiled: Optional[CompiledExpression] = None
+        if definition.aggregate_by is not None:
+            c = self.compiler.compile(Variable(attribute=definition.aggregate_by))
+            if c.type not in (AttrType.LONG, AttrType.INT):
+                raise SiddhiAppCreationError(
+                    f"aggregation '{self.name}': 'aggregate by {definition.aggregate_by}' "
+                    "must be a long epoch-ms attribute"
+                )
+            self.ts_compiled = c
+
+        sel = definition.selector
+        self.group_by: List[CompiledExpression] = [
+            self.compiler.compile(g) for g in (sel.group_by or [])
+        ]
+        self.group_names: List[str] = [
+            g.attribute if isinstance(g, Variable) else f"_g{i}"
+            for i, g in enumerate(sel.group_by or [])
+        ]
+
+        # decompose select items
+        final_scope = Scope()
+        final_scope.add_bare(AGG_START_TS, AttrType.LONG)
+        for nm, g in zip(self.group_names, sel.group_by or []):
+            gc = self.compiler.compile(g)
+            final_scope.add_bare(nm, gc.type)
+        rw = IncrementalRewrite(self.compiler, final_scope)
+        self.out_items: List[Tuple[str, CompiledExpression]] = []
+        out_attrs: List[Attribute] = []
+        if not sel.selection:
+            raise SiddhiAppCreationError(
+                f"aggregation '{self.name}': select clause is required"
+            )
+        final_compiler = ExpressionCompiler(final_scope)
+        group_key_exprs = {repr(g) for g in (sel.group_by or [])}
+        for item in sel.selection:
+            expr = item.expression
+            nm = item.name
+            if isinstance(expr, Variable) and repr(expr) in group_key_exprs:
+                # group-by key: passes through the bucket key
+                idx = [repr(g) for g in sel.group_by].index(repr(expr))
+                gname = self.group_names[idx]
+                compiled = final_compiler.compile(Variable(attribute=gname))
+            else:
+                rewritten = rw.rewrite(expr)
+                if repr(rewritten) == repr(expr):
+                    # no aggregator inside: per-bucket last value
+                    src = self.compiler.compile(expr)
+                    fname = rw._field("last", expr, src.type)
+                    compiled = final_compiler.compile(Variable(attribute=fname))
+                else:
+                    compiled = final_compiler.compile(rewritten)
+            self.out_items.append((nm, compiled))
+            out_attrs.append(Attribute(nm, compiled.type))
+        self.base_fields: List[BaseField] = list(rw.fields.values())
+        self.field_ops: Dict[str, str] = {f.name: f.op for f in self.base_fields}
+
+        self.output_definition = StreamDefinition(
+            id=self.name, attributes=[Attribute(AGG_START_TS, AttrType.LONG)] + out_attrs
+        )
+        # flush-cascade topology: each duration feeds the next chain duration;
+        # weeks hang off the coarsest sub-week chain duration
+        self._feeds: Dict[str, List[str]] = {d: [] for d in self.durations}
+        for i, d in enumerate(self.chain[:-1]):
+            self._feeds[d].append(self.chain[i + 1])
+        if self.has_weeks and self.chain:
+            sub_week = [d for d in self.chain if DURATIONS.index(d) < DURATIONS.index("weeks")]
+            if not sub_week:
+                raise SiddhiAppCreationError(
+                    f"aggregation '{self.name}': 'week' needs a day-or-finer "
+                    "duration to aggregate from when months/years are present"
+                )
+            self._feeds[sub_week[-1]].append("weeks")
+
+        self.stores: Dict[str, _DurationStore] = {d: _DurationStore(d) for d in self.durations}
+        self.watermark: int = -(1 << 62)
+
+    # -- ingest -------------------------------------------------------------
+
+    def on_event(self, batch: EventBatch, now: int):
+        batch = batch.only(ev.CURRENT)
+        if len(batch) == 0:
+            self._advance(now)
+            return
+        env = build_env(batch)
+        ts = (
+            np.asarray(self.ts_compiled(env), dtype=np.int64)
+            if self.ts_compiled is not None
+            else batch.timestamps
+        )
+        n = len(batch)
+        finest = self.durations[0]
+        buckets = bucket_starts(ts, finest)
+
+        # group keys (host tuples; numeric keys stay scalar)
+        if self.group_by:
+            gcols = [np.broadcast_to(np.asarray(g(env)), (n,)) for g in self.group_by]
+            keys = [tuple(c[i] for c in gcols) for i in range(n)]
+        else:
+            keys = [()] * n
+        # base-field per-event values
+        fvals: Dict[str, np.ndarray] = {}
+        for f in self.base_fields:
+            if f.op == "count":
+                fvals[f.name] = np.ones(n, dtype=np.int64)
+            else:
+                fvals[f.name] = np.broadcast_to(np.asarray(f.arg(env)), (n,))
+
+        # segment by (bucket, key) via sort over a combined id
+        combo = {}
+        order: List[Tuple[int, Tuple]] = []
+        ids = np.empty(n, dtype=np.int64)
+        for i in range(n):
+            k = (int(buckets[i]), keys[i])
+            j = combo.get(k)
+            if j is None:
+                j = combo[k] = len(order)
+                order.append(k)
+            ids[i] = j
+        store = self.stores[finest]
+        for k, j in combo.items():
+            m = ids == j
+            seg_ts = ts[m]
+            last_i = int(np.argmax(seg_ts))
+            values: Dict[str, object] = {}
+            for f in self.base_fields:
+                seg = fvals[f.name][m]
+                if f.op in ("sum", "count"):
+                    values[f.name] = seg.sum().item() if seg.dtype != object else sum(seg)
+                elif f.op == "min":
+                    values[f.name] = seg.min().item() if seg.dtype != object else min(seg)
+                elif f.op == "max":
+                    values[f.name] = seg.max().item() if seg.dtype != object else max(seg)
+                elif f.op == "set":
+                    values[f.name] = set(seg.tolist())
+                else:  # last
+                    values[f.name] = seg[last_i] if seg.dtype == object else seg[last_i].item()
+            # out-of-order below the watermark: merge straight into the
+            # finished store (the reference's OutOfOrderEventsDataAggregator)
+            if k[0] < bucket_starts(np.asarray([self.watermark]), finest)[0]:
+                self._merge_out_of_order(k, values, int(seg_ts.max()))
+            else:
+                store.merge_into(store.running, k, values, int(seg_ts.max()), self.field_ops)
+        self.watermark = max(self.watermark, int(ts.max()))
+        self._advance(now)
+
+    def _merge_out_of_order(self, key: Tuple[int, Tuple], values: Dict, last_ts: int):
+        """Late event: fold into the finished bucket of every duration."""
+        for d in self.durations:
+            st = self.stores[d]
+            dk = (int(bucket_starts(np.asarray([key[0]]), d)[0]), key[1])
+            target = st.finished if dk in st.finished or d == self.durations[0] else st.running
+            st.merge_into(target, dk, values, last_ts, self.field_ops)
+
+    def _advance(self, now: int):
+        """Flush every running bucket that the watermark has passed, cascading
+        base values into the parent duration."""
+        wm = self.watermark
+        for d in self.durations:
+            st = self.stores[d]
+            done = [k for k in st.running if bucket_end(k[0], d) <= wm]
+            for k in done:
+                b = st.running.pop(k)
+                st.merge_into(st.finished, k, b.values, b.last_ts, self.field_ops)
+                for parent in self._feeds[d]:
+                    pst = self.stores[parent]
+                    pk = (int(bucket_starts(np.asarray([k[0]]), parent)[0]), k[1])
+                    pst.merge_into(pst.running, pk, b.values, b.last_ts, self.field_ops)
+
+    # -- query --------------------------------------------------------------
+
+    def find(
+        self,
+        per: str,
+        within: Optional[Tuple[int, int]] = None,
+    ) -> EventBatch:
+        """All buckets of duration ``per`` intersecting [start, end), finished
+        and running stitched, finer running buckets rolled up — returned as a
+        batch over the aggregation's output schema."""
+        per = _canon_duration(per)
+        if per not in self.durations:
+            raise SiddhiAppCreationError(
+                f"aggregation '{self.name}': per '{per}' is not one of {self.durations}"
+            )
+        # union of finished + running at `per`, plus roll-up of finer running
+        merged: Dict[Tuple[int, Tuple], _Bucket] = {}
+        ops = self.field_ops
+
+        def fold(key, b: _Bucket):
+            t = merged.get(key)
+            if t is None:
+                t = merged[key] = _Bucket()
+            for fname, v in b.values.items():
+                t.values[fname] = _merge_value(ops[fname], t.values.get(fname), v, t.last_ts, b.last_ts)
+            if b.last_ts > t.last_ts:
+                t.last_ts = b.last_ts
+
+        st = self.stores[per]
+        for key, b in st.finished.items():
+            fold(key, b)
+        for key, b in st.running.items():
+            fold(key, b)
+        # weeks never roll into months/years (non-nesting); chain durations
+        # finer than `per` always do
+        for d in self.chain:
+            if DURATIONS.index(d) >= DURATIONS.index(per):
+                continue
+            for (bs, gk), b in self.stores[d].running.items():
+                pk = (int(bucket_starts(np.asarray([bs]), per)[0]), gk)
+                fold(pk, b)
+
+        items = sorted(merged.items(), key=lambda kv: (kv[0][0], repr(kv[0][1])))
+        if within is not None:
+            lo, hi = within
+            items = [(k, b) for k, b in items if lo <= k[0] < hi]
+
+        n = len(items)
+        env: Dict[str, object] = {}
+        starts = np.asarray([k[0] for k, _ in items], dtype=np.int64)
+        env[AGG_START_TS] = starts
+        for gi, gname in enumerate(self.group_names):
+            vals = [k[1][gi] for k, _ in items]
+            env[gname] = np.asarray(vals, dtype=object if any(isinstance(v, str) for v in vals) else None)
+        for f in self.base_fields:
+            col = [b.values.get(f.name) for _, b in items]
+            if f.op == "set":
+                env[f.name] = np.asarray([len(s) if s is not None else 0 for s in col], dtype=np.int64)
+            elif f.type in (AttrType.STRING, AttrType.OBJECT):
+                env[f.name] = np.asarray(col, dtype=object)
+            else:
+                env[f.name] = np.asarray(col)
+        from siddhi_tpu.planner.expr import N_KEY, TS_KEY
+
+        env[N_KEY] = n
+        env[TS_KEY] = starts
+        cols: Dict[str, np.ndarray] = {AGG_START_TS: starts}
+        for nm, compiled in self.out_items:
+            cols[nm] = np.broadcast_to(np.asarray(compiled(env)), (n,)) if n else np.asarray([])
+        return EventBatch(
+            self.name,
+            [a.name for a in self.output_definition.attributes],
+            cols,
+            timestamps=starts,
+        )
+
+    # -- snapshot -----------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        def dump(d: Dict[Tuple[int, Tuple], _Bucket]):
+            return [(k, b.values, b.last_ts) for k, b in d.items()]
+
+        return {
+            "watermark": self.watermark,
+            "stores": {
+                d: {"running": dump(st.running), "finished": dump(st.finished)}
+                for d, st in self.stores.items()
+            },
+        }
+
+    def restore(self, state: Dict):
+        self.watermark = state["watermark"]
+        for d, st_state in state["stores"].items():
+            st = self.stores[d]
+            st.running.clear()
+            st.finished.clear()
+            for k, values, last_ts in st_state["running"]:
+                b = _Bucket()
+                b.values = dict(values)
+                b.last_ts = last_ts
+                st.running[tuple(k) if not isinstance(k, tuple) else k] = b
+            for k, values, last_ts in st_state["finished"]:
+                b = _Bucket()
+                b.values = dict(values)
+                b.last_ts = last_ts
+                st.finished[tuple(k) if not isinstance(k, tuple) else k] = b
+
+
+_DT_FIELDS = 6  # year month day hour minute second
+
+
+def parse_datetime_ms(s: str) -> int:
+    """``yyyy-MM-dd HH:mm:ss`` (optional ``+HH:MM`` offset) -> epoch ms, UTC
+    default (the reference's IncrementalTimeConverterUtil)."""
+    import datetime as _dt
+
+    s = s.strip()
+    tz = _dt.timezone.utc
+    m = _re.search(r"\s([+-]\d{2}):(\d{2})$", s)
+    if m:
+        sign = 1 if m.group(1)[0] == "+" else -1
+        tz = _dt.timezone(
+            sign * _dt.timedelta(hours=abs(int(m.group(1))), minutes=int(m.group(2)))
+        )
+        s = s[: m.start()]
+    dt = _dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=tz)
+    return int(dt.timestamp() * 1000)
+
+
+def _wildcard_bounds(pattern: str) -> Tuple[int, int]:
+    """``"2017-06-** **:**:**"`` -> [month start, next month).  The first
+    ``**`` fixes the granularity; everything after it must be wildcarded."""
+    import datetime as _dt
+
+    parts = _re.split(r"[-\s:]+", pattern.strip())
+    if len(parts) != _DT_FIELDS:
+        raise SiddhiAppCreationError(
+            f"within pattern '{pattern}': expected yyyy-MM-dd HH:mm:ss with ** wildcards"
+        )
+    fixed: List[int] = []
+    for p in parts:
+        if p == "**":
+            break
+        fixed.append(int(p))
+    if len(fixed) == _DT_FIELDS:  # no wildcard: a single second
+        lo = parse_datetime_ms(
+            f"{fixed[0]:04d}-{fixed[1]:02d}-{fixed[2]:02d} {fixed[3]:02d}:{fixed[4]:02d}:{fixed[5]:02d}"
+        )
+        return lo, lo + 1000
+    mins = [1, 1, 1, 0, 0, 0]  # month/day floor at 1
+    vals = fixed + mins[len(fixed) :]
+    start = _dt.datetime(*vals, tzinfo=_dt.timezone.utc)
+    unit = len(fixed) - 1  # index of last fixed field
+    if unit < 0:
+        raise SiddhiAppCreationError(f"within pattern '{pattern}': fully wildcarded")
+    if unit == 0:
+        end = start.replace(year=start.year + 1)
+    elif unit == 1:
+        end = (
+            start.replace(year=start.year + 1, month=1)
+            if start.month == 12
+            else start.replace(month=start.month + 1)
+        )
+    else:
+        deltas = {2: _dt.timedelta(days=1), 3: _dt.timedelta(hours=1),
+                  4: _dt.timedelta(minutes=1), 5: _dt.timedelta(seconds=1)}
+        end = start + deltas[unit]
+    return int(start.timestamp() * 1000), int(end.timestamp() * 1000)
+
+
+def within_bounds(v1, v2=None) -> Tuple[int, int]:
+    """Resolve a ``within`` clause to an epoch-ms half-open range.
+
+    One arg: a wildcard pattern string (or a plain instant, which bounds only
+    the start).  Two args: [start, end) each a long or datetime string.
+    """
+
+    def to_ms(v) -> int:
+        if isinstance(v, (int, np.integer)):
+            return int(v)
+        if isinstance(v, (float, np.floating)):
+            return int(v)
+        if isinstance(v, str):
+            if "*" in v:
+                raise SiddhiAppCreationError("wildcard pattern is single-arg only")
+            return parse_datetime_ms(v)
+        raise SiddhiAppCreationError(f"within: cannot interpret {v!r} as a time")
+
+    if v2 is None:
+        if isinstance(v1, str) and "*" in v1:
+            return _wildcard_bounds(v1)
+        return to_ms(v1), 1 << 62
+    return to_ms(v1), to_ms(v2)
+
+
+def _canon_duration(per: str) -> str:
+    p = per.strip().lower()
+    table = {
+        "sec": "seconds", "second": "seconds", "seconds": "seconds",
+        "min": "minutes", "minute": "minutes", "minutes": "minutes",
+        "hour": "hours", "hours": "hours",
+        "day": "days", "days": "days",
+        "week": "weeks", "weeks": "weeks",
+        "month": "months", "months": "months",
+        "year": "years", "years": "years",
+    }
+    if p not in table:
+        raise SiddhiAppCreationError(f"unknown aggregation duration '{per}'")
+    return table[p]

@@ -36,6 +36,7 @@ class SiddhiAppRuntime:
         tables: Optional[Dict[str, object]] = None,
         named_windows: Optional[Dict[str, object]] = None,
         partitions: Optional[Dict[str, object]] = None,
+        aggregations: Optional[Dict[str, object]] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -47,6 +48,8 @@ class SiddhiAppRuntime:
         self.tables = tables or {}
         self.named_windows = named_windows or {}
         self.partitions = partitions or {}
+        self.aggregations = aggregations or {}
+        self._on_demand_cache: Dict[str, object] = {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
 
@@ -100,6 +103,30 @@ class SiddhiAppRuntime:
     # Java-style aliases for drop-in familiarity
     addCallback = add_callback
     getInputHandler = get_input_handler
+
+    # -- on-demand (pull) queries -------------------------------------------
+
+    def table_resolver(self, table_name: str):
+        table = self.tables.get(table_name)
+        if table is None:
+            raise SiddhiAppRuntimeError(f"'IN {table_name}': table is not defined")
+        return table.contains_fn()
+
+    def query(self, on_demand_query: str):
+        """Execute a pull query against a table / named window / aggregation
+        and return the matching events
+        (reference: SiddhiAppRuntimeImpl.query:304, cache cap 50)."""
+        from siddhi_tpu.compiler.compiler import SiddhiCompiler
+        from siddhi_tpu.core.on_demand import OnDemandQueryRuntime
+
+        rt = self._on_demand_cache.get(on_demand_query)
+        if rt is None:
+            odq = SiddhiCompiler.parse_on_demand_query(on_demand_query)
+            rt = OnDemandQueryRuntime(odq, self)
+            if len(self._on_demand_cache) >= 50:
+                self._on_demand_cache.pop(next(iter(self._on_demand_cache)))
+            self._on_demand_cache[on_demand_query] = rt
+        return rt.execute()
 
     # -- persistence (full implementation arrives with SnapshotService) -----
 

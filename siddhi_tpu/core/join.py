@@ -47,6 +47,7 @@ class JoinSide:
         window,
         table=None,
         named_window=None,
+        aggregation=None,
         triggers: bool = True,
     ):
         self.ref = ref
@@ -55,15 +56,42 @@ class JoinSide:
         self.window = window
         self.table = table
         self.named_window = named_window
+        self.aggregation = aggregation
+        # compiled `within`/`per` of an aggregation join, attached by the
+        # planner (reference: AggregationRuntime.compileExpression)
+        self.agg_within = None  # (CompiledExpression, CompiledExpression|None)
+        self.agg_per = None  # CompiledExpression
         self.triggers = triggers
 
-    def buffered(self) -> Optional[EventBatch]:
+    def buffered(self, probe_env: Optional[Dict] = None) -> Optional[EventBatch]:
+        if self.aggregation is not None:
+            from siddhi_tpu.aggregation.runtime import within_bounds
+
+            if self.agg_per is None:
+                raise SiddhiAppCreationError(
+                    f"aggregation join '{self.ref}': 'per' clause is required"
+                )
+            env = probe_env or {N_KEY: 0}
+            per = str(np.asarray(self.agg_per.fn(env)).ravel()[0])
+            within = None
+            if self.agg_within is not None:
+                start_c, end_c = self.agg_within
+                v1 = np.asarray(start_c.fn(env)).ravel()[0]
+                v2 = np.asarray(end_c.fn(env)).ravel()[0] if end_c is not None else None
+                within = within_bounds(v1, v2)
+            return self.aggregation.find(per, within)
         if self.table is not None:
             return self.table.rows_batch()
         if self.window is not None:
             return self.window.buffered()
         if self.named_window is not None:
-            return self.named_window.buffered()
+            buf = self.named_window.buffered()
+            # a named window's buffer is shared, so this side's filters must
+            # run at probe time (a plain window side filters before buffering)
+            if buf is not None:
+                for f in self.filters:
+                    buf = f.process(buf, 0)
+            return buf
         return None  # pure stream side buffers nothing
 
     def qualified_key(self, attr: str) -> str:
@@ -158,7 +186,17 @@ class JoinRuntime:
     def _join(
         self, side: JoinSide, rows: EventBatch, other: JoinSide, out_type: int
     ) -> Optional[EventBatch]:
-        buf = other.buffered()
+        probe_env = None
+        if other.aggregation is not None and len(rows):
+            # `within`/`per` may reference the arriving event's attributes;
+            # evaluate them on the first probing row
+            probe_env = {
+                side.qualified_key(a.name): rows.columns[a.name][:1]
+                for a in side.definition.attributes
+            }
+            probe_env[TS_KEY] = rows.timestamps[:1]
+            probe_env[N_KEY] = 1
+        buf = other.buffered(probe_env)
         n_a = len(rows)
         n_b = len(buf) if buf is not None else 0
         is_outer = self._side_outer(side)

@@ -348,8 +348,13 @@ class PartitionRuntime:
         for inst in self.instances.values():
             inst.close()
         self.instances.clear()
+        import time as _time
+
+        now = int(_time.time() * 1000)
         for k, qstates in state.items():
             inst = self.instance_for(k)
+            # fresh instances must not look idle to the purge task
+            inst.last_used = now
             for qname, qs in qstates.items():
                 qr = inst.query_runtimes.get(qname)
                 if qr is not None and hasattr(qr, "restore_state"):

@@ -183,6 +183,15 @@ class AppPlanner:
             self.trigger_runtimes[td.id] = tr
             self.scheduler.register_task(tr)
 
+        from siddhi_tpu.aggregation import AggregationRuntime
+
+        self.aggregations: Dict[str, AggregationRuntime] = {}
+        for ad in self.siddhi_app.aggregation_definitions.values():
+            ar = AggregationRuntime(ad, self)
+            self.aggregations[ad.id] = ar
+            junction = self.junction_for_input(ad.input_stream)
+            junction.subscribe(_AggregationReceiver(ar, self.app_context))
+
         from siddhi_tpu.core.partition import PartitionRuntime
 
         qp = QueryPlanner(self)
@@ -217,4 +226,17 @@ class AppPlanner:
             tables=self.tables,
             named_windows=self.named_windows,
             partitions=self.partition_runtimes,
+            aggregations=self.aggregations,
         )
+
+
+class _AggregationReceiver:
+    """Junction subscriber feeding an AggregationRuntime."""
+
+    def __init__(self, aggregation_runtime, app_context):
+        self.aggregation_runtime = aggregation_runtime
+        self.app_context = app_context
+
+    def receive(self, batch):
+        now = self.app_context.timestamp_generator.current_time()
+        self.aggregation_runtime.on_event(batch, now)

@@ -443,7 +443,20 @@ def _instance_of(py_check) -> Callable:
     return builder
 
 
+def _fn_sqrt(args: List[CompiledExpression]) -> CompiledExpression:
+    if len(args) != 1:
+        raise SiddhiAppCreationError("sqrt(value) needs 1 arg")
+    v = args[0]
+
+    def fn(env):
+        with np.errstate(invalid="ignore"):
+            return np.sqrt(np.asarray(v.fn(env), dtype=np.float64))
+
+    return CompiledExpression(fn, AttrType.DOUBLE)
+
+
 BUILTIN_FUNCTIONS: Dict[str, Callable] = {
+    "sqrt": _fn_sqrt,
     "cast": _fn_cast,
     "convert": _fn_convert,
     "coalesce": _fn_coalesce,

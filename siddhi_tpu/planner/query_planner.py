@@ -185,6 +185,20 @@ class QueryPlanner:
                 )
             table = self.app.tables.get(s.stream_id)
             ref = s.alias or s.stream_id
+            aggregation = getattr(self.app, "aggregations", {}).get(s.stream_id)
+            if aggregation is not None:
+                if s.handlers:
+                    raise SiddhiAppCreationError(
+                        f"query '{name}': aggregation '{s.stream_id}' cannot take "
+                        "filters/windows in a join"
+                    )
+                sides.append(
+                    JoinSide(
+                        ref, aggregation.output_definition, [], None,
+                        aggregation=aggregation, triggers=False,
+                    )
+                )
+                continue
             if table is not None:
                 if s.handlers:
                     raise SiddhiAppCreationError(
@@ -261,6 +275,24 @@ class QueryPlanner:
         if condition is not None and condition.type != AttrType.BOOL:
             raise SiddhiAppCreationError(f"query '{name}': 'on' condition must be boolean")
 
+        # aggregation joins: compile `within`/`per` against the join scope so
+        # they may reference the probing stream's attributes
+        for side in sides:
+            if side.aggregation is None:
+                continue
+            if getattr(j, "per", None) is None:
+                raise SiddhiAppCreationError(
+                    f"query '{name}': join with aggregation "
+                    f"'{side.aggregation.name}' requires a 'per' clause"
+                )
+            side.agg_per = compiler.compile(j.per)
+            w = getattr(j, "within", None)
+            if w is not None:
+                if isinstance(w, tuple):
+                    side.agg_within = (compiler.compile(w[0]), compiler.compile(w[1]))
+                else:
+                    side.agg_within = (compiler.compile(w), None)
+
         selector, out_def = self._plan_selector(
             query.selector, scope, compiler, name, query, batch_mode,
             star_sources=[left, right],
@@ -280,7 +312,7 @@ class QueryPlanner:
         if any(s.window is not None and getattr(s.window, "needs_scheduler", False) for s in sides):
             self.app.scheduler.register_task(jr)
         for side, src, is_left in ((left, j.left, True), (right, j.right, False)):
-            if side.table is not None:
+            if side.table is not None or side.aggregation is not None:
                 continue
             junction = self.app.junction_for_input(src)
             junction.subscribe(JoinStreamReceiver(jr, is_left, self.app.app_context))

@@ -50,11 +50,13 @@ class Scheduler:
         if now <= self._last_advance:
             return
         self._last_advance = now
-        for qr, w in self._windows:
+        # snapshot both lists: a fire may (un)register tasks mid-iteration
+        # (e.g. a partition purge closing per-key instances)
+        for qr, w in list(self._windows):
             wake = w.next_wakeup()
             if wake is not None and wake <= now:
                 qr.on_time(now)
-        for t in self._tasks:
+        for t in list(self._tasks):
             wake = t.next_wakeup()
             if wake is not None and wake <= now:
                 t.fire(now)

@@ -1,0 +1,170 @@
+"""On-demand (pull) query conformance tests.
+
+Modeled on the reference store-query corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/StoreQueryTableTestCase
+/ StoreQueryTestCase): populate a table/window/aggregation via push queries,
+then pull with runtime.query(...) and assert rows.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+TABLE_APP = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define table StockTable (symbol string, price float, volume long); "
+    "from StockStream insert into StockTable;"
+)
+
+
+def _populate(rt):
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 10])
+    h.send(["WSO2", 57.6, 50])
+
+
+def test_find_all(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    events = rt.query("from StockTable select symbol, price, volume;")
+    got = sorted(tuple(e.data) for e in events)
+    assert [(s, pytest.approx(p), v) for s, p, v in [
+        ("IBM", 75.6, 10), ("WSO2", 55.6, 100), ("WSO2", 57.6, 50),
+    ]] == got
+
+
+def test_find_with_condition(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    events = rt.query("from StockTable on volume > 40 select symbol, volume;")
+    assert sorted(tuple(e.data) for e in events) == [("WSO2", 50), ("WSO2", 100)]
+
+
+def test_find_select_star(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    events = rt.query("from StockTable on symbol == 'IBM';")
+    assert [tuple(e.data) for e in events] == [("IBM", pytest.approx(75.6), 10)]
+
+
+def test_find_group_by_aggregation(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    events = rt.query(
+        "from StockTable select symbol, sum(volume) as totalVolume "
+        "group by symbol order by symbol;"
+    )
+    assert [tuple(e.data) for e in events] == [("IBM", 10), ("WSO2", 150)]
+
+
+def test_find_having_limit(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    events = rt.query(
+        "from StockTable select symbol, volume having volume >= 10 "
+        "order by volume desc limit 2;"
+    )
+    assert [tuple(e.data) for e in events] == [("WSO2", 100), ("WSO2", 50)]
+
+
+def test_on_demand_insert(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    rt.query(
+        "select 'GOOG' as symbol, 100.0 as price, 7 as volume "
+        "insert into StockTable;"
+    )
+    events = rt.query("from StockTable select symbol, volume;")
+    assert [tuple(e.data) for e in events] == [("GOOG", 7)]
+
+
+def test_on_demand_delete(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    rt.query("select 'WSO2' as sym delete StockTable on StockTable.symbol == sym;")
+    events = rt.query("from StockTable select symbol;")
+    assert [tuple(e.data) for e in events] == [("IBM",)]
+
+
+def test_on_demand_update(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    _populate(rt)
+    rt.query(
+        "select 1000 as newVolume update StockTable "
+        "set StockTable.volume = newVolume on StockTable.symbol == 'IBM';"
+    )
+    events = rt.query("from StockTable on symbol == 'IBM' select volume;")
+    assert [tuple(e.data) for e in events] == [(1000,)]
+
+
+def test_on_demand_update_or_insert(manager):
+    rt = manager.create_siddhi_app_runtime(TABLE_APP)
+    rt.start()
+    rt.query(
+        "select 'MSFT' as symbol, 10.0 as price, 5 as volume "
+        "update or insert into StockTable "
+        "set StockTable.volume = volume on StockTable.symbol == symbol;"
+    )
+    assert [tuple(e.data) for e in rt.query("from StockTable select symbol, volume;")] == [
+        ("MSFT", 5)
+    ]
+    rt.query(
+        "select 'MSFT' as symbol, 10.0 as price, 50 as volume "
+        "update or insert into StockTable "
+        "set StockTable.volume = volume on StockTable.symbol == symbol;"
+    )
+    assert [tuple(e.data) for e in rt.query("from StockTable select symbol, volume;")] == [
+        ("MSFT", 50)
+    ]
+
+
+def test_on_demand_window_find(manager):
+    app = (
+        "define stream S (symbol string, price float); "
+        "define window W (symbol string, price float) length(3) output all events; "
+        "from S insert into W;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(5):
+        h.send([f"S{i}", float(i)])
+    events = rt.query("from W select symbol;")
+    assert sorted(e.data[0] for e in events) == ["S2", "S3", "S4"]
+
+
+def test_on_demand_aggregation_find(manager):
+    BASE = 1_496_289_720_000
+    app = (
+        "define stream S (symbol string, price double, ts long); "
+        "define aggregation A from S "
+        "select symbol, sum(price) as total group by symbol "
+        "aggregate by ts every sec, min;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["WSO2", 50.0, BASE])
+    h.send(["WSO2", 70.0, BASE + 500])
+    h.send(["IBM", 10.0, BASE + 1000])
+    events = rt.query(
+        f"from A on symbol == 'WSO2' within {BASE}L, {BASE + 60000}L per 'seconds' "
+        "select AGG_TIMESTAMP, symbol, total;"
+    )
+    assert [tuple(e.data) for e in events] == [(BASE, "WSO2", 120.0)]
