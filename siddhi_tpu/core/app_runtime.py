@@ -37,6 +37,8 @@ class SiddhiAppRuntime:
         named_windows: Optional[Dict[str, object]] = None,
         partitions: Optional[Dict[str, object]] = None,
         aggregations: Optional[Dict[str, object]] = None,
+        sources: Optional[List] = None,
+        sinks: Optional[List] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -49,9 +51,12 @@ class SiddhiAppRuntime:
         self.named_windows = named_windows or {}
         self.partitions = partitions or {}
         self.aggregations = aggregations or {}
+        self.sources = sources or []
+        self.sinks = sinks or []
         self._on_demand_cache: Dict[str, object] = {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
+        self._apply_statistics_level(self.app_context.root_metrics_level)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -61,11 +66,57 @@ class SiddhiAppRuntime:
         for j in self.junctions.values():
             j.start()
         self.scheduler.start()
+        # sinks connect before sources so output paths exist when events flow
+        for s in self.sinks:
+            s.start()
+        for s in self.sources:
+            s.start()
+        from siddhi_tpu.util.statistics import Level
+
+        sm = self.app_context.statistics_manager
+        if sm is not None and Level.at_least(self.app_context.root_metrics_level, Level.BASIC):
+            sm.start_reporting()
         self.running = True
+        if self.app_context.playback and self.app_context.playback_idle_ms > 0:
+            self._start_playback_heartbeat()
+
+    def _start_playback_heartbeat(self):
+        """@app:playback(idle.time, increment): when no events arrive for
+        idle.time, advance event time by increment so event-time windows
+        and schedulers keep draining (reference:
+        TimestampGeneratorImpl idle-time timer)."""
+        import threading
+        import time as _time
+
+        idle_s = self.app_context.playback_idle_ms / 1000.0
+        tg = self.app_context.timestamp_generator
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(idle_s):
+                if _time.monotonic() - tg.last_update_wall >= idle_s:
+                    with self.app_context.process_lock:
+                        now = tg.advance_idle()
+                        self.scheduler.advance(now)
+
+        t = threading.Thread(target=loop, name=f"playback-{self.name}", daemon=True)
+        self._playback_stop = stop
+        self._playback_thread = t
+        t.start()
 
     def shutdown(self):
-        if not self.running:
-            self.running = False
+        stop = getattr(self, "_playback_stop", None)
+        if stop is not None:
+            stop.set()
+            self._playback_thread.join(timeout=2)
+            self._playback_stop = None
+        sm = self.app_context.statistics_manager
+        if sm is not None:
+            sm.stop_reporting()
+        for s in self.sources:
+            s.shutdown()
+        for s in self.sinks:
+            s.shutdown()
         self.scheduler.stop()
         for j in self.junctions.values():
             j.stop()
@@ -104,6 +155,53 @@ class SiddhiAppRuntime:
     addCallback = add_callback
     getInputHandler = get_input_handler
 
+    # -- statistics ---------------------------------------------------------
+
+    def _apply_statistics_level(self, level: str):
+        """(Un)install throughput/latency/buffer trackers to match `level`
+        (reference: SiddhiAppRuntimeImpl.setStatisticsLevel:859,
+        registerForBufferedEvents:802-821)."""
+        from siddhi_tpu.util.statistics import Level
+
+        sm = self.app_context.statistics_manager
+        if sm is None:
+            return
+        self.app_context.root_metrics_level = level
+        basic = Level.at_least(level, Level.BASIC)
+        detail = Level.at_least(level, Level.DETAIL)
+        if not basic:
+            # downgrade: drop trackers from the manager so statistics()
+            # stops reporting stale metrics
+            sm.throughput.clear()
+            sm.latency.clear()
+        if not detail:
+            sm.buffers.clear()
+        for j in self.junctions.values():
+            j.throughput_tracker = sm.throughput_tracker(j.stream_id) if basic else None
+        for qname, qr in self.query_runtimes.items():
+            if hasattr(qr, "latency_tracker"):
+                qr.latency_tracker = sm.latency_tracker(qname) if basic else None
+        if detail:
+            for j in self.junctions.values():
+                if j.is_async:
+                    sm.buffer_tracker(j.stream_id, j)
+
+    def set_statistics_level(self, level: str):
+        """Runtime-switchable metrics level OFF/BASIC/DETAIL."""
+        from siddhi_tpu.util.statistics import Level
+
+        self._apply_statistics_level(level)
+        sm = self.app_context.statistics_manager
+        if sm is not None and self.running:
+            if Level.at_least(level, Level.BASIC):
+                sm.start_reporting()
+            else:
+                sm.stop_reporting()
+
+    def statistics(self) -> Dict[str, float]:
+        sm = self.app_context.statistics_manager
+        return sm.stats() if sm is not None else {}
+
     # -- on-demand (pull) queries -------------------------------------------
 
     def table_resolver(self, table_name: str):
@@ -128,13 +226,75 @@ class SiddhiAppRuntime:
             self._on_demand_cache[on_demand_query] = rt
         return rt.execute()
 
-    # -- persistence (full implementation arrives with SnapshotService) -----
+    # -- persistence --------------------------------------------------------
 
-    def persist(self):
-        raise SiddhiAppRuntimeError(
-            f"app '{self.name}': no persistence store configured "
-            "(SiddhiManager.set_persistence_store)"
-        )
+    def _snapshot_service(self):
+        from siddhi_tpu.util.snapshot import SnapshotService
+
+        return SnapshotService(self)
+
+    def _persistence_store(self):
+        store = getattr(self.app_context.siddhi_context, "persistence_store", None)
+        if store is None:
+            raise SiddhiAppRuntimeError(
+                f"app '{self.name}': no persistence store configured "
+                "(SiddhiManager.set_persistence_store)"
+            )
+        return store
+
+    def persist(self) -> str:
+        """Snapshot all state and save it under a new revision
+        (reference: SiddhiAppRuntimeImpl.persist:677).  Returns the
+        revision id."""
+        from siddhi_tpu.util.snapshot import SnapshotService
+
+        store = self._persistence_store()
+        svc = self._snapshot_service()
+        revision = SnapshotService.new_revision(self.name)
+        # quiesce external input around the snapshot
+        # (reference: SiddhiAppRuntimeImpl.persist:677-691 pauses sources)
+        for s in self.sources:
+            s.pause()
+        try:
+            store.save(self.name, revision, svc.full_snapshot())
+        finally:
+            for s in self.sources:
+                s.resume()
+        return revision
+
+    def snapshot(self) -> bytes:
+        """Raw snapshot bytes without a store (reference:
+        SiddhiAppRuntimeImpl.snapshot)."""
+        return self._snapshot_service().full_snapshot()
+
+    def restore(self, snapshot: bytes):
+        self._snapshot_service().restore(snapshot)
+
+    def restore_revision(self, revision: str):
+        store = self._persistence_store()
+        data = store.load(self.name, revision)
+        if data is None:
+            raise SiddhiAppRuntimeError(
+                f"app '{self.name}': revision '{revision}' not found"
+            )
+        self.restore(data)
+
+    def restore_last_revision(self) -> Optional[str]:
+        """Restore the newest saved revision; returns its id (None when no
+        revision exists — reference: SiddhiAppRuntimeImpl.restoreLastRevision)."""
+        store = self._persistence_store()
+        last = store.get_last_revision(self.name)
+        if last is None:
+            return None
+        self.restore_revision(last)
+        return last
+
+    def clear_all_revisions(self):
+        self._persistence_store().clear_all_revisions(self.name)
+
+    # Java-style aliases
+    restoreRevision = restore_revision
+    restoreLastRevision = restore_last_revision
 
     def get_stream_definitions(self):
         return self.siddhi_app.stream_definitions

@@ -23,6 +23,7 @@ class TimestampGenerator:
         self.playback = playback
         self.increment_ms = increment_ms
         self._event_time: int = -1
+        self.last_update_wall: float = time.monotonic()
 
     def current_time(self) -> int:
         if self.playback:
@@ -30,8 +31,17 @@ class TimestampGenerator:
         return int(time.time() * 1000)
 
     def set_event_time(self, ts: int):
+        self.last_update_wall = time.monotonic()
         if ts > self._event_time:
             self._event_time = ts
+
+    def advance_idle(self) -> int:
+        """Idle heartbeat: push event time forward by the increment when no
+        events arrive (reference: TimestampGeneratorImpl idle-time timer)."""
+        self.last_update_wall = time.monotonic()
+        if self._event_time >= 0:
+            self._event_time += self.increment_ms
+        return self.current_time()
 
 
 class SiddhiContext:
@@ -55,6 +65,7 @@ class SiddhiAppContext:
         self.siddhi_context = siddhi_context
         self.name = name
         self.playback = False
+        self.playback_idle_ms = 0
         self.enforce_order = False
         self.root_metrics_level = "off"
         self.timestamp_generator = TimestampGenerator()

@@ -56,6 +56,16 @@ class SiddhiManager:
         for rt in list(self._app_runtimes.values()):
             rt.persist()
 
+    def restore_last_state(self):
+        """Restore every app to its newest saved revision
+        (reference: SiddhiManager.restoreLastState:292)."""
+        for rt in list(self._app_runtimes.values()):
+            rt.restore_last_revision()
+
+    # Java-style aliases
+    setPersistenceStore = set_persistence_store
+    restoreLastState = restore_last_state
+
     def shutdown(self):
         for rt in list(self._app_runtimes.values()):
             rt.shutdown()

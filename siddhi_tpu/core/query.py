@@ -601,6 +601,53 @@ class QueryRuntime:
             if self.latency_tracker is not None:
                 self.latency_tracker.mark_out(len(batch))
 
+    # -- state plumbing (snapshot contract) ---------------------------------
+
+    def snapshot_state(self) -> Dict:
+        """Collect every stateful element of this query (windows in the
+        chain, selector group states, rate limiter, join-side windows,
+        pattern NFA instances) — the analog of the reference's per-query
+        StateHolder walk (util/snapshot/SnapshotService.java:101-169)."""
+        state: Dict = {"selector": self.selector.snapshot()}
+        if hasattr(self.rate_limiter, "snapshot"):
+            state["rate_limiter"] = self.rate_limiter.snapshot()
+        windows = {}
+        for ci, chain in enumerate(self.chains):
+            for pi, p in enumerate(chain):
+                if isinstance(p, WindowChainProcessor):
+                    windows[f"{ci}.{pi}"] = p.window.snapshot()
+        if windows:
+            state["windows"] = windows
+        jr = getattr(self, "join_runtime", None)
+        if jr is not None:
+            jw = {}
+            for label, side in (("left", jr.left), ("right", jr.right)):
+                if side.window is not None:
+                    jw[label] = side.window.snapshot()
+            if jw:
+                state["join_windows"] = jw
+        pp = getattr(self, "pattern_processor", None)
+        if pp is not None:
+            state["pattern"] = pp.snapshot()
+        return state
+
+    def restore_state(self, state: Dict):
+        self.selector.restore(state["selector"])
+        if "rate_limiter" in state and hasattr(self.rate_limiter, "restore"):
+            self.rate_limiter.restore(state["rate_limiter"])
+        for key, ws in state.get("windows", {}).items():
+            ci, pi = (int(x) for x in key.split("."))
+            self.chains[ci][pi].window.restore(ws)
+        jr = getattr(self, "join_runtime", None)
+        if jr is not None:
+            jw = state.get("join_windows", {})
+            for label, side in (("left", jr.left), ("right", jr.right)):
+                if label in jw and side.window is not None:
+                    side.window.restore(jw[label])
+        pp = getattr(self, "pattern_processor", None)
+        if pp is not None and "pattern" in state:
+            pp.restore(state["pattern"])
+
     def on_time(self, now: int, payloads: Optional[EventBatch] = None):
         """Scheduler tick: run time-window evictions through the tail of
         the chain."""

@@ -73,5 +73,7 @@ def extension(kind: str, name: str, namespace: Optional[str] = None):
 def default_registry() -> ExtensionRegistry:
     # import builtin extension modules for their registration side effects
     import siddhi_tpu.ops.windows  # noqa: F401
+    import siddhi_tpu.transport.sink  # noqa: F401
+    import siddhi_tpu.transport.source  # noqa: F401
 
     return _DEFAULT.copy()

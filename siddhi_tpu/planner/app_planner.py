@@ -44,13 +44,46 @@ class AppPlanner:
         self.app_context = SiddhiAppContext(siddhi_context, self.name)
         playback = find_annotation(siddhi_app.annotations, "app:playback")
         if playback is not None:
-            inc = playback.element("increment")
-            self.app_context.set_playback(True, int(inc) if inc else 0)
+            from siddhi_tpu.compiler.parser import parse_time_string
+
+            def time_ms(v):
+                if v is None:
+                    return 0
+                try:
+                    return int(v)
+                except ValueError:
+                    return parse_time_string(v)
+
+            self.app_context.set_playback(True, time_ms(playback.element("increment")))
+            self.app_context.playback_idle_ms = time_ms(playback.element("idle.time"))
+        if find_annotation(siddhi_app.annotations, "app:enforceOrder") is not None:
+            # the sync dispatch path is ordered by construction; the flag is
+            # kept for API parity (reference: SiddhiAppParser.java:199-213)
+            self.app_context.enforce_order = True
+
+        from siddhi_tpu.util.statistics import Level, StatisticsManager
+
+        stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")
+        level = Level.OFF
+        interval_s = 60.0
+        if stats_ann is not None:
+            v = (stats_ann.element() or "true").lower()
+            level = {
+                "true": Level.BASIC, "false": Level.OFF,
+                "basic": Level.BASIC, "detail": Level.DETAIL,
+            }.get(v, Level.BASIC)
+            iv = stats_ann.element("interval")
+            if iv:
+                interval_s = float(iv)
+        self.app_context.root_metrics_level = level
+        self.app_context.statistics_manager = StatisticsManager(self.name, interval_s)
         self.scheduler = Scheduler(self.app_context)
         self.app_context.scheduler = self.scheduler
 
         self.junctions: Dict[str, StreamJunction] = {}
         self.definitions: Dict[str, StreamDefinition] = {}
+        self.sources = []
+        self.sinks = []
         self.query_runtimes: Dict[str, object] = {}
         self.tables: Dict[str, object] = {}  # name -> InMemoryTable
         self.named_windows: Dict[str, object] = {}  # name -> NamedWindowRuntime
@@ -101,7 +134,77 @@ class AppPlanner:
         )
         self.junctions[key] = j
         self.definitions[key] = definition
+        self._attach_transports(definition, j)
         return j
+
+    # -- @source / @sink ----------------------------------------------------
+
+    @staticmethod
+    def _ann_options(ann) -> Dict[str, str]:
+        return {k: v for k, v in ann.elements if k is not None and k.lower() != "type"}
+
+    def _mapper(self, ann, kind: str):
+        """Build the (source|sink) mapper from a nested @map annotation
+        (default passThrough)."""
+        map_ann = ann.nested("map")
+        map_type = map_ann.element("type") if map_ann else None
+        map_type = map_type or "passThrough"
+        factory = self.extensions.lookup(f"{kind}_mapper", map_type)
+        if factory is None:
+            raise SiddhiAppCreationError(f"unknown @map(type='{map_type}') for {kind}")
+        return factory(), self._ann_options(map_ann) if map_ann else {}
+
+    def _attach_transports(self, definition, junction):
+        from siddhi_tpu.transport.sink import DistributedSink, SinkStreamCallback
+
+        for ann in definition.annotations:
+            nm = ann.name.lower()
+            if nm == "source":
+                stype = ann.element("type")
+                if stype is None:
+                    raise SiddhiAppCreationError(
+                        f"@source on '{definition.id}': 'type' is required"
+                    )
+                factory = self.extensions.lookup("source", stype)
+                if factory is None:
+                    raise SiddhiAppCreationError(f"unknown @source(type='{stype}')")
+                mapper, map_opts = self._mapper(ann, "source")
+                mapper.init(definition, map_opts)
+                src = factory()
+                src.init(definition, self._ann_options(ann), mapper, junction, self.app_context)
+                self.sources.append(src)
+            elif nm == "sink":
+                stype = ann.element("type")
+                if stype is None:
+                    raise SiddhiAppCreationError(
+                        f"@sink on '{definition.id}': 'type' is required"
+                    )
+                factory = self.extensions.lookup("sink", stype)
+                if factory is None:
+                    raise SiddhiAppCreationError(f"unknown @sink(type='{stype}')")
+                mapper, map_opts = self._mapper(ann, "sink")
+                mapper.init(definition, map_opts)
+                dist = ann.nested("distribution")
+                if dist is not None:
+                    dests = [
+                        self._ann_options(d)
+                        for d in dist.annotations
+                        if d.name.lower() == "destination"
+                    ]
+                    if not dests:
+                        raise SiddhiAppCreationError(
+                            "@distribution needs at least one @destination"
+                        )
+                    sink = DistributedSink(
+                        factory, dests,
+                        dist.element("strategy") or "roundRobin",
+                        self._ann_options(dist),
+                    )
+                else:
+                    sink = factory()
+                sink.init(definition, self._ann_options(ann), mapper, self.app_context)
+                junction.subscribe(SinkStreamCallback(sink))
+                self.sinks.append(sink)
 
     def get_or_create_junction(
         self, stream_id: str, fallback_def: StreamDefinition, is_inner=False, is_fault=False
@@ -227,6 +330,8 @@ class AppPlanner:
             named_windows=self.named_windows,
             partitions=self.partition_runtimes,
             aggregations=self.aggregations,
+            sources=self.sources,
+            sinks=self.sinks,
         )
 
 

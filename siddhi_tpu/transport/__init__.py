@@ -1,0 +1,31 @@
+from siddhi_tpu.transport.broker import InMemoryBroker
+from siddhi_tpu.transport.source import (
+    InMemorySource,
+    PassThroughSourceMapper,
+    JsonSourceMapper,
+    Source,
+    SourceMapper,
+)
+from siddhi_tpu.transport.sink import (
+    InMemorySink,
+    JsonSinkMapper,
+    LogSink,
+    PassThroughSinkMapper,
+    Sink,
+    SinkMapper,
+)
+
+__all__ = [
+    "InMemoryBroker",
+    "InMemorySource",
+    "InMemorySink",
+    "JsonSinkMapper",
+    "JsonSourceMapper",
+    "LogSink",
+    "PassThroughSinkMapper",
+    "PassThroughSourceMapper",
+    "Sink",
+    "SinkMapper",
+    "Source",
+    "SourceMapper",
+]

@@ -1,0 +1,26 @@
+"""Exponential backoff counter for transport reconnects.
+
+Mirrors the reference ``util/transport/BackoffRetryCounter.java`` (interval
+ladder 5s, 10s, 15s, 30s, 1min, 2min, 5min, capped), scaled by a factor so
+tests can run the ladder in milliseconds.
+"""
+
+from __future__ import annotations
+
+_INTERVALS_MS = [5_000, 10_000, 15_000, 30_000, 60_000, 120_000, 300_000]
+
+
+class BackoffRetryCounter:
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+        self._idx = 0
+
+    def reset(self):
+        self._idx = 0
+
+    def get_time_interval_ms(self) -> int:
+        return int(_INTERVALS_MS[min(self._idx, len(_INTERVALS_MS) - 1)] * self.scale)
+
+    def increment(self):
+        if self._idx < len(_INTERVALS_MS) - 1:
+            self._idx += 1

@@ -1,0 +1,318 @@
+"""Sink SPI: publishing stream output to external transports.
+
+Re-design of the reference ``stream/output/sink/`` (Sink.java:59 —
+publish :174/:243, connectWithRetry :276 with BackoffRetryCounter,
+onError :354; SinkMapper event -> payload; InMemorySink, LogSink;
+distributed/ multi-endpoint strategies): a sink subscribes to its
+stream's junction, maps each event batch to payloads, and publishes.
+Publish failures route through ``on_error`` (drop + log, or raise into
+the junction's @OnError handling).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
+from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+from siddhi_tpu.extension.registry import extension
+from siddhi_tpu.transport.broker import InMemoryBroker
+from siddhi_tpu.transport.retry import BackoffRetryCounter
+
+log = logging.getLogger(__name__)
+
+
+class SinkMapper:
+    """events -> transport payloads (reference: SinkMapper.java)."""
+
+    def init(self, definition, options: Dict[str, str]):
+        self.definition = definition
+        self.options = options
+
+    def map(self, events: List[Event]) -> List:
+        raise NotImplementedError
+
+
+@extension("sink_mapper", "passThrough")
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, events: List[Event]) -> List:
+        return list(events)
+
+
+@extension("sink_mapper", "json")
+class JsonSinkMapper(SinkMapper):
+    """One JSON object string per event (attribute name -> value); the
+    stdlib stand-in for siddhi-map-json."""
+
+    def map(self, events: List[Event]) -> List:
+        import json
+
+        names = self.definition.attribute_names
+
+        def clean(v):
+            import numpy as np
+
+            if isinstance(v, np.generic):
+                return v.item()
+            return v
+
+        return [
+            json.dumps({nm: clean(v) for nm, v in zip(names, e.data)})
+            for e in events
+        ]
+
+
+class Sink:
+    """Transport publisher SPI (reference: Sink.java:59)."""
+
+    def init(self, definition, options: Dict[str, str], mapper: SinkMapper, app_context):
+        self.definition = definition
+        self.options = options
+        self.mapper = mapper
+        self.app_context = app_context
+        self.connected = False
+        self._retry = BackoffRetryCounter(scale=float(options.get("retry.scale", "1.0")))
+        self._retrying = False
+        self._retry_lock = threading.Lock()
+        self._shutdown = False
+
+    # -- SPI ---------------------------------------------------------------
+
+    def connect(self):
+        pass
+
+    def disconnect(self):
+        pass
+
+    def publish(self, payload):
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._shutdown = False
+        self._connect_with_retry()
+
+    def _connect_with_retry(self):
+        # one reconnect chain at a time — a batch of publish failures must
+        # not fan out into parallel perpetual timer chains
+        with self._retry_lock:
+            if self._retrying:
+                return
+            self._retrying = True
+        try:
+            self.connect()
+            self.connected = True
+            self._retry.reset()
+            with self._retry_lock:
+                self._retrying = False
+        except ConnectionUnavailableError as e:
+            interval = self._retry.get_time_interval_ms()
+            self._retry.increment()
+            log.warning(
+                "sink %s on stream '%s' connection failed (%s); retrying in %d ms",
+                type(self).__name__, self.definition.id, e, interval,
+            )
+            t = threading.Timer(interval / 1000.0, self._retry_connect)
+            t.daemon = True
+            self._retry_timer = t
+            t.start()
+
+    def _retry_connect(self):
+        with self._retry_lock:
+            self._retrying = False
+        if not self._shutdown:
+            self._connect_with_retry()
+
+    def shutdown(self):
+        self._shutdown = True
+        t = getattr(self, "_retry_timer", None)
+        if t is not None:
+            t.cancel()
+        if self.connected:
+            self.disconnect()
+            self.connected = False
+
+    # -- junction-facing ---------------------------------------------------
+
+    def send_batch(self, batch: EventBatch):
+        events = events_from_batch(batch)
+        if not events:
+            return
+        for payload in self.mapper.map(events):
+            if not self.connected:
+                self.on_error(payload, ConnectionUnavailableError("not connected"))
+                continue
+            try:
+                self.publish(payload)
+            except ConnectionUnavailableError as e:
+                self.connected = False
+                self.on_error(payload, e)
+                self._connect_with_retry()
+
+    def on_error(self, payload, e: Exception):
+        """Publish-failure hook: default logs and drops (reference
+        Sink.onError:354; the junction's @OnError handling covers
+        processing-chain failures)."""
+        log.error(
+            "sink %s on stream '%s' failed to publish: %s",
+            type(self).__name__, self.definition.id, e,
+        )
+
+
+class SinkStreamCallback:
+    """Junction subscriber adapting batches into a Sink."""
+
+    def __init__(self, sink: Sink):
+        self.sink = sink
+
+    def receive(self, batch: EventBatch):
+        self.sink.send_batch(batch)
+
+
+@extension("sink", "inMemory")
+class InMemorySink(Sink):
+    """Publishes payloads to an InMemoryBroker topic
+    (reference: InMemorySink.java)."""
+
+    def publish(self, payload):
+        topic = self.options.get("topic")
+        InMemoryBroker.publish(topic, payload)
+
+
+@extension("sink", "log")
+class LogSink(Sink):
+    """Logs each event (reference: LogSink.java).  Options: prefix,
+    priority (debug|info|warn|error)."""
+
+    def publish(self, payload):
+        prefix = self.options.get("prefix", f"{self.definition.id} : ")
+        level = {
+            "debug": logging.DEBUG, "info": logging.INFO,
+            "warn": logging.WARNING, "error": logging.ERROR,
+        }.get(self.options.get("priority", "info").lower(), logging.INFO)
+        log.log(level, "%s%s", prefix, payload)
+
+
+# ---------------------------------------------------------------------------
+# Distributed (multi-endpoint) transport
+# ---------------------------------------------------------------------------
+
+
+class DistributionStrategy:
+    """Chooses destination indices per event
+    (reference: stream/output/sink/distributed/DistributionStrategy.java)."""
+
+    def init(self, n_destinations: int, options: Dict[str, str], definition):
+        self.n = n_destinations
+        self.options = options
+        self.definition = definition
+
+    def destinations_for(self, event: Event) -> List[int]:
+        raise NotImplementedError
+
+
+class RoundRobinDistributionStrategy(DistributionStrategy):
+    def init(self, n_destinations, options, definition):
+        super().init(n_destinations, options, definition)
+        self._i = 0
+
+    def destinations_for(self, event: Event) -> List[int]:
+        d = self._i % self.n
+        self._i += 1
+        return [d]
+
+
+class PartitionedDistributionStrategy(DistributionStrategy):
+    """Hashes the ``partitionKey`` attribute onto a destination
+    (reference: PartitionedDistributionStrategy.java).  Uses crc32, not
+    Python's per-process-randomized hash(), so a key maps to the same
+    destination across restarts."""
+
+    def init(self, n_destinations, options, definition):
+        super().init(n_destinations, options, definition)
+        key = options.get("partitionKey")
+        if key is None or key not in definition.attribute_names:
+            raise ValueError(
+                "partitioned distribution needs a 'partitionKey' option "
+                "naming a stream attribute"
+            )
+        self._idx = definition.attribute_names.index(key)
+
+    def destinations_for(self, event: Event) -> List[int]:
+        import zlib
+
+        return [zlib.crc32(str(event.data[self._idx]).encode()) % self.n]
+
+
+class BroadcastDistributionStrategy(DistributionStrategy):
+    def destinations_for(self, event: Event) -> List[int]:
+        return list(range(self.n))
+
+
+_STRATEGIES = {
+    "roundrobin": RoundRobinDistributionStrategy,
+    "partitioned": PartitionedDistributionStrategy,
+    "broadcast": BroadcastDistributionStrategy,
+}
+
+
+class DistributedSink(Sink):
+    """One logical sink fanned out over N destination connections
+    (reference: distributed/DistributedTransport.java + strategies).
+
+    Built from ``@sink(..., @distribution(strategy='...',
+    @destination(...), ...))``: each @destination's options overlay the
+    parent sink options for its child connection.
+    """
+
+    def __init__(self, child_factory, destination_options: List[Dict[str, str]],
+                 strategy_name: str, strategy_options: Dict[str, str]):
+        cls = _STRATEGIES.get(strategy_name.lower().replace("_", ""))
+        if cls is None:
+            raise ValueError(f"unknown distribution strategy '{strategy_name}'")
+        self._child_factory = child_factory
+        self._destination_options = destination_options
+        self.strategy: DistributionStrategy = cls()
+        self._strategy_options = strategy_options
+        self.children: List[Sink] = []
+
+    def init(self, definition, options, mapper, app_context):
+        super().init(definition, options, mapper, app_context)
+        self.strategy.init(
+            len(self._destination_options),
+            {**options, **self._strategy_options},
+            definition,
+        )
+        for dest in self._destination_options:
+            child = self._child_factory()
+            child.init(definition, {**options, **dest}, mapper, app_context)
+            self.children.append(child)
+
+    def start(self):
+        for c in self.children:
+            c.start()
+
+    def shutdown(self):
+        for c in self.children:
+            c.shutdown()
+
+    def send_batch(self, batch: EventBatch):
+        events = events_from_batch(batch)
+        if not events:
+            return
+        payloads = self.mapper.map(events)
+        for event, payload in zip(events, payloads):
+            for d in self.strategy.destinations_for(event):
+                child = self.children[d]
+                if not child.connected:
+                    child.on_error(payload, ConnectionUnavailableError("not connected"))
+                    continue
+                try:
+                    child.publish(payload)
+                except ConnectionUnavailableError as e:
+                    child.connected = False
+                    child.on_error(payload, e)
+                    child._connect_with_retry()

@@ -1,0 +1,203 @@
+"""Source SPI: external-transport receivers feeding a stream.
+
+Re-design of the reference ``stream/input/source/`` (Source.java:50 —
+lifecycle init/connect-with-retry/pause/resume/disconnect,
+SourceMapper.java payload -> Event mapping, InMemorySource.java): a
+source owns a transport connection and pushes mapped events into its
+stream's junction.  Pausing (used while a snapshot is taken) buffers
+incoming payloads and replays them on resume.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+from siddhi_tpu.extension.registry import extension
+from siddhi_tpu.transport.broker import InMemoryBroker, Subscriber
+from siddhi_tpu.transport.retry import BackoffRetryCounter
+
+log = logging.getLogger(__name__)
+
+
+class SourceMapper:
+    """payload -> List[Event] (reference: SourceMapper.java)."""
+
+    def init(self, definition, options: Dict[str, str]):
+        self.definition = definition
+        self.options = options
+
+    def map(self, payload) -> List[Event]:
+        raise NotImplementedError
+
+
+@extension("source_mapper", "passThrough")
+class PassThroughSourceMapper(SourceMapper):
+    """Payload already is an Event / row / list thereof
+    (reference: PassThroughSourceMapper.java)."""
+
+    def map(self, payload) -> List[Event]:
+        if isinstance(payload, Event):
+            return [payload]
+        if isinstance(payload, (list, tuple)):
+            if payload and isinstance(payload[0], Event):
+                return list(payload)
+            return [Event(data=list(payload))]
+        raise ValueError(f"passThrough mapper: cannot map {type(payload).__name__}")
+
+
+@extension("source_mapper", "json")
+class JsonSourceMapper(SourceMapper):
+    """JSON object / array of objects -> events by attribute name.
+
+    A stdlib stand-in for the reference's siddhi-map-json extension; the
+    optional ``enclosing.element`` option selects a nested list/object.
+    """
+
+    def map(self, payload) -> List[Event]:
+        import json
+
+        obj = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+        enclosing = self.options.get("enclosing.element")
+        if enclosing:
+            obj = obj[enclosing]
+        rows = obj if isinstance(obj, list) else [obj]
+        names = self.definition.attribute_names
+        return [Event(data=[r.get(nm) for nm in names]) for r in rows]
+
+
+class Source:
+    """Transport receiver SPI (reference: Source.java:50).
+
+    Subclasses implement connect / disconnect and call ``self.deliver``
+    with raw payloads.
+    """
+
+    def init(self, definition, options: Dict[str, str], mapper: SourceMapper,
+             junction, app_context):
+        self.definition = definition
+        self.options = options
+        self.mapper = mapper
+        self.junction = junction
+        self.app_context = app_context
+        self.connected = False
+        self._paused = False
+        self._pause_buffer: List = []
+        self._lock = threading.Lock()
+        self._retry = BackoffRetryCounter(
+            scale=float(options.get("retry.scale", "1.0"))
+        )
+        self._shutdown = False
+
+    # -- SPI ---------------------------------------------------------------
+
+    def connect(self):
+        raise NotImplementedError
+
+    def disconnect(self):
+        pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._shutdown = False
+        self._connect_with_retry()
+
+    def _connect_with_retry(self):
+        try:
+            self.connect()
+            self.connected = True
+            self._retry.reset()
+        except ConnectionUnavailableError as e:
+            interval = self._retry.get_time_interval_ms()
+            self._retry.increment()
+            log.warning(
+                "source %s on stream '%s' connection failed (%s); retrying in %d ms",
+                type(self).__name__, self.definition.id, e, interval,
+            )
+            t = threading.Timer(interval / 1000.0, self._retry_connect)
+            t.daemon = True
+            self._retry_timer = t
+            t.start()
+
+    def _retry_connect(self):
+        if not self._shutdown:
+            self._connect_with_retry()
+
+    def pause(self):
+        self._paused = True
+
+    def resume(self):
+        # drain the pause buffer BEFORE lifting the pause: payloads arriving
+        # mid-replay keep buffering behind the older ones, preserving order
+        while True:
+            with self._lock:
+                if not self._pause_buffer:
+                    self._paused = False
+                    return
+                buffered, self._pause_buffer = self._pause_buffer, []
+            for p in buffered:
+                events = self.mapper.map(p)
+                if events:
+                    self._send_events(events)
+
+    def shutdown(self):
+        self._shutdown = True
+        t = getattr(self, "_retry_timer", None)
+        if t is not None:
+            t.cancel()
+        if self.connected:
+            self.disconnect()
+            self.connected = False
+
+    # -- delivery ----------------------------------------------------------
+
+    def deliver(self, payload):
+        """Transport thread entry: map and push into the junction."""
+        with self._lock:
+            if self._paused:
+                self._pause_buffer.append(payload)
+                return
+        events = self.mapper.map(payload)
+        if events:
+            self._send_events(events)
+
+    def _send_events(self, events: List[Event]):
+        from siddhi_tpu.core.stream import InputHandler
+
+        handler = getattr(self, "_handler", None)
+        if handler is None:
+            handler = self._handler = InputHandler(self.junction, self.app_context)
+        handler.send(events)
+
+
+@extension("source", "inMemory")
+class InMemorySource(Source):
+    """Subscribes its stream to an InMemoryBroker topic
+    (reference: InMemorySource.java)."""
+
+    def connect(self):
+        topic = self.options.get("topic")
+        if topic is None:
+            raise ConnectionUnavailableError(
+                f"inMemory source on '{self.definition.id}': 'topic' option required"
+            )
+        src = self
+
+        class _Sub(Subscriber):
+            def on_message(self, message):
+                src.deliver(message)
+
+            def get_topic(self) -> str:
+                return topic
+
+        self._subscriber = _Sub()
+        InMemoryBroker.subscribe(self._subscriber)
+
+    def disconnect(self):
+        sub = getattr(self, "_subscriber", None)
+        if sub is not None:
+            InMemoryBroker.unsubscribe(sub)

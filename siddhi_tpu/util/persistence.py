@@ -1,0 +1,119 @@
+"""Persistence stores: where serialized app snapshots live.
+
+Re-design of the reference ``util/persistence/``
+(InMemoryPersistenceStore.java, FileSystemPersistenceStore.java,
+PersistenceHelper.java): a store maps (app name, revision) -> bytes,
+where revision = ``<epoch_ms>_<app name>`` so lexicographic-by-timestamp
+ordering gives the latest revision.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+class PersistenceStore:
+    """SPI: save / load / last revision / clear for one app's snapshots."""
+
+    def save(self, app_name: str, revision: str, snapshot: bytes):
+        raise NotImplementedError
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str):
+        raise NotImplementedError
+
+
+class InMemoryPersistenceStore(PersistenceStore):
+    """Keeps every revision in a process-local dict
+    (reference: InMemoryPersistenceStore.java)."""
+
+    def __init__(self):
+        self._store: Dict[str, Dict[str, bytes]] = {}
+        self._lock = threading.Lock()
+
+    def save(self, app_name: str, revision: str, snapshot: bytes):
+        with self._lock:
+            self._store.setdefault(app_name, {})[revision] = snapshot
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(app_name, {}).get(revision)
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            revs = self._store.get(app_name)
+            if not revs:
+                return None
+            return max(revs, key=lambda r: int(r.split("_", 1)[0]))
+
+    def clear_all_revisions(self, app_name: str):
+        with self._lock:
+            self._store.pop(app_name, None)
+
+
+class FileSystemPersistenceStore(PersistenceStore):
+    """One file per revision under ``<base>/<app>/<revision>``
+    (reference: FileSystemPersistenceStore.java).  Keeps the newest
+    ``revisions_to_keep`` files (reference default 3)."""
+
+    def __init__(self, base_dir: str, revisions_to_keep: int = 3):
+        self.base_dir = base_dir
+        self.revisions_to_keep = revisions_to_keep
+        self._lock = threading.Lock()
+
+    def _app_dir(self, app_name: str) -> str:
+        return os.path.join(self.base_dir, app_name)
+
+    def _revisions(self, app_name: str) -> List[str]:
+        d = self._app_dir(app_name)
+        if not os.path.isdir(d):
+            return []
+        # .tmp files are crash leftovers from an interrupted save
+        revs = [f for f in os.listdir(d) if "_" in f and not f.endswith(".tmp")]
+        return sorted(revs, key=lambda r: int(r.split("_", 1)[0]))
+
+    def save(self, app_name: str, revision: str, snapshot: bytes):
+        with self._lock:
+            d = self._app_dir(app_name)
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, revision + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(snapshot)
+            os.replace(tmp, os.path.join(d, revision))
+            # evict oldest beyond the keep count
+            revs = self._revisions(app_name)
+            for old in revs[: max(0, len(revs) - self.revisions_to_keep)]:
+                try:
+                    os.remove(os.path.join(d, old))
+                except OSError:
+                    pass
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        path = os.path.join(self._app_dir(app_name), revision)
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            revs = self._revisions(app_name)
+            return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name: str):
+        with self._lock:
+            d = self._app_dir(app_name)
+            if not os.path.isdir(d):
+                return
+            for f in os.listdir(d):
+                try:
+                    os.remove(os.path.join(d, f))
+                except OSError:
+                    pass

@@ -1,0 +1,181 @@
+"""Statistics: throughput / latency / buffer metrics.
+
+Re-design of the reference ``util/statistics/`` (SiddhiStatisticsManager
+behind Dropwizard MetricRegistry, ThroughputTracker per junction,
+LatencyTracker marked in/out around each query chain, Level
+OFF/BASIC/DETAIL from @app:statistics, runtime-switchable): plain host
+counters — the event path is micro-batched, so tracker overhead is one
+increment per batch, not per event.
+
+Metric naming follows the reference convention
+``io.siddhi.SiddhiApps.<app>.Siddhi.<kind>.<name>.<metric>``
+(SiddhiAppRuntimeImpl.registerForBufferedEvents:802-821).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Level:
+    OFF = "off"
+    BASIC = "basic"
+    DETAIL = "detail"
+
+    _ORDER = {OFF: 0, BASIC: 1, DETAIL: 2}
+
+    @classmethod
+    def at_least(cls, level: str, needed: str) -> bool:
+        return cls._ORDER.get(level, 0) >= cls._ORDER[needed]
+
+
+class ThroughputTracker:
+    """Events-seen counter with a rate over the elapsed window
+    (reference: util/statistics/ThroughputTracker)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self._start = time.monotonic()
+
+    def add(self, n: int):
+        self.count += n
+
+    def events_per_second(self) -> float:
+        dt = time.monotonic() - self._start
+        return self.count / dt if dt > 0 else 0.0
+
+    def reset(self):
+        self.count = 0
+        self._start = time.monotonic()
+
+
+class LatencyTracker:
+    """Per-query in-pipeline latency, marked around the chain
+    (reference: util/statistics/LatencyTracker +
+    ProcessStreamReceiver.java:79-87)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.batches = 0
+        self.events = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._t0 = None
+
+    def mark_in(self, n_events: int):
+        self._t0 = time.perf_counter()
+        self.events += n_events
+
+    def mark_out(self, n_events: int):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.batches += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+
+    def avg_ms(self) -> float:
+        return (self.total_s / self.batches) * 1000.0 if self.batches else 0.0
+
+    def max_ms(self) -> float:
+        return self.max_s * 1000.0
+
+    def reset(self):
+        self.batches = 0
+        self.events = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+
+class BufferedEventsTracker:
+    """Async-junction queue depth gauge (reference: buffer gauges in
+    SiddhiAppRuntimeImpl.registerForBufferedEvents)."""
+
+    def __init__(self, name: str, junction):
+        self.name = name
+        self.junction = junction
+
+    def buffered(self) -> int:
+        q = getattr(self.junction, "_queue", None)
+        return q.qsize() if q is not None else 0
+
+
+class StatisticsManager:
+    """Tracker registry + periodic console reporter
+    (reference: util/statistics/metrics/SiddhiStatisticsManager.java:35)."""
+
+    def __init__(self, app_name: str, interval_s: float = 60.0):
+        self.app_name = app_name
+        self.interval_s = interval_s
+        self.throughput: Dict[str, ThroughputTracker] = {}
+        self.latency: Dict[str, LatencyTracker] = {}
+        self.buffers: Dict[str, BufferedEventsTracker] = {}
+        self._reporter: Optional[threading.Thread] = None
+        self._running = False
+        # generation counter: a restarted reporter invalidates the old
+        # thread even if it is still asleep inside its interval
+        self._generation = 0
+
+    def _metric(self, kind: str, name: str, metric: str) -> str:
+        return f"io.siddhi.SiddhiApps.{self.app_name}.Siddhi.{kind}.{name}.{metric}"
+
+    def throughput_tracker(self, name: str) -> ThroughputTracker:
+        return self.throughput.setdefault(name, ThroughputTracker(name))
+
+    def latency_tracker(self, name: str) -> LatencyTracker:
+        return self.latency.setdefault(name, LatencyTracker(name))
+
+    def buffer_tracker(self, name: str, junction) -> BufferedEventsTracker:
+        return self.buffers.setdefault(name, BufferedEventsTracker(name, junction))
+
+    def stats(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for t in self.throughput.values():
+            out[self._metric("Streams", t.name, "throughput")] = t.events_per_second()
+            out[self._metric("Streams", t.name, "totalEvents")] = t.count
+        for l in self.latency.values():
+            out[self._metric("Queries", l.name, "latencyAvgMs")] = l.avg_ms()
+            out[self._metric("Queries", l.name, "latencyMaxMs")] = l.max_ms()
+            out[self._metric("Queries", l.name, "events")] = l.events
+        for b in self.buffers.values():
+            out[self._metric("Streams", b.name, "bufferedEvents")] = b.buffered()
+        return out
+
+    def reset(self):
+        for t in self.throughput.values():
+            t.reset()
+        for l in self.latency.values():
+            l.reset()
+
+    # -- console reporter ---------------------------------------------------
+
+    def start_reporting(self):
+        import logging
+
+        if self._running:
+            return
+        self._running = True
+        self._generation += 1
+        gen = self._generation
+        log = logging.getLogger(__name__)
+
+        def loop():
+            while self._running and gen == self._generation:
+                time.sleep(self.interval_s)
+                if not self._running or gen != self._generation:
+                    break
+                for k, v in sorted(self.stats().items()):
+                    log.info("%s = %s", k, v)
+
+        self._reporter = threading.Thread(
+            target=loop, name=f"stats-{self.app_name}", daemon=True
+        )
+        self._reporter.start()
+
+    def stop_reporting(self):
+        self._running = False
+        self._generation += 1

@@ -1,0 +1,166 @@
+"""Persistence / snapshot conformance tests.
+
+Modeled on the reference managment suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/managment/
+PersistenceTestCase / SnapshotableEventQueueTestCase): persist a running
+app, keep sending events, restore, and assert the state rolled back to
+the revision point.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.util.persistence import (
+    FileSystemPersistenceStore,
+    InMemoryPersistenceStore,
+)
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    yield m
+    m.shutdown()
+
+
+def test_persist_restore_count_window(manager):
+    app = (
+        "@app:name('persistApp') "
+        "define stream S (symbol string, price float); "
+        "@info(name='q') from S#window.length(10) "
+        "select symbol, count() as n insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in (ins or [])))
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["A", 2.0])
+    assert got[-1][1] == 2
+    revision = rt.persist()
+    h.send(["A", 3.0])
+    assert got[-1][1] == 3
+    rt.restore_revision(revision)
+    h.send(["A", 9.0])
+    # count resumes from the persisted 2, not from 3
+    assert got[-1][1] == 3
+
+
+def test_restore_last_revision_table(manager):
+    app = (
+        "@app:name('tableApp') "
+        "define stream S (symbol string, volume long); "
+        "define table T (symbol string, volume long); "
+        "from S insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 1])
+    rt.persist()
+    h.send(["B", 2])
+    assert len(rt.query("from T select symbol;")) == 2
+    rt.restore_last_revision()
+    assert [e.data for e in rt.query("from T select symbol;")] == [["A"]]
+
+
+def test_restore_last_revision_picks_newest(manager):
+    app = (
+        "@app:name('revApp') "
+        "define stream S (v long); "
+        "define table T (v long); "
+        "from S insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1])
+    import time
+
+    rt.persist()
+    time.sleep(0.002)  # distinct revision timestamps
+    h.send([2])
+    rt.persist()
+    h.send([3])
+    rt.restore_last_revision()
+    assert sorted(e.data[0] for e in rt.query("from T select v;")) == [1, 2]
+
+
+def test_pattern_state_survives_restore(manager):
+    app = (
+        "@app:name('patternApp') "
+        "define stream S (sym string, v double); "
+        "@info(name='q') from every a=S[v > 10.0] -> b=S[v > a.v] "
+        "select a.v as av, b.v as bv insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in (ins or [])))
+    h = rt.get_input_handler("S")
+    h.send(["A", 20.0])  # arms a=20
+    rev = rt.persist()
+    rt.restore_revision(rev)
+    h.send(["A", 30.0])  # must still complete the armed partial match
+    assert [20.0, 30.0] in got
+
+
+def test_aggregation_state_survives_restore(manager):
+    BASE = 1_496_289_720_000
+    app = (
+        "@app:name('aggApp') "
+        "define stream S (v double, ts long); "
+        "define aggregation A from S select sum(v) as total "
+        "aggregate by ts every sec, min;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1.0, BASE])
+    rev = rt.persist()
+    h.send([2.0, BASE + 100])
+    rt.restore_revision(rev)
+    h.send([4.0, BASE + 200])
+    b = rt.aggregations["A"].find("seconds")
+    assert float(b.columns["total"][0]) == 5.0  # 1 + 4, the 2 rolled back
+
+
+def test_filesystem_store_keeps_limited_revisions(tmp_path):
+    m = SiddhiManager()
+    store = FileSystemPersistenceStore(str(tmp_path), revisions_to_keep=2)
+    m.set_persistence_store(store)
+    app = (
+        "@app:name('fsApp') "
+        "define stream S (v long); define table T (v long); "
+        "from S insert into T;"
+    )
+    rt = m.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    import time
+
+    revs = []
+    for i in range(4):
+        h.send([i])
+        revs.append(rt.persist())
+        time.sleep(0.002)
+    assert store.load("fsApp", revs[0]) is None  # evicted
+    assert store.get_last_revision("fsApp") == revs[-1]
+    rt.restore_last_revision()
+    assert sorted(e.data[0] for e in rt.query("from T select v;")) == [0, 1, 2, 3]
+    store.clear_all_revisions("fsApp")
+    assert store.get_last_revision("fsApp") is None
+    m.shutdown()
+
+
+def test_persist_without_store_raises():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("define stream S (v long); from S select v insert into O;")
+    rt.start()
+    from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
+
+    with pytest.raises(SiddhiAppRuntimeError):
+        rt.persist()
+    m.shutdown()

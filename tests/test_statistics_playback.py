@@ -1,0 +1,103 @@
+"""Statistics + playback-mode conformance tests.
+
+Modeled on the reference managment suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/managment/
+StatisticsTestCase / PlayBackTestCase): @app:statistics installs
+throughput/latency trackers; @app:playback drives windows on event time,
+with the idle heartbeat draining them when input stops.
+"""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def test_statistics_trackers(manager):
+    app = (
+        "@app:name('statApp') @app:statistics('true') "
+        "define stream S (v long); "
+        "@info(name='q') from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(5):
+        h.send([i])
+    stats = rt.statistics()
+    assert stats["io.siddhi.SiddhiApps.statApp.Siddhi.Streams.S.totalEvents"] == 5
+    assert stats["io.siddhi.SiddhiApps.statApp.Siddhi.Queries.q.events"] == 5
+    assert stats["io.siddhi.SiddhiApps.statApp.Siddhi.Queries.q.latencyAvgMs"] >= 0
+
+
+def test_statistics_level_switchable(manager):
+    app = (
+        "@app:name('switchApp') "
+        "define stream S (v long); "
+        "@info(name='q') from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1])
+    assert rt.statistics() == {}  # off by default
+    rt.set_statistics_level("basic")
+    h.send([2])
+    stats = rt.statistics()
+    assert stats["io.siddhi.SiddhiApps.switchApp.Siddhi.Streams.S.totalEvents"] == 1
+    rt.set_statistics_level("off")
+    h.send([3])
+    assert rt.statistics() == {}  # downgrade drops the trackers
+
+
+def test_playback_time_window_event_time(manager):
+    """Windows run on event timestamps in playback mode
+    (reference: PlayBackTestCase.playBackTest1)."""
+    app = (
+        "@app:playback "
+        "define stream S (symbol string, price float); "
+        "@info(name='q') from S#window.time(1 sec) "
+        "select symbol, count() as n insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in (ins or [])))
+    h = rt.get_input_handler("S")
+    t0 = 1_500_000_000_000
+    h.send(["A", 1.0], timestamp=t0)
+    h.send(["B", 2.0], timestamp=t0 + 100)
+    assert got[-1][1] == 2
+    # jump event time 2s forward: first two must have expired from the window
+    h.send(["C", 3.0], timestamp=t0 + 2100)
+    assert got[-1][1] == 1
+
+
+def test_playback_idle_heartbeat_drains_window(manager):
+    """With idle.time/increment, event time advances without events
+    (reference: PlayBackTestCase heartbeat test)."""
+    app = (
+        "@app:playback(idle.time='50 millisecond', increment='1 sec') "
+        "define stream S (symbol string); "
+        "@info(name='q') from S#window.timeBatch(1 sec) "
+        "select count() as n insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in (ins or [])))
+    h = rt.get_input_handler("S")
+    h.send(["A"], timestamp=1_500_000_000_000)
+    # no further events: the heartbeat must advance event time and flush
+    deadline = time.time() + 3
+    while not got and time.time() < deadline:
+        time.sleep(0.02)
+    assert got and got[-1][0] == 1

@@ -1,0 +1,219 @@
+"""Source/Sink transport conformance tests.
+
+Modeled on the reference transport corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/transport/
+InMemoryTransportTestCase / MultiClientDistributedSinkTestCase /
+TestFailingInMemorySink): the in-memory broker is the transport double;
+@source/@sink annotated streams exchange events through topics.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+from siddhi_tpu.transport import InMemoryBroker
+from siddhi_tpu.transport.broker import FunctionSubscriber
+
+
+@pytest.fixture
+def manager():
+    InMemoryBroker.clear()
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.clear()
+
+
+def test_inmemory_source_to_query(manager):
+    app = (
+        "@source(type='inMemory', topic='stocks') "
+        "define stream S (symbol string, price float); "
+        "@info(name='q') from S[price > 50.0] select symbol insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in (ins or [])))
+    rt.start()
+    InMemoryBroker.publish("stocks", ["IBM", 75.0])
+    InMemoryBroker.publish("stocks", ["WSO2", 45.0])
+    InMemoryBroker.publish("stocks", Event(data=["GOOG", 60.0]))
+    assert got == [["IBM"], ["GOOG"]]
+
+
+def test_inmemory_sink_publishes(manager):
+    app = (
+        "define stream S (symbol string, price float); "
+        "@sink(type='inMemory', topic='out') "
+        "define stream Out (symbol string); "
+        "from S select symbol insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    received = []
+    InMemoryBroker.subscribe(FunctionSubscriber("out", received.append))
+    rt.get_input_handler("S").send(["IBM", 10.0])
+    assert len(received) == 1 and received[0].data == ["IBM"]
+
+
+def test_json_mappers_roundtrip(manager):
+    app = (
+        "@source(type='inMemory', topic='in', @map(type='json')) "
+        "define stream S (symbol string, volume long); "
+        "@sink(type='inMemory', topic='out', @map(type='json')) "
+        "define stream Out (symbol string, volume long); "
+        "from S select symbol, volume insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    received = []
+    InMemoryBroker.subscribe(FunctionSubscriber("out", received.append))
+    InMemoryBroker.publish("in", '{"symbol": "IBM", "volume": 100}')
+    InMemoryBroker.publish("in", '[{"symbol": "A", "volume": 1}, {"symbol": "B", "volume": 2}]')
+    import json
+
+    assert [json.loads(r) for r in received] == [
+        {"symbol": "IBM", "volume": 100},
+        {"symbol": "A", "volume": 1},
+        {"symbol": "B", "volume": 2},
+    ]
+
+
+def test_source_pause_resume_on_persist(manager):
+    from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+    manager.set_persistence_store(InMemoryPersistenceStore())
+    app = (
+        "@app:name('p') "
+        "@source(type='inMemory', topic='t') "
+        "define stream S (v long); "
+        "define table T (v long); from S insert into T;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    InMemoryBroker.publish("t", [1])
+    rt.persist()
+    InMemoryBroker.publish("t", [2])
+    assert sorted(e.data[0] for e in rt.query("from T select v;")) == [1, 2]
+
+
+def test_roundrobin_distributed_sink(manager):
+    app = (
+        "define stream S (v long); "
+        "@sink(type='inMemory', @distribution(strategy='roundRobin', "
+        "@destination(topic='d1'), @destination(topic='d2'))) "
+        "define stream Out (v long); "
+        "from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    d1, d2 = [], []
+    InMemoryBroker.subscribe(FunctionSubscriber("d1", d1.append))
+    InMemoryBroker.subscribe(FunctionSubscriber("d2", d2.append))
+    h = rt.get_input_handler("S")
+    for i in range(4):
+        h.send([i])
+    assert [e.data[0] for e in d1] == [0, 2]
+    assert [e.data[0] for e in d2] == [1, 3]
+
+
+def test_partitioned_distributed_sink(manager):
+    app = (
+        "define stream S (sym string, v long); "
+        "@sink(type='inMemory', @distribution(strategy='partitioned', "
+        "partitionKey='sym', @destination(topic='p1'), @destination(topic='p2'))) "
+        "define stream Out (sym string, v long); "
+        "from S select sym, v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    p1, p2 = [], []
+    InMemoryBroker.subscribe(FunctionSubscriber("p1", p1.append))
+    InMemoryBroker.subscribe(FunctionSubscriber("p2", p2.append))
+    h = rt.get_input_handler("S")
+    for sym, v in [("A", 1), ("B", 2), ("A", 3), ("B", 4)]:
+        h.send([sym, v])
+    # every event delivered exactly once, each key pinned to one destination
+    assert len(p1) + len(p2) == 4
+    seen = {}
+    for topic, events in (("p1", p1), ("p2", p2)):
+        for e in events:
+            seen.setdefault(e.data[0], set()).add(topic)
+    assert all(len(topics) == 1 for topics in seen.values())
+
+
+def test_broadcast_distributed_sink(manager):
+    app = (
+        "define stream S (v long); "
+        "@sink(type='inMemory', @distribution(strategy='broadcast', "
+        "@destination(topic='b1'), @destination(topic='b2'))) "
+        "define stream Out (v long); "
+        "from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    b1, b2 = [], []
+    InMemoryBroker.subscribe(FunctionSubscriber("b1", b1.append))
+    InMemoryBroker.subscribe(FunctionSubscriber("b2", b2.append))
+    rt.get_input_handler("S").send([7])
+    assert len(b1) == 1 and len(b2) == 1
+
+
+def test_failing_sink_drops_and_logs(manager):
+    """Publish failure must not break the processing chain
+    (reference: TestFailingInMemorySink + Sink.onError)."""
+    from siddhi_tpu.transport.sink import Sink
+
+    published, failed = [], []
+
+    class FailingSink(Sink):
+        def publish(self, payload):
+            if len(failed) < 1:
+                failed.append(payload)
+                raise ConnectionUnavailableError("transport down")
+            published.append(payload)
+
+    manager.set_extension("failing", FailingSink, kind="sink")
+    app = (
+        "define stream S (v long); "
+        "@sink(type='failing', topic='x', retry.scale='0.0001') "
+        "define stream Out (v long); "
+        "from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1])  # fails, dropped
+    h.send([2])  # succeeds
+    assert len(failed) == 1 and len(published) == 1
+    assert published[0].data == [2]
+
+
+def test_source_connect_retry(manager):
+    """A source whose connect fails keeps retrying with backoff
+    (reference: Source.connectWithRetry)."""
+    import time
+
+    from siddhi_tpu.transport.source import Source
+
+    attempts = []
+
+    class FlakySource(Source):
+        def connect(self):
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise ConnectionUnavailableError("not yet")
+
+    manager.set_extension("flaky", FlakySource, kind="source")
+    app = (
+        "@source(type='flaky', retry.scale='0.0001') "
+        "define stream S (v long); "
+        "from S select v insert into Out;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    deadline = time.time() + 2
+    while len(attempts) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(attempts) >= 2
+    assert rt.sources[0].connected
