@@ -843,3 +843,316 @@ class SessionWindow(WindowProcessor):
 
     def restore(self, state):
         self._sessions = state["sessions"]
+
+
+@extension("window", "cron")
+class CronWindow(WindowProcessor):
+    """Cron-scheduled tumbling batch window (reference:
+    CronWindowProcessor.java:187-225 dispatchEvents): events are held
+    until the cron expression fires; at each fire the previous batch is
+    expired (timestamped at fire time) and the held batch is emitted as
+    CURRENT, becoming the next expired set."""
+
+    needs_scheduler = True
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        from siddhi_tpu.core.trigger import CronSchedule
+
+        expr = args[0].fn({})
+        if not isinstance(expr, str):
+            raise SiddhiAppCreationError("cron window expects a cron-expression string")
+        self._cron = CronSchedule(expr)
+        self._pending: Optional[EventBatch] = None
+        self._last_flushed: Optional[EventBatch] = None
+        self._next_fire: Optional[int] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._pending is None:
+            self._pending = _empty_like(cur)
+        if self._next_fire is None:
+            self._next_fire = self._cron.next_fire(now)
+        if len(cur):
+            self._pending = EventBatch.concat([self._pending, cur])
+        return _empty_like(cur)
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        if self._next_fire is None or now < self._next_fire:
+            return None
+        fire = self._next_fire
+        self._next_fire = self._cron.next_fire(now)
+        if len(self._pending or ()) == 0 and len(self._last_flushed or ()) == 0:
+            return None
+        outs: List[EventBatch] = []
+        if self._last_flushed is not None and len(self._last_flushed):
+            exp = self._last_flushed.with_types(ev.EXPIRED)
+            exp.timestamps = np.full(len(exp), fire, dtype=np.int64)
+            outs.append(exp)
+            outs.append(reset_marker(self._last_flushed, fire))
+        flush = self._pending
+        if len(flush):
+            outs.append(flush)
+        self._last_flushed = flush
+        self._pending = _empty_like(flush)
+        return EventBatch.concat(outs) if outs else None
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._next_fire
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._pending
+
+    def snapshot(self):
+        return {"pending": self._pending, "last": self._last_flushed, "next": self._next_fire}
+
+    def restore(self, state):
+        self._pending, self._last_flushed, self._next_fire = (
+            state["pending"], state["last"], state["next"]
+        )
+
+
+class _WindowExprEval:
+    """Evaluator for expression/expressionBatch window retention
+    expressions (reference: ExpressionWindowProcessor.java:68-103).
+
+    The expression string is parsed with the SiddhiQL expression grammar
+    and evaluated against the current buffer: bare attributes and
+    ``last.attr`` read the newest event, ``first.attr`` the oldest;
+    ``count()``, ``sum/min/max/avg(attr)`` aggregate over the buffer;
+    ``eventTimestamp(first|last)`` reads buffer timestamps."""
+
+    _AGGS = {"sum": np.sum, "min": np.min, "max": np.max, "avg": np.mean}
+
+    def __init__(self, expr_string: str, attribute_names: List[str]):
+        from siddhi_tpu.compiler.parser import Parser
+        from siddhi_tpu.compiler.tokenizer import tokenize
+        from siddhi_tpu.query_api import expression as X
+
+        self.X = X
+        self.attribute_names = set(attribute_names)
+        toks = tokenize(expr_string)
+        self.ast = Parser(toks).parse_expression()
+        self._validate(self.ast)
+
+    def _validate(self, e):
+        """Reject unknown attributes at app-creation time, not on the
+        first event."""
+        X = self.X
+        if isinstance(e, X.Variable):
+            # first/last refs and bare names must be stream attributes;
+            # bare 'first'/'last' only appear as eventTimestamp() args,
+            # which are handled before recursion below
+            if e.stream_id in (None, "first", "last") and e.attribute not in self.attribute_names:
+                raise SiddhiAppCreationError(
+                    f"expression window: unknown attribute '{e.attribute}'")
+            return
+        if isinstance(e, X.FunctionCall):
+            if e.name == "eventTimestamp":
+                return  # args are first/last selectors, not attributes
+            for a in e.args:
+                self._validate(a)
+            return
+        for attr in ("left", "right", "expr"):
+            child = getattr(e, attr, None)
+            if isinstance(child, X.Expression):
+                self._validate(child)
+
+    def __call__(self, buf: EventBatch, start: int = 0) -> bool:
+        """Evaluate over ``buf[start:]`` without materializing a copy —
+        numpy slices below are views, so eviction scans stay O(n)."""
+        if len(buf) - start <= 0:
+            return True
+        return bool(self._ev(self.ast, buf, start))
+
+    def _col(self, buf: EventBatch, attr: str, pos: int, start: int):
+        if attr not in buf.columns:
+            raise SiddhiAppCreationError(f"expression window: unknown attribute '{attr}'")
+        return buf.columns[attr][start if pos == 0 else -1]
+
+    def _ev(self, e, buf: EventBatch, start: int):
+        X = self.X
+        if isinstance(e, X.Constant):
+            return e.value
+        if isinstance(e, X.TimeConstant):
+            return e.value
+        if isinstance(e, X.Variable):
+            if e.stream_id in ("first", "last"):
+                return self._col(buf, e.attribute, 0 if e.stream_id == "first" else -1, start)
+            if e.stream_id is None:
+                return self._col(buf, e.attribute, -1, start)
+            raise SiddhiAppCreationError(
+                f"expression window: unsupported reference '{e.stream_id}.{e.attribute}'")
+        if isinstance(e, X.FunctionCall):
+            name = e.name
+            if name == "count":
+                return len(buf) - start
+            if name == "eventTimestamp":
+                if e.args and isinstance(e.args[0], X.Variable):
+                    which = e.args[0].attribute
+                    return int(buf.timestamps[start if which == "first" else -1])
+                return int(buf.timestamps[-1])
+            if name in self._AGGS:
+                arg = e.args[0]
+                if not isinstance(arg, X.Variable) or arg.stream_id is not None:
+                    raise SiddhiAppCreationError(
+                        "expression window aggregates take a plain attribute")
+                if arg.attribute not in buf.columns:
+                    raise SiddhiAppCreationError(
+                        f"expression window: unknown attribute '{arg.attribute}'")
+                col = buf.columns[arg.attribute][start:]
+                return self._AGGS[name](col) if len(col) else 0
+            raise SiddhiAppCreationError(
+                f"expression window: unsupported function '{name}()'")
+        if isinstance(e, X.ArithmeticOp):
+            a, b = self._ev(e.left, buf, start), self._ev(e.right, buf, start)
+            if e.op == "+":
+                return a + b
+            if e.op == "-":
+                return a - b
+            if e.op == "*":
+                return a * b
+            if e.op == "/":
+                return a / b
+            return a % b
+        if isinstance(e, X.CompareOp):
+            a, b = self._ev(e.left, buf, start), self._ev(e.right, buf, start)
+            op = e.op
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            return a >= b
+        if isinstance(e, X.AndOp):
+            return bool(self._ev(e.left, buf, start)) and bool(self._ev(e.right, buf, start))
+        if isinstance(e, X.OrOp):
+            return bool(self._ev(e.left, buf, start)) or bool(self._ev(e.right, buf, start))
+        if isinstance(e, X.NotOp):
+            return not bool(self._ev(e.expr, buf, start))
+        if isinstance(e, X.IsNull):
+            return self._ev(e.expr, buf, start) is None
+        raise SiddhiAppCreationError(
+            f"expression window: unsupported expression node {type(e).__name__}")
+
+
+@extension("window", "expression")
+class ExpressionWindow(WindowProcessor):
+    """Sliding window retained by an expression (reference:
+    ExpressionWindowProcessor.java:68-103): each arrival is appended,
+    then events are expired from the oldest until the expression holds
+    over the remaining buffer.
+
+    Inherently sequential host-side operator (retention depends on each
+    prior decision): O(buffer) per arrival; eviction scans use offset
+    views, not copies."""
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        expr = args[0].fn({})
+        if not isinstance(expr, str):
+            raise SiddhiAppCreationError("expression window expects a string expression")
+        self._eval = _WindowExprEval(expr, attribute_names)
+        self._buf: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        outs: List[EventBatch] = []
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            self._buf = EventBatch.concat([self._buf, row])
+            n_evict = 0
+            while len(self._buf) - n_evict > 0 and not self._eval(self._buf, n_evict):
+                n_evict += 1
+            if n_evict:
+                evict = self._buf.take(np.arange(n_evict)).with_types(ev.EXPIRED)
+                evict.timestamps = np.full(len(evict), now, dtype=np.int64)
+                outs.append(evict)
+                self._buf = self._buf.take(np.arange(n_evict, len(self._buf)))
+            outs.append(row)
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buf
+
+    def snapshot(self):
+        return {"buf": self._buf}
+
+    def restore(self, state):
+        self._buf = state["buf"]
+
+
+@extension("window", "expressionBatch")
+class ExpressionBatchWindow(WindowProcessor):
+    """Tumbling window flushed when the expression fails (reference:
+    ExpressionBatchWindowProcessor.java:68-147): events accumulate while
+    the expression (evaluated including the arriving event) holds; on
+    failure the batch is flushed — previous flush expired, RESET, new
+    CURRENT batch.  ``include.triggering.event`` puts the triggering
+    event into the flushed batch; ``stream.current.event`` streams
+    arrivals through immediately and only expires in batches."""
+
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        expr = args[0].fn({})
+        if not isinstance(expr, str):
+            raise SiddhiAppCreationError("expressionBatch window expects a string expression")
+        self._eval = _WindowExprEval(expr, attribute_names)
+        self.include_triggering = bool(args[1].fn({})) if len(args) > 1 else False
+        self.stream_current = bool(args[2].fn({})) if len(args) > 2 else False
+        self._buf: Optional[EventBatch] = None
+        self._last_flushed: Optional[EventBatch] = None
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buf is None:
+            self._buf = _empty_like(cur)
+        outs: List[EventBatch] = []
+        for i in range(len(cur)):
+            row = cur.take(np.asarray([i]))
+            if self.stream_current:
+                outs.append(row)
+            with_row = EventBatch.concat([self._buf, row])
+            if self._eval(with_row):
+                self._buf = with_row
+                continue
+            # expression failed including the arriving event -> flush
+            if self.include_triggering:
+                flush, rest = with_row, _empty_like(cur)
+            else:
+                flush, rest = self._buf, row
+            outs.extend(self._flush(flush, now))
+            self._buf = rest
+        return EventBatch.concat(outs) if outs else _empty_like(cur)
+
+    def _flush(self, flush: EventBatch, now: int) -> List[EventBatch]:
+        outs: List[EventBatch] = []
+        if self._last_flushed is not None and len(self._last_flushed):
+            exp = self._last_flushed.with_types(ev.EXPIRED)
+            exp.timestamps = np.full(len(exp), now, dtype=np.int64)
+            outs.append(exp)
+        if len(flush) or (self._last_flushed is not None and len(self._last_flushed)):
+            outs.append(reset_marker(flush, now))
+        if len(flush) and not self.stream_current:
+            outs.append(flush)
+        self._last_flushed = flush
+        return outs
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buf
+
+    def snapshot(self):
+        return {"buf": self._buf, "last": self._last_flushed}
+
+    def restore(self, state):
+        self._buf, self._last_flushed = state["buf"], state["last"]

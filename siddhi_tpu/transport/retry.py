@@ -7,6 +7,8 @@ tests can run the ladder in milliseconds.
 
 from __future__ import annotations
 
+from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+
 _INTERVALS_MS = [5_000, 10_000, 15_000, 30_000, 60_000, 120_000, 300_000]
 
 
@@ -24,3 +26,76 @@ class BackoffRetryCounter:
     def increment(self):
         if self._idx < len(_INTERVALS_MS) - 1:
             self._idx += 1
+
+
+class ConnectRetryMixin:
+    """Single-chain exponential-backoff reconnect shared by Source and
+    Sink (reference: Sink.connectWithRetry:276, Source.connectWithRetry).
+
+    Host class provides ``connect()``, ``definition``, and calls
+    ``_init_retry(options)`` from its init; the mixin maintains
+    ``connected`` and guarantees at most one pending retry chain.
+    """
+
+    def _init_retry(self, options):
+        import threading
+
+        self._retry = BackoffRetryCounter(scale=float(options.get("retry.scale", "1.0")))
+        self._retrying = False
+        self._retry_lock = threading.Lock()
+        self._retry_timer = None
+        self._shutdown = False
+
+    def start(self):
+        self._shutdown = False
+        self._connect_with_retry()
+
+    def _connect_with_retry(self):
+        import logging
+        import threading
+
+        log = logging.getLogger(type(self).__module__)
+        # one reconnect chain at a time — a batch of publish failures must
+        # not fan out into parallel perpetual timer chains
+        with self._retry_lock:
+            if self._retrying:
+                return
+            self._retrying = True
+        try:
+            self.connect()
+        except ConnectionUnavailableError as e:
+            interval = self._retry.get_time_interval_ms()
+            self._retry.increment()
+            log.warning(
+                "%s on stream '%s' connection failed (%s); retrying in %d ms",
+                type(self).__name__, self.definition.id, e, interval,
+            )
+            t = threading.Timer(interval / 1000.0, self._retry_connect)
+            t.daemon = True
+            self._retry_timer = t
+            t.start()
+            return  # flag stays held until the timer fires
+        except BaseException:
+            with self._retry_lock:
+                self._retrying = False
+            raise
+        self.connected = True
+        self._retry.reset()
+        with self._retry_lock:
+            self._retrying = False
+
+    def _retry_connect(self):
+        with self._retry_lock:
+            self._retrying = False
+        if not self._shutdown:
+            self._connect_with_retry()
+
+    def _shutdown_retry(self):
+        """Cancel any pending chain; leaves the mixin restartable."""
+        self._shutdown = True
+        t = self._retry_timer
+        if t is not None:
+            t.cancel()
+            self._retry_timer = None
+        with self._retry_lock:
+            self._retrying = False

@@ -12,14 +12,13 @@ the junction's @OnError handling).
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Dict, List, Optional
 
 from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
 from siddhi_tpu.core.exceptions import ConnectionUnavailableError
 from siddhi_tpu.extension.registry import extension
 from siddhi_tpu.transport.broker import InMemoryBroker
-from siddhi_tpu.transport.retry import BackoffRetryCounter
+from siddhi_tpu.transport.retry import ConnectRetryMixin
 
 log = logging.getLogger(__name__)
 
@@ -64,7 +63,7 @@ class JsonSinkMapper(SinkMapper):
         ]
 
 
-class Sink:
+class Sink(ConnectRetryMixin):
     """Transport publisher SPI (reference: Sink.java:59)."""
 
     def init(self, definition, options: Dict[str, str], mapper: SinkMapper, app_context):
@@ -73,10 +72,7 @@ class Sink:
         self.mapper = mapper
         self.app_context = app_context
         self.connected = False
-        self._retry = BackoffRetryCounter(scale=float(options.get("retry.scale", "1.0")))
-        self._retrying = False
-        self._retry_lock = threading.Lock()
-        self._shutdown = False
+        self._init_retry(options)
 
     # -- SPI ---------------------------------------------------------------
 
@@ -90,47 +86,10 @@ class Sink:
         raise NotImplementedError
 
     # -- lifecycle ---------------------------------------------------------
-
-    def start(self):
-        self._shutdown = False
-        self._connect_with_retry()
-
-    def _connect_with_retry(self):
-        # one reconnect chain at a time — a batch of publish failures must
-        # not fan out into parallel perpetual timer chains
-        with self._retry_lock:
-            if self._retrying:
-                return
-            self._retrying = True
-        try:
-            self.connect()
-            self.connected = True
-            self._retry.reset()
-            with self._retry_lock:
-                self._retrying = False
-        except ConnectionUnavailableError as e:
-            interval = self._retry.get_time_interval_ms()
-            self._retry.increment()
-            log.warning(
-                "sink %s on stream '%s' connection failed (%s); retrying in %d ms",
-                type(self).__name__, self.definition.id, e, interval,
-            )
-            t = threading.Timer(interval / 1000.0, self._retry_connect)
-            t.daemon = True
-            self._retry_timer = t
-            t.start()
-
-    def _retry_connect(self):
-        with self._retry_lock:
-            self._retrying = False
-        if not self._shutdown:
-            self._connect_with_retry()
+    # start/_connect_with_retry/_retry_connect come from ConnectRetryMixin
 
     def shutdown(self):
-        self._shutdown = True
-        t = getattr(self, "_retry_timer", None)
-        if t is not None:
-            t.cancel()
+        self._shutdown_retry()
         if self.connected:
             self.disconnect()
             self.connected = False
@@ -142,15 +101,20 @@ class Sink:
         if not events:
             return
         for payload in self.mapper.map(events):
-            if not self.connected:
-                self.on_error(payload, ConnectionUnavailableError("not connected"))
-                continue
-            try:
-                self.publish(payload)
-            except ConnectionUnavailableError as e:
-                self.connected = False
-                self.on_error(payload, e)
-                self._connect_with_retry()
+            self.publish_with_reconnect(payload)
+
+    def publish_with_reconnect(self, payload):
+        """Publish one payload; on connection failure route to
+        ``on_error`` and kick off the single reconnect chain."""
+        if not self.connected:
+            self.on_error(payload, ConnectionUnavailableError("not connected"))
+            return
+        try:
+            self.publish(payload)
+        except ConnectionUnavailableError as e:
+            self.connected = False
+            self.on_error(payload, e)
+            self._connect_with_retry()
 
     def on_error(self, payload, e: Exception):
         """Publish-failure hook: default logs and drops (reference
@@ -306,13 +270,4 @@ class DistributedSink(Sink):
         payloads = self.mapper.map(events)
         for event, payload in zip(events, payloads):
             for d in self.strategy.destinations_for(event):
-                child = self.children[d]
-                if not child.connected:
-                    child.on_error(payload, ConnectionUnavailableError("not connected"))
-                    continue
-                try:
-                    child.publish(payload)
-                except ConnectionUnavailableError as e:
-                    child.connected = False
-                    child.on_error(payload, e)
-                    child._connect_with_retry()
+                self.children[d].publish_with_reconnect(payload)

@@ -18,7 +18,7 @@ from siddhi_tpu.core.event import Event
 from siddhi_tpu.core.exceptions import ConnectionUnavailableError
 from siddhi_tpu.extension.registry import extension
 from siddhi_tpu.transport.broker import InMemoryBroker, Subscriber
-from siddhi_tpu.transport.retry import BackoffRetryCounter
+from siddhi_tpu.transport.retry import ConnectRetryMixin
 
 log = logging.getLogger(__name__)
 
@@ -69,7 +69,7 @@ class JsonSourceMapper(SourceMapper):
         return [Event(data=[r.get(nm) for nm in names]) for r in rows]
 
 
-class Source:
+class Source(ConnectRetryMixin):
     """Transport receiver SPI (reference: Source.java:50).
 
     Subclasses implement connect / disconnect and call ``self.deliver``
@@ -87,10 +87,7 @@ class Source:
         self._paused = False
         self._pause_buffer: List = []
         self._lock = threading.Lock()
-        self._retry = BackoffRetryCounter(
-            scale=float(options.get("retry.scale", "1.0"))
-        )
-        self._shutdown = False
+        self._init_retry(options)
 
     # -- SPI ---------------------------------------------------------------
 
@@ -101,31 +98,7 @@ class Source:
         pass
 
     # -- lifecycle ---------------------------------------------------------
-
-    def start(self):
-        self._shutdown = False
-        self._connect_with_retry()
-
-    def _connect_with_retry(self):
-        try:
-            self.connect()
-            self.connected = True
-            self._retry.reset()
-        except ConnectionUnavailableError as e:
-            interval = self._retry.get_time_interval_ms()
-            self._retry.increment()
-            log.warning(
-                "source %s on stream '%s' connection failed (%s); retrying in %d ms",
-                type(self).__name__, self.definition.id, e, interval,
-            )
-            t = threading.Timer(interval / 1000.0, self._retry_connect)
-            t.daemon = True
-            self._retry_timer = t
-            t.start()
-
-    def _retry_connect(self):
-        if not self._shutdown:
-            self._connect_with_retry()
+    # start/_connect_with_retry/_retry_connect come from ConnectRetryMixin
 
     def pause(self):
         self._paused = True
@@ -145,10 +118,7 @@ class Source:
                     self._send_events(events)
 
     def shutdown(self):
-        self._shutdown = True
-        t = getattr(self, "_retry_timer", None)
-        if t is not None:
-            t.cancel()
+        self._shutdown_retry()
         if self.connected:
             self.disconnect()
             self.connected = False
@@ -177,14 +147,20 @@ class Source:
 @extension("source", "inMemory")
 class InMemorySource(Source):
     """Subscribes its stream to an InMemoryBroker topic
-    (reference: InMemorySource.java)."""
+    (reference: InMemorySource.java — topic validated at init, so a
+    missing option fails app creation, not the retry loop)."""
+
+    def init(self, definition, options, mapper, junction, app_context):
+        super().init(definition, options, mapper, junction, app_context)
+        if options.get("topic") is None:
+            from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+            raise SiddhiAppCreationError(
+                f"inMemory source on '{definition.id}': 'topic' option required"
+            )
 
     def connect(self):
         topic = self.options.get("topic")
-        if topic is None:
-            raise ConnectionUnavailableError(
-                f"inMemory source on '{self.definition.id}': 'topic' option required"
-            )
         src = self
 
         class _Sub(Subscriber):

@@ -134,21 +134,23 @@ class StatisticsManager:
 
     def stats(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
-        for t in self.throughput.values():
+        # snapshot the registries: _apply_statistics_level repopulates
+        # them from another thread while the reporter iterates
+        for t in list(self.throughput.values()):
             out[self._metric("Streams", t.name, "throughput")] = t.events_per_second()
             out[self._metric("Streams", t.name, "totalEvents")] = t.count
-        for l in self.latency.values():
+        for l in list(self.latency.values()):
             out[self._metric("Queries", l.name, "latencyAvgMs")] = l.avg_ms()
             out[self._metric("Queries", l.name, "latencyMaxMs")] = l.max_ms()
             out[self._metric("Queries", l.name, "events")] = l.events
-        for b in self.buffers.values():
+        for b in list(self.buffers.values()):
             out[self._metric("Streams", b.name, "bufferedEvents")] = b.buffered()
         return out
 
     def reset(self):
-        for t in self.throughput.values():
+        for t in list(self.throughput.values()):
             t.reset()
-        for l in self.latency.values():
+        for l in list(self.latency.values()):
             l.reset()
 
     # -- console reporter ---------------------------------------------------
@@ -168,8 +170,11 @@ class StatisticsManager:
                 time.sleep(self.interval_s)
                 if not self._running or gen != self._generation:
                     break
-                for k, v in sorted(self.stats().items()):
-                    log.info("%s = %s", k, v)
+                try:
+                    for k, v in sorted(self.stats().items()):
+                        log.info("%s = %s", k, v)
+                except Exception:  # noqa: BLE001 — reporter must survive
+                    log.exception("statistics reporter failed; continuing")
 
         self._reporter = threading.Thread(
             target=loop, name=f"stats-{self.app_name}", daemon=True

@@ -319,3 +319,98 @@ class TestOutputRateLimiting:
         ])
         datas = {tuple(e.data) for e in got}
         assert ("A", 3) in datas and ("B", 5) in datas
+
+
+class TestCronWindow:
+    def test_cron_batch_flush(self, manager):
+        # fire every second; events held until the fire, then batched out
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S#window.cron('* * * * * ?') select symbol, sum(v) as total "
+            "insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 10], 1000),
+            (["B", 20], 1400),
+            (["C", 30], 2500),  # past the 2000ms cron fire -> flush A,B first
+        ])
+        # at the 2000ms fire: batch A+B flushed as one batch -> sum 30
+        assert [e.data[1] for e in got] == [30]
+
+
+class TestExpressionWindow:
+    def test_count_retention(self, manager):
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S#window.expression('count() <= 2') select symbol, sum(v) as total "
+            "insert all events into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 10], 1000),
+            (["B", 20], 1100),
+            (["C", 30], 1200),  # A evicted: count()<=2
+        ])
+        totals = [e.data[1] for e in got]
+        # A(10), B(30), expired-A(20), C(50)
+        assert totals == [10, 30, 20, 50]
+
+    def test_sum_retention(self, manager):
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S#window.expression('sum(v) < 100') select symbol, sum(v) as total "
+            "insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 60], 1000),
+            (["B", 50], 1100),   # 110 >= 100 -> evict A
+            (["C", 40], 1200),   # 90 ok
+        ])
+        totals = [e.data[1] for e in got]
+        assert totals == [60, 50, 90]
+
+    def test_first_last_timestamp_span(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S#window.expression('eventTimestamp(last) - eventTimestamp(first) < 1000') "
+            "select sum(v) as total insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1], 1000),
+            ([2], 1500),
+            ([4], 2200),  # first=1000 span 1200 -> evict; then span 700 ok
+        ])
+        totals = [e.data[0] for e in got]
+        assert totals == [1, 3, 6]
+
+
+class TestExpressionBatchWindow:
+    def test_count_batch(self, manager):
+        app = (
+            "define stream S (symbol string, v long); "
+            "from S#window.expressionBatch('count() <= 2') "
+            "select symbol, sum(v) as total insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            (["A", 10], 1000),
+            (["B", 20], 1100),
+            (["C", 30], 1200),  # count 3 > 2 -> flush [A,B], C starts new batch
+            (["D", 40], 1300),
+            (["E", 50], 1400),  # flush [C,D]
+        ])
+        # batch [A,B] flushed (sum 30), then batch [C,D] (sum 70)
+        assert [e.data[1] for e in got] == [30, 70]
+
+    def test_attribute_trigger_include(self, manager):
+        app = (
+            "define stream S (v long, flush bool); "
+            "from S#window.expressionBatch('not flush', true) "
+            "select sum(v) as total insert into OutputStream;"
+        )
+        got = run_pb(manager, app, [
+            ([1, False], 1000),
+            ([2, False], 1100),
+            ([4, True], 1200),   # flush fires; triggering event included
+            ([8, False], 1300),
+        ])
+        # batch [1,2,4] flushed including the trigger -> single output sum 7
+        assert [e.data[0] for e in got] == [7]
