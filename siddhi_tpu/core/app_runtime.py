@@ -39,6 +39,7 @@ class SiddhiAppRuntime:
         aggregations: Optional[Dict[str, object]] = None,
         sources: Optional[List] = None,
         sinks: Optional[List] = None,
+        functions: Optional[Dict[str, object]] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -53,6 +54,7 @@ class SiddhiAppRuntime:
         self.aggregations = aggregations or {}
         self.sources = sources or []
         self.sinks = sinks or []
+        self.functions = functions or {}
         self._on_demand_cache: Dict[str, object] = {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
@@ -66,6 +68,9 @@ class SiddhiAppRuntime:
         for j in self.junctions.values():
             j.start()
         self.scheduler.start()
+        for t in self.tables.values():
+            if hasattr(t, "start"):
+                t.start()  # record tables connect their stores
         # sinks connect before sources so output paths exist when events flow
         for s in self.sinks:
             s.start()
@@ -120,6 +125,9 @@ class SiddhiAppRuntime:
         self.scheduler.stop()
         for j in self.junctions.values():
             j.stop()
+        for t in self.tables.values():
+            if hasattr(t, "shutdown"):
+                t.shutdown()
         self.running = False
         if self._manager is not None:
             self._manager._app_runtimes.pop(self.name, None)
@@ -231,7 +239,11 @@ class SiddhiAppRuntime:
     def _snapshot_service(self):
         from siddhi_tpu.util.snapshot import SnapshotService
 
-        return SnapshotService(self)
+        # cached: incremental mode tracks per-element digests across persists
+        svc = getattr(self, "_snapshot_svc", None)
+        if svc is None:
+            svc = self._snapshot_svc = SnapshotService(self)
+        return svc
 
     def _persistence_store(self):
         store = getattr(self.app_context.siddhi_context, "persistence_store", None)
@@ -248,6 +260,8 @@ class SiddhiAppRuntime:
         revision id."""
         from siddhi_tpu.util.snapshot import SnapshotService
 
+        from siddhi_tpu.util.persistence import IncrementalPersistenceStore
+
         store = self._persistence_store()
         svc = self._snapshot_service()
         revision = SnapshotService.new_revision(self.name)
@@ -256,7 +270,11 @@ class SiddhiAppRuntime:
         for s in self.sources:
             s.pause()
         try:
-            store.save(self.name, revision, svc.full_snapshot())
+            if isinstance(store, IncrementalPersistenceStore):
+                kind, data = svc.incremental_snapshot()
+                store.save(self.name, revision, kind, data)
+            else:
+                store.save(self.name, revision, svc.full_snapshot())
         finally:
             for s in self.sources:
                 s.resume()
@@ -271,7 +289,19 @@ class SiddhiAppRuntime:
         self._snapshot_service().restore(snapshot)
 
     def restore_revision(self, revision: str):
+        from siddhi_tpu.util.persistence import IncrementalPersistenceStore
+
         store = self._persistence_store()
+        if isinstance(store, IncrementalPersistenceStore):
+            chain = store.load_chain(self.name, until_revision=revision)
+            if chain is None:
+                raise SiddhiAppRuntimeError(
+                    f"app '{self.name}': no base snapshot at or before "
+                    f"revision '{revision}'")
+            _, base_bytes, incs = chain
+            self._snapshot_service().restore_incremental(
+                base_bytes, [b for _, b in incs])
+            return
         data = store.load(self.name, revision)
         if data is None:
             raise SiddhiAppRuntimeError(
@@ -281,8 +311,20 @@ class SiddhiAppRuntime:
 
     def restore_last_revision(self) -> Optional[str]:
         """Restore the newest saved revision; returns its id (None when no
-        revision exists — reference: SiddhiAppRuntimeImpl.restoreLastRevision)."""
+        revision exists — reference: SiddhiAppRuntimeImpl.restoreLastRevision).
+        With an incremental store, replays newest base + later increments."""
+        from siddhi_tpu.util.persistence import IncrementalPersistenceStore
+
         store = self._persistence_store()
+        if isinstance(store, IncrementalPersistenceStore):
+            chain = store.load_chain(self.name)
+            if chain is None:
+                return None
+            base_rev, base_bytes, incs = chain
+            self._snapshot_service().restore_incremental(
+                base_bytes, [b for _, b in incs]
+            )
+            return incs[-1][0] if incs else base_rev
         last = store.get_last_revision(self.name)
         if last is None:
             return None

@@ -54,6 +54,9 @@ class SiddhiContext:
         self.extensions = default_registry()
         self.persistence_store = None
         self.config: Dict[str, str] = {}
+        from siddhi_tpu.util.config import InMemoryConfigManager
+
+        self.config_manager = InMemoryConfigManager()
         self.attributes: Dict[str, object] = {}
 
 

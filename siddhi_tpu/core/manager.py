@@ -37,6 +37,53 @@ class SiddhiManager:
     # Java-style alias
     createSiddhiAppRuntime = create_siddhi_app_runtime
 
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp]):
+        """Plan the app end-to-end, then discard it — raises
+        SiddhiAppCreationError/SiddhiParserError on any problem
+        (reference: SiddhiManager.validateSiddhiApp:144-165)."""
+        runtime = self.create_siddhi_app_runtime(app)
+        runtime.shutdown()
+
+    def create_sandbox_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        """Create a runtime with external transports stripped: non-inMemory
+        @source/@sink and every @store annotation are removed so the app
+        runs fully in-process (reference:
+        SiddhiManager.createSandboxSiddhiAppRuntime:104-132)."""
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+        else:
+            import copy
+
+            app = copy.deepcopy(app)  # never strip the caller's object
+
+        def keep(ann) -> bool:
+            nm = ann.name.lower()
+            if nm not in ("source", "sink"):
+                return True
+            return (ann.element("type") or "").lower() == "inmemory"
+
+        for sd in app.stream_definitions.values():
+            sd.annotations[:] = [a for a in sd.annotations if keep(a)]
+        for td in app.table_definitions.values():
+            td.annotations[:] = [a for a in td.annotations if a.name.lower() != "store"]
+        return self.create_siddhi_app_runtime(app)
+
+    # Java-style aliases
+    validateSiddhiApp = validate_siddhi_app
+    createSandboxSiddhiAppRuntime = create_sandbox_siddhi_app_runtime
+
+    def get_attributes(self) -> Dict[str, object]:
+        return self.siddhi_context.attributes
+
+    def set_attribute(self, key: str, value):
+        """Shared objects visible to extensions
+        (reference: SiddhiManager.setAttribute:76)."""
+        self.siddhi_context.attributes[key] = value
+
+    def remove_extension(self, name: str, kind: str = "function"):
+        ns, _, nm = name.rpartition(":")
+        self.siddhi_context.extensions.unregister(kind, nm, ns or None)
+
     def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
         return self._app_runtimes.get(name)
 
@@ -51,6 +98,13 @@ class SiddhiManager:
 
     def set_persistence_store(self, store):
         self.siddhi_context.persistence_store = store
+
+    def set_config_manager(self, config_manager):
+        """Deployment config source for extensions and refs
+        (reference: SiddhiManager.setConfigManager:203)."""
+        self.siddhi_context.config_manager = config_manager
+
+    setConfigManager = set_config_manager
 
     def persist(self):
         for rt in list(self._app_runtimes.values()):

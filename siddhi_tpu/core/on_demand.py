@@ -89,16 +89,33 @@ class OnDemandQueryRuntime:
             scope.add_alias(odq.input_store, ref)
         self.scope = scope
         self.compiler = ExpressionCompiler(
-            scope, table_resolver=getattr(self.app, "table_resolver", None)
+            scope,
+            functions=getattr(self.app, "functions", None),
+            table_resolver=getattr(self.app, "table_resolver", None),
         )
 
         # condition over store rows
         self.condition = None
+        self._pushdown = None
         if odq.on_condition is not None:
             c = self.compiler.compile(odq.on_condition)
             if c.type != AttrType.BOOL:
                 raise StoreQueryCreationError("'on' condition must be boolean")
             self.condition = c
+            if self.kind == "table":
+                from siddhi_tpu.table.record import RecordTableRuntime
+
+                if isinstance(self.store, RecordTableRuntime):
+                    # push the condition to the external store instead of
+                    # fetching every record and filtering host-side
+                    from siddhi_tpu.table.table import compile_table_condition
+
+                    self._pushdown = compile_table_condition(
+                        self.store, odq.on_condition, Scope(),
+                        extra_functions=getattr(self.app, "functions", None),
+                        table_resolver=getattr(self.app, "table_resolver", None),
+                    )
+                    self.condition = None
 
         # aggregation access clauses
         self.per = None
@@ -201,17 +218,24 @@ class OnDemandQueryRuntime:
         return self.store
 
     def _compile_table_condition(self, event_scope: Scope):
-        from siddhi_tpu.table.table import CompiledTableCondition
+        from siddhi_tpu.table.table import compile_table_condition
 
         cond = getattr(self.odq.output_stream, "on_condition", None)
         if cond is None:
             cond = self.odq.on_condition
-        return CompiledTableCondition(self._target_table(), cond, event_scope)
+        return compile_table_condition(
+            self._target_table(), cond, event_scope,
+            extra_functions=getattr(self.app, "functions", None),
+            table_resolver=getattr(self.app, "table_resolver", None),
+        )
 
     # -- execution ----------------------------------------------------------
 
     def _rows(self) -> Optional[EventBatch]:
         if self.kind == "table":
+            if self._pushdown is not None:
+                slots = self._pushdown.slots_matching({N_KEY: 1})
+                return self.store.rows_batch(slots)
             return self.store.rows_batch()
         if self.kind == "window":
             return self.store.buffered()

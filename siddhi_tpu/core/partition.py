@@ -120,6 +120,10 @@ class _InstancePlanner:
     # -- delegated surface --------------------------------------------------
 
     @property
+    def functions(self):
+        return getattr(self._app, "functions", {})
+
+    @property
     def app_context(self):
         return self._app.app_context
 
@@ -268,6 +272,7 @@ class PartitionRuntime:
             self.partitioned_defs[sid] = definition
             compiler = ExpressionCompiler(
                 scope_for_definition(definition, sid),
+                functions=getattr(app_planner, "functions", None),
                 table_resolver=app_planner.table_resolver,
             )
             if isinstance(pt, ValuePartitionType):

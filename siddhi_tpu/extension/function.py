@@ -1,0 +1,143 @@
+"""Custom scalar functions and script UDFs.
+
+Re-design of the reference ``core/executor/function/FunctionExecutor``
+extension base plus the script surface (``define function f[lang]
+return type { body }``, executor/function/ScriptFunctionExecutor): a
+custom function is a class with ``execute(*values)`` called per row
+(vectorized by the wrapper), a script engine is an extension of kind
+'script' keyed by language that compiles a body into such a callable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.extension.registry import extension
+from siddhi_tpu.planner.expr import CompiledExpression, _to_type
+from siddhi_tpu.query_api import AttrType
+
+
+class FunctionExecutor:
+    """Custom scalar function SPI (reference: FunctionExecutor.java).
+
+    Subclass, set ``return_type``, implement ``execute(*values)`` (one
+    row's argument values -> one value).  Register via
+    ``SiddhiManager.set_extension('ns:name', cls, kind='function')``.
+    """
+
+    return_type: AttrType = AttrType.OBJECT
+
+    def init(self, arg_types: List[AttrType]):
+        pass
+
+    def execute(self, *values):
+        raise NotImplementedError
+
+
+def make_scalar_function_builder(scalar: Callable, return_type: Optional[AttrType]):
+    """Wrap a per-row callable into an expression-compiler function
+    builder: argument arrays are broadcast and the callable applied
+    row-wise via a numpy ufunc."""
+
+    def builder(args: List[CompiledExpression]) -> CompiledExpression:
+        nin = len(args)
+
+        def fn(env):
+            if nin == 0:
+                return scalar()
+            vals = [np.atleast_1d(np.asarray(a.fn(env))) for a in args]
+            vals = np.broadcast_arrays(*vals)
+            out = np.frompyfunc(scalar, nin, 1)(*vals)
+            if return_type is not None and return_type != AttrType.OBJECT:
+                return _to_type(out, return_type)
+            return out
+
+        return CompiledExpression(fn, return_type or AttrType.OBJECT)
+
+    return builder
+
+
+def builder_for_extension(factory) -> Callable:
+    """An extension registered as kind='function' may be a
+    FunctionExecutor subclass, an instance, or a plain callable.
+    Executor classes are instantiated per call site and ``init`` receives
+    the argument types (reference: FunctionExecutor.initExecutor)."""
+    if isinstance(factory, type) and issubclass(factory, FunctionExecutor):
+        def builder(args: List[CompiledExpression]) -> CompiledExpression:
+            inst = factory()
+            inst.init([a.type for a in args])
+            return make_scalar_function_builder(inst.execute, inst.return_type)(args)
+
+        return builder
+    if isinstance(factory, FunctionExecutor):
+        def builder(args: List[CompiledExpression]) -> CompiledExpression:
+            factory.init([a.type for a in args])
+            return make_scalar_function_builder(factory.execute, factory.return_type)(args)
+
+        return builder
+    if callable(factory):
+        return make_scalar_function_builder(factory, None)
+    raise SiddhiAppCreationError(
+        f"function extension {factory!r} is neither FunctionExecutor nor callable")
+
+
+class ScriptEngine:
+    """Script-language SPI (extension kind 'script', name = language)."""
+
+    def compile(self, name: str, body: str, return_type: AttrType) -> Callable:
+        raise NotImplementedError
+
+
+@extension("script", "python")
+class PythonScript(ScriptEngine):
+    """``define function f[python] return type { body }``.
+
+    The body sees the argument values as ``data`` (a list).  A body that
+    is a single expression is evaluated directly; otherwise it is
+    executed and must assign ``result``.
+    """
+
+    def compile(self, name: str, body: str, return_type: AttrType) -> Callable:
+        src = body.strip()
+        try:
+            code = compile(src, f"<function {name}>", "eval")
+            mode = "eval"
+        except SyntaxError:
+            try:
+                code = compile(src, f"<function {name}>", "exec")
+                mode = "exec"
+            except SyntaxError as e:
+                raise SiddhiAppCreationError(
+                    f"function '{name}[python]': body does not compile: {e}"
+                ) from e
+
+        def scalar(*values):
+            g = {"data": list(values)}
+            if mode == "eval":
+                return eval(code, g)  # noqa: S307 — user-defined script UDF
+            exec(code, g)  # noqa: S102
+            if "result" not in g:
+                raise SiddhiAppCreationError(
+                    f"function '{name}[python]': multi-statement body must set 'result'")
+            return g["result"]
+
+        return scalar
+
+
+@extension("script", "javascript")
+@extension("script", "js")
+class JavaScriptScript(ScriptEngine):
+    """Placeholder matching the reference's JS script support: no JS
+    engine ships in this environment, so planning a [javascript]
+    function fails with a clear error unless the user registers their
+    own engine under kind='script'."""
+
+    def compile(self, name: str, body: str, return_type: AttrType) -> Callable:
+        raise SiddhiAppCreationError(
+            f"function '{name}[javascript]': no JavaScript engine available; "
+            "register one with set_extension('javascript', Engine, kind='script') "
+            "or use [python]"
+        )

@@ -42,6 +42,12 @@ class ExtensionRegistry:
         assert kind in KINDS, f"unknown extension kind {kind!r}"
         self._kinds[kind][self.full_name(namespace, name)] = factory
 
+    def items(self, kind: str):
+        return list(self._kinds[kind].items())
+
+    def unregister(self, kind: str, name: str, namespace: Optional[str] = None):
+        self._kinds[kind].pop(self.full_name(namespace, name), None)
+
     def lookup(self, kind: str, name: str, namespace: Optional[str] = None) -> Optional[Callable]:
         return self._kinds[kind].get(self.full_name(namespace, name))
 
@@ -72,7 +78,9 @@ def extension(kind: str, name: str, namespace: Optional[str] = None):
 
 def default_registry() -> ExtensionRegistry:
     # import builtin extension modules for their registration side effects
+    import siddhi_tpu.extension.function  # noqa: F401
     import siddhi_tpu.ops.windows  # noqa: F401
+    import siddhi_tpu.table.record  # noqa: F401
     import siddhi_tpu.transport.sink  # noqa: F401
     import siddhi_tpu.transport.source  # noqa: F401
 

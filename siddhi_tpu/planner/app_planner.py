@@ -143,6 +143,31 @@ class AppPlanner:
     def _ann_options(ann) -> Dict[str, str]:
         return {k: v for k, v in ann.elements if k is not None and k.lower() != "type"}
 
+    def _resolve_ref(self, ann) -> Dict[str, str]:
+        """Options for @source/@sink/@store with ``ref=`` merged from the
+        config manager's refs (reference: ConfigManager.extractSystemConfigs);
+        inline options win over ref properties."""
+        opts = self._ann_options(ann)
+        ref = opts.pop("ref", None)
+        if ref is not None:
+            cm = self.siddhi_context.config_manager
+            ref_configs = dict(cm.extract_system_configs(ref))
+            if not ref_configs:
+                raise SiddhiAppCreationError(f"undefined ref '{ref}'")
+            ref_configs.update(opts)
+            opts = ref_configs
+        return opts
+
+    def _transport_config(self, ann, what: str):
+        """-> (type, init options) with ``ref=`` resolved exactly once."""
+        opts = self._resolve_ref(ann)
+        stype = ann.element("type") or opts.get("type")
+        if stype is None:
+            raise SiddhiAppCreationError(
+                f"@{what} on a definition: 'type' is required (inline or via ref)")
+        opts.pop("type", None)
+        return stype, opts
+
     def _mapper(self, ann, kind: str):
         """Build the (source|sink) mapper from a nested @map annotation
         (default passThrough)."""
@@ -160,25 +185,19 @@ class AppPlanner:
         for ann in definition.annotations:
             nm = ann.name.lower()
             if nm == "source":
-                stype = ann.element("type")
-                if stype is None:
-                    raise SiddhiAppCreationError(
-                        f"@source on '{definition.id}': 'type' is required"
-                    )
+                stype, opts = self._transport_config(ann, "source")
                 factory = self.extensions.lookup("source", stype)
                 if factory is None:
                     raise SiddhiAppCreationError(f"unknown @source(type='{stype}')")
                 mapper, map_opts = self._mapper(ann, "source")
                 mapper.init(definition, map_opts)
                 src = factory()
-                src.init(definition, self._ann_options(ann), mapper, junction, self.app_context)
+                src.config_reader = self.siddhi_context.config_manager.generate_config_reader(
+                    "source", stype)
+                src.init(definition, opts, mapper, junction, self.app_context)
                 self.sources.append(src)
             elif nm == "sink":
-                stype = ann.element("type")
-                if stype is None:
-                    raise SiddhiAppCreationError(
-                        f"@sink on '{definition.id}': 'type' is required"
-                    )
+                stype, opts = self._transport_config(ann, "sink")
                 factory = self.extensions.lookup("sink", stype)
                 if factory is None:
                     raise SiddhiAppCreationError(f"unknown @sink(type='{stype}')")
@@ -202,7 +221,9 @@ class AppPlanner:
                     )
                 else:
                     sink = factory()
-                sink.init(definition, self._ann_options(ann), mapper, self.app_context)
+                sink.config_reader = self.siddhi_context.config_manager.generate_config_reader(
+                    "sink", stype)
+                sink.init(definition, opts, mapper, self.app_context)
                 junction.subscribe(SinkStreamCallback(sink))
                 self.sinks.append(sink)
 
@@ -240,9 +261,62 @@ class AppPlanner:
 
     # -- build --------------------------------------------------------------
 
+    def _build_functions(self):
+        """name -> expression-builder map: function extensions plus
+        script-defined UDFs (``define function f[lang] ...``)."""
+        from siddhi_tpu.extension.function import (
+            builder_for_extension,
+            make_scalar_function_builder,
+        )
+
+        fns = {}
+        for full_name, factory in self.extensions.items("function"):
+            fns[full_name] = builder_for_extension(factory)
+        for fd in self.siddhi_app.function_definitions.values():
+            engine_factory = self.extensions.lookup("script", fd.language.lower())
+            if engine_factory is None:
+                raise SiddhiAppCreationError(
+                    f"function '{fd.id}': unknown script language '{fd.language}'")
+            scalar = engine_factory().compile(fd.id, fd.body, fd.return_type)
+            fns[fd.id] = make_scalar_function_builder(scalar, fd.return_type)
+        return fns
+
+    def _build_table(self, td):
+        """@store tables become record-table runtimes over a store
+        extension (reference: DefinitionParserHelper table wiring);
+        plain tables are columnar in-memory tables."""
+        from siddhi_tpu.query_api.annotation import find_annotation
+        from siddhi_tpu.table import InMemoryTable, RecordTableRuntime, TableCache
+
+        store_ann = find_annotation(td.annotations, "store")
+        if store_ann is None:
+            return InMemoryTable(td)
+        options = self._resolve_ref(store_ann)
+        stype = store_ann.element("type") or options.get("type")
+        if stype is None:
+            raise SiddhiAppCreationError(
+                f"table '{td.id}': @store needs a type (inline or via ref)")
+        factory = self.extensions.lookup("store", stype)
+        if factory is None:
+            raise SiddhiAppCreationError(
+                f"table '{td.id}': unknown store type '{stype}'")
+        store = factory()
+        reader = self.siddhi_context.config_manager.generate_config_reader("store", stype)
+        store.init(td, options, reader)
+        cache = None
+        cache_ann = store_ann.nested("cache")
+        if cache_ann is not None:
+            size = int(cache_ann.element("size") or cache_ann.element("max.size") or "50")
+            policy = (cache_ann.element("cache.policy")
+                      or cache_ann.element("policy") or "FIFO")
+            cache = TableCache(size, policy)
+        return RecordTableRuntime(td, store, cache=cache)
+
     def build(self):
         from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
         from siddhi_tpu.planner.query_planner import QueryPlanner
+
+        self.functions = self._build_functions()
 
         for d in self.siddhi_app.stream_definitions.values():
             self.define_stream(d)
@@ -250,7 +324,7 @@ class AppPlanner:
         from siddhi_tpu.table import InMemoryTable
 
         for td in self.siddhi_app.table_definitions.values():
-            self.tables[td.id] = InMemoryTable(td)
+            self.tables[td.id] = self._build_table(td)
 
         from siddhi_tpu.core.trigger import TriggerRuntime
         from siddhi_tpu.core.window import NamedWindowRuntime
@@ -270,7 +344,7 @@ class AppPlanner:
             wscope = Scope()
             for a in wd.attributes:
                 wscope.add(wd.id, a.name, a.name, a.type)
-            wcompiler = ExpressionCompiler(wscope)
+            wcompiler = ExpressionCompiler(wscope, functions=self.functions)
             args = [wcompiler.compile(a) for a in fn.args]
             w = factory(args, wd.attribute_names)
             junction = self.define_stream(
@@ -332,6 +406,7 @@ class AppPlanner:
             aggregations=self.aggregations,
             sources=self.sources,
             sinks=self.sinks,
+            functions=self.functions,
         )
 
 

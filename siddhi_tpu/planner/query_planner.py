@@ -212,7 +212,7 @@ class QueryPlanner:
             definition = self.app.resolve_stream_definition(s)
             # side-local scope: handler expressions see bare side attrs
             side_scope = scope_for_definition(definition, ref)
-            side_compiler = ExpressionCompiler(side_scope, table_resolver=self.app.table_resolver)
+            side_compiler = ExpressionCompiler(side_scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
             chain, b_mode, windows = self._plan_handlers(s, definition, side_compiler)
             batch_mode = batch_mode or b_mode
             window = None
@@ -270,7 +270,7 @@ class QueryPlanner:
                 scope.add(side.ref, a.name, side.qualified_key(a.name), a.type)
             if src.stream_id != side.ref:
                 scope.add_alias(src.stream_id, side.ref)
-        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+        compiler = ExpressionCompiler(scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
         condition = compiler.compile(j.on_condition) if j.on_condition is not None else None
         if condition is not None and condition.type != AttrType.BOOL:
             raise SiddhiAppCreationError(f"query '{name}': 'on' condition must be boolean")
@@ -333,7 +333,7 @@ class QueryPlanner:
 
         # selector scope over event refs; bare attrs resolve when unambiguous
         scope = PatternScope(builder.ref_defs, builder.stream_to_ref, cand_def=None)
-        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+        compiler = ExpressionCompiler(scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
         selector, out_def = self._plan_selector(
             query.selector, scope, compiler, name, query, batch_mode=False
         )
@@ -390,7 +390,7 @@ class QueryPlanner:
         scope = scope_for_definition(definition, ref)
         if s.alias and s.alias != s.stream_id:
             scope.add_alias(s.stream_id, s.alias)
-        compiler = ExpressionCompiler(scope, table_resolver=self.app.table_resolver)
+        compiler = ExpressionCompiler(scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
 
         chain, batch_mode, windows = self._plan_handlers(s, definition, compiler)
         selector, out_def = self._plan_selector(
@@ -566,12 +566,12 @@ class QueryPlanner:
     def _plan_output(self, query: Query, out_def: StreamDefinition):
         from siddhi_tpu.query_api import DeleteStream, UpdateOrInsertStream, UpdateStream
         from siddhi_tpu.table import (
-            CompiledTableCondition,
             DeleteTableCallback,
             InsertIntoTableCallback,
             UpdateOrInsertTableCallback,
             UpdateTableCallback,
             compile_set_clause,
+            compile_table_condition,
         )
 
         out = query.output_stream
@@ -603,7 +603,7 @@ class QueryPlanner:
             out_scope = Scope()
             for a in out_def.attributes:
                 out_scope.add_bare(a.name, a.type)
-            condition = CompiledTableCondition(
+            condition = compile_table_condition(
                 table, out.on_condition, out_scope, table_resolver=self.app.table_resolver
             )
             if isinstance(out, DeleteStream):

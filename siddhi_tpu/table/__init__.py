@@ -1,4 +1,16 @@
-from siddhi_tpu.table.table import CompiledTableCondition, InMemoryTable
+from siddhi_tpu.table.table import (
+    CompiledTableCondition,
+    InMemoryTable,
+    compile_table_condition,
+)
+from siddhi_tpu.table.record import (
+    AbstractRecordTable,
+    InMemoryRecordStore,
+    RecordCompiledCondition,
+    RecordTableHandler,
+    RecordTableRuntime,
+    TableCache,
+)
 from siddhi_tpu.table.callbacks import (
     DeleteTableCallback,
     InsertIntoTableCallback,
@@ -8,7 +20,14 @@ from siddhi_tpu.table.callbacks import (
 )
 
 __all__ = [
+    "AbstractRecordTable",
     "CompiledTableCondition",
+    "InMemoryRecordStore",
+    "RecordCompiledCondition",
+    "RecordTableHandler",
+    "RecordTableRuntime",
+    "TableCache",
+    "compile_table_condition",
     "InMemoryTable",
     "DeleteTableCallback",
     "InsertIntoTableCallback",

@@ -408,3 +408,18 @@ def _equality_terms(cond: Expression, table: InMemoryTable):
 
     ok = walk(cond)
     return terms, ok
+
+
+def compile_table_condition(table, condition, event_scope, extra_functions=None,
+                            table_resolver=None):
+    """Dispatch: slot-planner condition for in-memory tables, push-down
+    IR + post-filter for record (store-backed) tables."""
+    from siddhi_tpu.table.record import RecordCompiledCondition, RecordTableRuntime
+
+    if isinstance(table, RecordTableRuntime):
+        return RecordCompiledCondition(
+            table, condition, event_scope, extra_functions, table_resolver
+        )
+    return CompiledTableCondition(
+        table, condition, event_scope, extra_functions, table_resolver
+    )

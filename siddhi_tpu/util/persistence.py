@@ -117,3 +117,123 @@ class FileSystemPersistenceStore(PersistenceStore):
                     os.remove(os.path.join(d, f))
                 except OSError:
                     pass
+
+
+class IncrementalPersistenceStore:
+    """SPI for base+increment persistence (reference:
+    util/persistence/IncrementalPersistenceStore.java): revisions carry a
+    kind ('base' | 'inc'); restore needs the newest base plus every
+    increment after it, in order."""
+
+    def save(self, app_name: str, revision: str, kind: str, data: bytes):
+        raise NotImplementedError
+
+    def load_chain(self, app_name: str, until_revision: Optional[str] = None):
+        """-> (base_revision, base_bytes, [(inc_revision, inc_bytes), ...])
+        or None when no base exists.  With ``until_revision``, the chain
+        stops at that revision (newest base at or before it plus the
+        increments between)."""
+        raise NotImplementedError
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str):
+        raise NotImplementedError
+
+
+class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
+    """``<base>/<app>/<revision>.base|.inc`` files (reference:
+    IncrementalFileSystemPersistenceStore.java).  Old bases and their
+    increment chains are pruned, keeping ``bases_to_keep`` chains."""
+
+    def __init__(self, base_dir: str, bases_to_keep: int = 2):
+        self.base_dir = base_dir
+        self.bases_to_keep = bases_to_keep
+        self._lock = threading.Lock()
+
+    def _app_dir(self, app_name: str) -> str:
+        return os.path.join(self.base_dir, app_name)
+
+    def _entries(self, app_name: str) -> List[tuple]:
+        """[(ts, revision, kind)] sorted by timestamp."""
+        d = self._app_dir(app_name)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for f in os.listdir(d):
+            if f.endswith(".tmp"):
+                continue
+            if f.endswith(".base") or f.endswith(".inc"):
+                rev, kind = f.rsplit(".", 1)
+                try:
+                    ts = int(rev.split("_", 1)[0])
+                except ValueError:
+                    continue
+                out.append((ts, rev, kind))
+        return sorted(out)
+
+    def save(self, app_name: str, revision: str, kind: str, data: bytes):
+        assert kind in ("base", "inc")
+        with self._lock:
+            d = self._app_dir(app_name)
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, f"{revision}.{kind}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(d, f"{revision}.{kind}"))
+            if kind == "base":
+                self._prune(app_name)
+
+    def _prune(self, app_name: str):
+        entries = self._entries(app_name)
+        base_ts = [ts for ts, _, kind in entries if kind == "base"]
+        if len(base_ts) <= self.bases_to_keep:
+            return
+        cutoff = sorted(base_ts)[-self.bases_to_keep]
+        d = self._app_dir(app_name)
+        for ts, rev, kind in entries:
+            if ts < cutoff:
+                try:
+                    os.remove(os.path.join(d, f"{rev}.{kind}"))
+                except OSError:
+                    pass
+
+    def load_chain(self, app_name: str, until_revision: Optional[str] = None):
+        with self._lock:
+            entries = self._entries(app_name)
+            if until_revision is not None:
+                try:
+                    limit = int(until_revision.split("_", 1)[0])
+                except ValueError:
+                    return None
+                entries = [e for e in entries if e[0] <= limit]
+            bases = [(ts, rev) for ts, rev, kind in entries if kind == "base"]
+            if not bases:
+                return None
+            base_ts, base_rev = bases[-1]
+            d = self._app_dir(app_name)
+            with open(os.path.join(d, f"{base_rev}.base"), "rb") as f:
+                base_bytes = f.read()
+            incs = []
+            for ts, rev, kind in entries:
+                if kind == "inc" and ts > base_ts:
+                    with open(os.path.join(d, f"{rev}.inc"), "rb") as f:
+                        incs.append((rev, f.read()))
+            return base_rev, base_bytes, incs
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            entries = self._entries(app_name)
+            return entries[-1][1] if entries else None
+
+    def clear_all_revisions(self, app_name: str):
+        with self._lock:
+            d = self._app_dir(app_name)
+            if not os.path.isdir(d):
+                return
+            for f in os.listdir(d):
+                try:
+                    os.remove(os.path.join(d, f))
+                except OSError:
+                    pass

@@ -536,3 +536,16 @@ class TestMisc:
         app = parse('define stream S (a string); from S[a == """x "y" z"""] select a insert into O;')
         f = app.queries[0].input_stream.handlers[0]
         assert f.expression.right.value == 'x "y" z'
+
+
+class TestScriptFunctions:
+    def test_parse_function_definition(self):
+        from siddhi_tpu.compiler import SiddhiCompiler
+
+        app = SiddhiCompiler.parse(
+            "define function double[python] return long { data[0] * 2 }; "
+            "define stream S (v long); from S select double(v) as d insert into O;"
+        )
+        fd = app.function_definitions["double"]
+        assert fd.language == "python"
+        assert "data[0] * 2" in fd.body

@@ -1,0 +1,107 @@
+"""Config plane tests (reference: util/config/ + config test cases)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.util.config import (
+    ConfigReader,
+    InMemoryConfigManager,
+    YAMLConfigManager,
+)
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+YAML_DOC = """
+properties:
+  deployment.mode: test
+extensions:
+  - extension:
+      namespace: source
+      name: inMemory
+      properties:
+        default.prefix: pfx
+refs:
+  - ref:
+      name: bus1
+      type: inMemory
+      properties:
+        topic: cfg-topic
+"""
+
+
+class TestConfigManagers:
+    def test_in_memory_reader(self):
+        cm = InMemoryConfigManager(
+            {"source.http.port": "8280", "global.prop": "x"},
+            {"ref1": {"type": "inMemory", "topic": "t"}},
+        )
+        r = cm.generate_config_reader("source", "http")
+        assert r.read_config("port") == "8280"
+        assert r.read_config("missing", "dflt") == "dflt"
+        assert cm.extract_system_configs("ref1")["topic"] == "t"
+        assert cm.extract_property("global.prop") == "x"
+
+    def test_yaml_manager(self):
+        cm = YAMLConfigManager(YAML_DOC)
+        assert cm.extract_property("deployment.mode") == "test"
+        r = cm.generate_config_reader("source", "inMemory")
+        assert r.read_config("default.prefix") == "pfx"
+        refs = cm.extract_system_configs("bus1")
+        assert refs == {"type": "inMemory", "topic": "cfg-topic"}
+        assert cm.generate_config_reader("sink", "nope").get_all_configs() == {}
+
+    def test_source_by_ref(self, manager):
+        import time
+
+        from siddhi_tpu.transport.broker import InMemoryBroker
+
+        manager.set_config_manager(YAMLConfigManager(YAML_DOC))
+        rt = manager.create_siddhi_app_runtime(
+            "@source(ref='bus1', @map(type='passThrough')) "
+            "define stream S (v long); "
+            "from S[v > 1] select v insert into Out;"
+        )
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.start()
+        InMemoryBroker.publish("cfg-topic", [5])
+        time.sleep(0.1)
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [5]
+
+    def test_undefined_ref_raises(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        with pytest.raises(SiddhiAppCreationError):
+            manager.create_siddhi_app_runtime(
+                "@source(ref='nope', @map(type='passThrough')) "
+                "define stream S (v long); from S select v insert into O;"
+            )
+
+    def test_store_config_reader_passed(self, manager):
+        from siddhi_tpu.table import InMemoryRecordStore
+
+        seen = {}
+
+        class CfgStore(InMemoryRecordStore):
+            def init(self, definition, options, config_reader=None):
+                super().init(definition, options, config_reader)
+                seen["reader"] = config_reader
+
+        manager.set_extension("cfgstore", CfgStore, kind="store")
+        manager.set_config_manager(InMemoryConfigManager(
+            {"store.cfgstore.flush.interval": "9"}
+        ))
+        rt = manager.create_siddhi_app_runtime(
+            "@store(type='cfgstore') define table T (v long); "
+            "define stream S (v long); from S select v insert into T;"
+        )
+        rt.start()
+        rt.shutdown()
+        assert seen["reader"].read_config("flush.interval") == "9"

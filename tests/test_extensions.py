@@ -1,0 +1,134 @@
+"""Extension SPI tests (reference: query/extension/*TestCase.java —
+custom functions/windows registered via siddhiManager.setExtension, and
+script-defined functions)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.extension.function import FunctionExecutor
+from siddhi_tpu.query_api import AttrType
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run(manager, app, rows, out="O", stream="S"):
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(evs))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for r in rows:
+        h.send(r)
+    rt.shutdown()
+    return got
+
+
+class TestScriptFunctions:
+    def test_python_expression_body(self, manager):
+        got = run(manager,
+                  "define function double[python] return long { data[0] * 2 }; "
+                  "define stream S (v long); "
+                  "from S select double(v) as d insert into O;",
+                  [[21]])
+        assert [e.data[0] for e in got] == [42]
+
+    def test_python_statement_body_result(self, manager):
+        got = run(manager,
+                  "define function tag[python] return string "
+                  "{ result = 'v=' + str(data[0]) }; "
+                  "define stream S (v long); "
+                  "from S select tag(v) as t insert into O;",
+                  [[7]])
+        assert [e.data[0] for e in got] == ["v=7"]
+
+    def test_two_arg_script(self, manager):
+        got = run(manager,
+                  "define function addem[python] return long { data[0] + data[1] }; "
+                  "define stream S (a long, b long); "
+                  "from S select addem(a, b) as s insert into O;",
+                  [[3, 4]])
+        assert [e.data[0] for e in got] == [7]
+
+    def test_script_in_filter(self, manager):
+        got = run(manager,
+                  "define function isBig[python] return bool { data[0] > 10 }; "
+                  "define stream S (v long); "
+                  "from S[isBig(v)] select v insert into O;",
+                  [[5], [50]])
+        assert [e.data[0] for e in got] == [50]
+
+    def test_unknown_language_raises(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        with pytest.raises(SiddhiAppCreationError):
+            manager.create_siddhi_app_runtime(
+                "define function f[cobol] return long { 42 }; "
+                "define stream S (v long); from S select f() as x insert into O;"
+            )
+
+    def test_javascript_needs_engine(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        with pytest.raises(SiddhiAppCreationError, match="JavaScript"):
+            manager.create_siddhi_app_runtime(
+                "define function f[javascript] return long { return 42; }; "
+                "define stream S (v long); from S select f() as x insert into O;"
+            )
+
+
+class TestCustomFunctionExtension:
+    def test_function_executor(self, manager):
+        class PlusOne(FunctionExecutor):
+            return_type = AttrType.LONG
+
+            def execute(self, v):
+                return v + 1
+
+        manager.set_extension("custom:plusOne", PlusOne, kind="function")
+        got = run(manager,
+                  "define stream S (v long); "
+                  "from S select custom:plusOne(v) as d insert into O;",
+                  [[41]])
+        assert [e.data[0] for e in got] == [42]
+
+    def test_plain_callable(self, manager):
+        manager.set_extension("sq", lambda v: v * v, kind="function")
+        got = run(manager,
+                  "define stream S (v long); from S select sq(v) as d insert into O;",
+                  [[9]])
+        assert [e.data[0] for e in got] == [81]
+
+    def test_remove_extension(self, manager):
+        manager.set_extension("gone", lambda v: v, kind="function")
+        manager.remove_extension("gone", kind="function")
+        with pytest.raises(Exception):
+            manager.create_siddhi_app_runtime(
+                "define stream S (v long); from S select gone(v) as d insert into O;"
+            )
+
+
+class TestCustomWindowExtension:
+    def test_custom_window(self, manager):
+        from siddhi_tpu.ops.windows import LengthWindow
+
+        class KeepOne(LengthWindow):
+            def __init__(self, args, attribute_names):
+                # fixed capacity 1 regardless of args
+                from siddhi_tpu.planner.expr import CompiledExpression
+                from siddhi_tpu.query_api import AttrType as T
+
+                one = CompiledExpression(lambda env: 1, T.INT)
+                super().__init__([one], attribute_names)
+
+        manager.set_extension("custom:keepOne", KeepOne, kind="window")
+        got = run(manager,
+                  "define stream S (v long); "
+                  "from S#window.custom:keepOne() select sum(v) as t "
+                  "insert into O;",
+                  [[1], [2], [3]])
+        assert [e.data[0] for e in got] == [1, 2, 3]

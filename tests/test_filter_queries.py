@@ -266,3 +266,38 @@ class TestLengthWindows:
         assert pairs[0][1] is None
         assert [e.data for e in pairs[1][0]] == [[2]]
         assert [e.data for e in pairs[1][1]] == [[1]]
+
+
+class TestManagerApis:
+    def test_validate_ok(self, manager):
+        manager.validate_siddhi_app(
+            "define stream S (v long); from S[v > 1] select v insert into O;"
+        )
+        # validation does not leave a runtime registered
+        assert manager.get_siddhi_app_runtimes() == {}
+
+    def test_validate_bad_raises(self, manager):
+        import pytest as _pytest
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        with _pytest.raises(Exception):
+            manager.validate_siddhi_app(
+                "define stream S (v long); from S[nope > 1] select v insert into O;"
+            )
+
+    def test_sandbox_strips_transports(self, manager):
+        rt = manager.create_sandbox_siddhi_app_runtime(
+            "@source(type='doesNotExist', topic='x', @map(type='passThrough')) "
+            "define stream S (v long); "
+            "@store(type='alsoMissing') define table T (v long); "
+            "from S select v insert into T;"
+        )
+        rt.start()
+        rt.get_input_handler("S").send([7])
+        events = rt.query("from T select v")
+        rt.shutdown()
+        assert [e.data[0] for e in events] == [7]
+
+    def test_set_attribute(self, manager):
+        manager.set_attribute("shared", {"x": 1})
+        assert manager.get_attributes()["shared"] == {"x": 1}

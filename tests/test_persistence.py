@@ -164,3 +164,66 @@ def test_persist_without_store_raises():
     with pytest.raises(SiddhiAppRuntimeError):
         rt.persist()
     m.shutdown()
+
+
+class TestIncrementalPersistence:
+    def test_incremental_persist_restore(self, manager, tmp_path):
+        from siddhi_tpu.util.persistence import IncrementalFileSystemPersistenceStore
+
+        store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+        manager.set_persistence_store(store)
+        app = (
+            "@app:name('incApp') "
+            "define stream S (sym string, v long); "
+            "define table T (sym string, total long); "
+            "from S select sym, v as total insert into T;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["A", 1])
+        rev1 = rt.persist()          # first persist -> base
+        h.send(["B", 2])
+        rev2 = rt.persist()          # -> increment with only table delta
+        h.send(["C", 3])             # not persisted
+
+        import os
+        files = sorted(os.listdir(tmp_path / rt.name))
+        assert any(f.endswith(".base") for f in files), files
+        assert any(f.endswith(".inc") for f in files), files
+
+        rt.shutdown()
+        rt2 = manager.create_siddhi_app_runtime(app)
+        rt2.start()
+        restored = rt2.restore_last_revision()
+        assert restored == rev2
+        events = rt2.query("from T select sym")
+        assert sorted(e.data[0] for e in events) == ["A", "B"]
+        rt2.shutdown()
+
+    def test_increment_smaller_than_base(self, manager, tmp_path):
+        from siddhi_tpu.util.persistence import IncrementalFileSystemPersistenceStore
+
+        store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+        manager.set_persistence_store(store)
+        app = (
+            "define stream S (sym string, v long); "
+            "define table T (sym string, total long); "
+            "define table U (sym string, total long); "
+            "from S[v < 100] select sym, v as total insert into T; "
+            "from S[v >= 100] select sym, v as total insert into U;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(50):
+            h.send([f"row{i}", i])
+        rt.persist()                 # base holds 50 rows in T
+        h.send(["only-u", 500])      # only table U changes
+        rt.persist()
+        import os
+        d = tmp_path / rt.name
+        base = next(f for f in os.listdir(d) if f.endswith(".base"))
+        inc = next(f for f in os.listdir(d) if f.endswith(".inc"))
+        assert os.path.getsize(d / inc) < os.path.getsize(d / base)
+        rt.shutdown()

@@ -1,0 +1,175 @@
+"""Record (store-backed) table + cache tests.
+
+Reference: query/table/store/* and cache test cases — @store tables
+route CRUD/find through the AbstractRecordTable SPI with condition
+push-down, optionally behind a FIFO/LRU/LFU cache.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.table import (
+    AbstractRecordTable,
+    InMemoryRecordStore,
+    TableCache,
+)
+from siddhi_tpu.table.record import (
+    StoreCompare,
+    StoreParam,
+    StoreTrue,
+    evaluate_store_condition,
+)
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+APP = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream CheckStream (symbol string); "
+    "@store(type='memory') @PrimaryKey('symbol') "
+    "define table StockTable (symbol string, price float, volume long); "
+    "from StockStream insert into StockTable; "
+)
+
+
+def start(manager, app):
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(evs))
+    rt.start()
+    return rt, got
+
+
+class TestRecordTable:
+    def test_insert_and_join(self, manager):
+        rt, got = start(manager, APP + (
+            "from CheckStream join StockTable on CheckStream.symbol == StockTable.symbol "
+            "select CheckStream.symbol as symbol, StockTable.price as price "
+            "insert into OutStream;"
+        ))
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("StockStream").send(["WSO2", 57.5, 10])
+        rt.get_input_handler("CheckStream").send(["IBM"])
+        rt.shutdown()
+        assert [e.data for e in got] == [["IBM", 75.5]]
+
+    def test_update(self, manager):
+        rt, got = start(manager, APP + (
+            "define stream UpdateStream (symbol string, price float); "
+            "from UpdateStream update StockTable set StockTable.price = price "
+            "on StockTable.symbol == symbol; "
+            "from CheckStream join StockTable on CheckStream.symbol == StockTable.symbol "
+            "select StockTable.price as price insert into OutStream;"
+        ))
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("UpdateStream").send(["IBM", 100.0])
+        rt.get_input_handler("CheckStream").send(["IBM"])
+        rt.shutdown()
+        assert [e.data for e in got] == [[100.0]]
+
+    def test_delete(self, manager):
+        rt, got = start(manager, APP + (
+            "define stream DeleteStream (symbol string); "
+            "from DeleteStream delete StockTable on StockTable.symbol == symbol; "
+            "from CheckStream join StockTable on CheckStream.symbol == StockTable.symbol "
+            "select StockTable.price as price insert into OutStream;"
+        ))
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("DeleteStream").send(["IBM"])
+        rt.get_input_handler("CheckStream").send(["IBM"])
+        rt.shutdown()
+        assert got == []
+
+    def test_in_table_membership(self, manager):
+        rt, got = start(manager, APP + (
+            "from CheckStream[CheckStream.symbol in StockTable] "
+            "select symbol insert into OutStream;"
+        ))
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("CheckStream").send(["IBM"])
+        rt.get_input_handler("CheckStream").send(["MSFT"])
+        rt.shutdown()
+        assert [e.data for e in got] == [["IBM"]]
+
+    def test_on_demand_query(self, manager):
+        rt = manager.create_siddhi_app_runtime(APP)
+        rt.start()
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("StockStream").send(["WSO2", 57.5, 10])
+        events = rt.query("from StockTable select symbol, price")
+        rt.shutdown()
+        assert sorted(e.data[0] for e in events) == ["IBM", "WSO2"]
+
+    def test_custom_store_spi(self, manager):
+        calls = []
+
+        class SpyStore(InMemoryRecordStore):
+            def find(self, condition, params):
+                calls.append(("find", condition, dict(params)))
+                return super().find(condition, params)
+
+        manager.set_extension("spy", SpyStore, kind="store")
+        app = APP.replace("type='memory'", "type='spy'")
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        rt.get_input_handler("StockStream").send(["WSO2", 57.5, 10])
+        events = rt.query("from StockTable on symbol == 'IBM' select symbol, volume")
+        rt.shutdown()
+        assert [e.data for e in events] == [["IBM", 100]]
+        # the pk-equality condition was pushed down, not a full scan
+        assert any(isinstance(c[1], StoreCompare) for c in calls), calls
+
+
+class TestStoreConditionIR:
+    def test_evaluate(self):
+        ir = StoreCompare("price", ">", StoreParam("p0"))
+        assert evaluate_store_condition(ir, {"price": 10}, {"p0": 5})
+        assert not evaluate_store_condition(ir, {"price": 10}, {"p0": 50})
+        assert evaluate_store_condition(StoreTrue(), {"x": 1}, {})
+
+
+class TestTableCache:
+    def test_fifo_eviction(self):
+        c = TableCache(2, "FIFO")
+        c.put("a", 1)
+        c.put("b", 2)
+        c.get("a")
+        c.put("c", 3)  # evicts 'a' (insertion order, hits irrelevant)
+        assert c.get("a") is None and c.get("b") == 2 and c.get("c") == 3
+
+    def test_lru_eviction(self):
+        c = TableCache(2, "LRU")
+        c.put("a", 1)
+        c.put("b", 2)
+        c.get("a")      # 'a' recently used
+        c.put("c", 3)   # evicts 'b'
+        assert c.get("b") is None and c.get("a") == 1 and c.get("c") == 3
+
+    def test_lfu_eviction(self):
+        c = TableCache(2, "LFU")
+        c.put("a", 1)
+        c.put("b", 2)
+        c.get("a")
+        c.get("a")
+        c.get("b")
+        c.put("c", 3)   # evicts 'b' (freq 2 < a's 3)
+        assert c.get("b") is None and c.get("a") == 1 and c.get("c") == 3
+
+    def test_cached_pk_lookup_hits(self, manager):
+        app = APP.replace("@store(type='memory')",
+                          "@store(type='memory', @cache(size='10', cache.policy='LRU'))")
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        rt.get_input_handler("StockStream").send(["IBM", 75.5, 100])
+        table = rt.tables["StockTable"]
+        for _ in range(3):
+            events = rt.query("from StockTable on symbol == 'IBM' select price")
+            assert [e.data for e in events] == [[75.5]]
+        rt.shutdown()
+        assert table.cache.hits >= 2  # first pk probe misses, rest hit
