@@ -1,0 +1,39 @@
+"""Quick-start: custom function extension + script UDF (reference:
+quickstart-samples ExtensionSample.java)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.extension.function import FunctionExecutor
+from siddhi_tpu.query_api import AttrType
+
+
+class StringConcatFunction(FunctionExecutor):
+    """custom:plus(a, b) — concatenates its arguments."""
+
+    return_type = AttrType.STRING
+
+    def execute(self, *values):
+        return "".join(str(v) for v in values)
+
+
+def main():
+    manager = SiddhiManager()
+    manager.set_extension("custom:plus", StringConcatFunction, kind="function")
+    runtime = manager.create_siddhi_app_runtime(
+        "define function tax[python] return double { data[0] * 1.2 }; "
+        "define stream Orders (item string, price double); "
+        "from Orders select custom:plus('item-', item) as label, tax(price) as gross "
+        "insert into Priced;"
+    )
+    runtime.add_callback("Priced", lambda events: [print(e) for e in events])
+    runtime.start()
+    runtime.get_input_handler("Orders").send(["book", 10.0])
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Quick-start: per-key partitioned query (reference:
+quickstart-samples PartitionSample.java)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(
+        "define stream LoginStream (user string, latency long); "
+        "partition with (user of LoginStream) begin "
+        "  @info(name='perUser') "
+        "  from LoginStream select user, sum(latency) as total insert into UserTotals; "
+        "end;"
+    )
+    runtime.add_callback("UserTotals", lambda events: [print(e) for e in events])
+    runtime.start()
+    h = runtime.get_input_handler("LoginStream")
+    h.send(["alice", 10])
+    h.send(["bob", 5])
+    h.send(["alice", 7])   # alice's running sum is isolated from bob's
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Quick-start: pattern detection (the engine's north-star path):
+every price-rise pair within 5 seconds."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(
+        "define stream Ticks (symbol string, price double); "
+        "@info(name='rise') "
+        "from every e1=Ticks -> e2=Ticks[price > e1.price] within 5 sec "
+        "select e1.price as low, e2.price as high insert into Rises;"
+    )
+    runtime.add_callback("Rises", lambda events: [print(e) for e in events])
+    runtime.start()
+    h = runtime.get_input_handler("Ticks")
+    h.send(["ACME", 10.0])
+    h.send(["ACME", 12.5])
+    h.send(["ACME", 11.0])
+    h.send(["ACME", 14.0])
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Single filter query throughput (reference: performance-samples
+SimpleFilterSingleQueryPerformance.java:51 — prints steady-state
+events/sec and average in-pipeline latency every batch window).
+
+Run: python samples/performance/filter_single_query_performance.py [seconds]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import EventBatch
+
+
+def main(seconds: float = 5.0):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(
+        "define stream StockStream (symbol string, price float, volume long); "
+        "@info(name='filter') from StockStream[volume < 150] "
+        "select symbol, price insert into OutputStream;"
+    )
+    n_out = [0]
+    runtime.add_callback("OutputStream", lambda evs: n_out.__setitem__(0, n_out[0] + len(evs)))
+    runtime.start()
+    h = runtime.get_input_handler("StockStream")
+
+    B = 8192
+    batch = EventBatch(
+        "StockStream",
+        ["symbol", "price", "volume"],
+        {
+            "symbol": np.asarray(["WSO2"] * B, dtype=object),
+            "price": np.full(B, 55.6, dtype=np.float32),
+            "volume": (np.arange(B) % 300).astype(np.int64),
+        },
+        np.zeros(B, dtype=np.int64),
+    )
+    # warmup
+    for _ in range(5):
+        h.send_batch(batch)
+    sent = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        h.send_batch(batch)
+        sent += B
+    dt = time.perf_counter() - t0
+    print(f"events sent      : {sent}")
+    print(f"events matched   : {n_out[0]}")
+    print(f"throughput       : {sent / dt:,.0f} events/sec")
+    print(f"avg latency      : {dt / (sent / B) * 1e3:.3f} ms/batch ({B} events)")
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 5.0)

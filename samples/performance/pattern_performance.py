@@ -1,0 +1,58 @@
+"""Pattern-matching throughput on the dense NFA — the north-star path
+(reference: the JVM equivalent runs StreamPreStateProcessor chains with
+per-event locking; see BASELINE.md).
+
+Run: python samples/performance/pattern_performance.py [seconds]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main(seconds: float = 5.0):
+    from siddhi_tpu.ops.dense_nfa import compile_pattern
+
+    app = (
+        "define stream Txn (key long, v double); "
+        "@info(name='fraud') "
+        "from every a=Txn[v > 100.0] -> b=Txn[v > a.v]<3:5> within 10 min "
+        "select a.v as base, b[0].v as b0 insert into Alerts;"
+    )
+    N_PART, B = 1 << 17, 1 << 15
+    eng = compile_pattern(app, "fraud", n_partitions=N_PART)
+    state = eng.init_state()
+    step = eng.make_step("Txn", jit=True)
+    jnp = eng.jnp
+    rng = np.random.default_rng(7)
+    part = jnp.asarray(rng.integers(0, N_PART, B).astype(np.int32))
+    cols = {
+        "v": jnp.asarray(rng.uniform(50, 500, B).astype(np.float32)),
+        "key": jnp.asarray(np.zeros(B, dtype=np.float32)),
+    }
+    ts = jnp.asarray(np.full(B, 1_000, dtype=np.int32))
+    valid = jnp.ones(B, dtype=bool)
+
+    # warmup/compile
+    state, emit, out_vals = step(state, part, cols, ts, valid)
+    import jax
+
+    jax.block_until_ready(emit)
+    sent = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        state, emit, out_vals = step(state, part, cols, ts, valid)
+        sent += B
+    jax.block_until_ready(emit)
+    dt = time.perf_counter() - t0
+    print(f"events processed : {sent}")
+    print(f"throughput       : {sent / dt:,.0f} events/sec "
+          f"({N_PART} partitions, batch {B})")
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 5.0)

@@ -1,0 +1,34 @@
+"""Quick-start: filter query (reference: quickstart-samples
+SimpleFilterSample.java).
+
+Run: python samples/simple_filter_sample.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(
+        "define stream StockStream (symbol string, price float, volume long); "
+        "@info(name='query1') "
+        "from StockStream[volume < 150] select symbol, price insert into OutputStream;"
+    )
+    runtime.add_callback(
+        "OutputStream", lambda events: [print(e) for e in events]
+    )
+    runtime.start()
+    h = runtime.get_input_handler("StockStream")
+    h.send(["IBM", 700.0, 100])
+    h.send(["WSO2", 60.5, 200])
+    h.send(["GOOG", 50.0, 30])
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Quick-start: sliding time window aggregation (reference:
+quickstart-samples TimeWindowSample.java)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(
+        "define stream StockStream (symbol string, price float); "
+        "@info(name='query1') "
+        "from StockStream#window.time(500 millisec) "
+        "select symbol, avg(price) as avgPrice group by symbol "
+        "insert into OutputStream;"
+    )
+    runtime.add_callback("OutputStream", lambda events: [print(e) for e in events])
+    runtime.start()
+    h = runtime.get_input_handler("StockStream")
+    h.send(["IBM", 100.0])
+    h.send(["IBM", 200.0])
+    time.sleep(0.6)   # window slides; IBM events expire
+    h.send(["IBM", 300.0])
+    runtime.shutdown()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,18 @@ class SiddhiAppRuntime:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def debug(self):
+        """Start in debug mode: returns a SiddhiDebugger wired to every
+        query terminal (reference: SiddhiAppRuntimeImpl.debug:657)."""
+        from siddhi_tpu.debugger import SiddhiDebugger
+
+        debugger = SiddhiDebugger(self)
+        for qr in self.query_runtimes.values():
+            if hasattr(qr, "debugger"):
+                qr.debugger = debugger
+        self.start()
+        return debugger
+
     def start(self):
         if self.running:
             return
@@ -130,7 +142,10 @@ class SiddhiAppRuntime:
                 t.shutdown()
         self.running = False
         if self._manager is not None:
-            self._manager._app_runtimes.pop(self.name, None)
+            # identity-guarded: an unregistered or replaced runtime must
+            # not evict a different runtime registered under this name
+            if self._manager._app_runtimes.get(self.name) is self:
+                self._manager._app_runtimes.pop(self.name, None)
 
     # -- I/O ----------------------------------------------------------------
 

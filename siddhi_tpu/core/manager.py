@@ -20,7 +20,8 @@ class SiddhiManager:
         self.siddhi_context = SiddhiContext()
         self._app_runtimes: Dict[str, SiddhiAppRuntime] = {}
 
-    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp],
+                                  register: bool = True) -> SiddhiAppRuntime:
         from siddhi_tpu.planner.app_planner import AppPlanner
 
         if isinstance(app, str):
@@ -31,7 +32,8 @@ class SiddhiManager:
             siddhi_app = app
         runtime = AppPlanner(siddhi_app, app_string, self.siddhi_context).build()
         runtime._manager = self
-        self._app_runtimes[runtime.name] = runtime
+        if register:
+            self._app_runtimes[runtime.name] = runtime
         return runtime
 
     # Java-style alias
@@ -41,7 +43,8 @@ class SiddhiManager:
         """Plan the app end-to-end, then discard it — raises
         SiddhiAppCreationError/SiddhiParserError on any problem
         (reference: SiddhiManager.validateSiddhiApp:144-165)."""
-        runtime = self.create_siddhi_app_runtime(app)
+        # unregistered: validating 'X' must not disturb a running 'X'
+        runtime = self.create_siddhi_app_runtime(app, register=False)
         runtime.shutdown()
 
     def create_sandbox_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:

@@ -32,6 +32,31 @@ from siddhi_tpu.query_api import (
 )
 
 
+
+def _rewrite_stream_refs(expr, old_ref: str, new_ref: str):
+    """Replace Variable stream references 'old_ref.x' -> 'new_ref.x'
+    throughout an expression tree (frozen dataclasses -> rebuild)."""
+    import dataclasses
+
+    from siddhi_tpu.query_api import expression as X
+
+    def walk(e):
+        if isinstance(e, X.Variable):
+            if e.stream_id == old_ref:
+                return dataclasses.replace(e, stream_id=new_ref)
+            return e
+        if isinstance(e, X.FunctionCall):
+            return dataclasses.replace(e, args=tuple(walk(a) for a in e.args))
+        changes = {}
+        for f in ("left", "right", "expr"):
+            child = getattr(e, f, None)
+            if isinstance(child, X.Expression):
+                changes[f] = walk(child)
+        return dataclasses.replace(e, **changes) if changes else e
+
+    return walk(expr)
+
+
 class OnDemandQueryRuntime:
     """One compiled on-demand query, re-executable (the reference caches
     these in SiddhiAppRuntimeImpl.onDemandQueryRuntimeMap, cap 50)."""
@@ -107,11 +132,17 @@ class OnDemandQueryRuntime:
 
                 if isinstance(self.store, RecordTableRuntime):
                     # push the condition to the external store instead of
-                    # fetching every record and filtering host-side
+                    # fetching every record and filtering host-side; an
+                    # input alias is normalized to the table id first so
+                    # the merged table scope resolves it
                     from siddhi_tpu.table.table import compile_table_condition
 
+                    cond = odq.on_condition
+                    if odq.input_alias and odq.input_alias != self.store.table_id:
+                        cond = _rewrite_stream_refs(
+                            cond, odq.input_alias, self.store.table_id)
                     self._pushdown = compile_table_condition(
-                        self.store, odq.on_condition, Scope(),
+                        self.store, cond, Scope(),
                         extra_functions=getattr(self.app, "functions", None),
                         table_resolver=getattr(self.app, "table_resolver", None),
                     )

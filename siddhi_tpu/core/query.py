@@ -576,6 +576,7 @@ class QueryRuntime:
         self.app_context = app_context
         self.callback_output: Optional[QueryCallbackOutput] = None
         self.latency_tracker = None
+        self.debugger = None  # set by SiddhiAppRuntime.debug()
 
     def add_callback(self, cb: QueryCallback):
         if self.callback_output is None:
@@ -588,6 +589,8 @@ class QueryRuntime:
         if self.latency_tracker is not None:
             self.latency_tracker.mark_in(len(batch))
         try:
+            if self.debugger is not None and len(batch):
+                self.debugger.check_breakpoint(self.name, "IN", batch)
             b = batch
             for p in self.chains[chain_index]:
                 b = p.process(b, now)
@@ -596,6 +599,8 @@ class QueryRuntime:
             out = self.selector.process(b, now)
             out = self.rate_limiter.process(out, now)
             if out is not None and len(out):
+                if self.debugger is not None:
+                    self.debugger.check_breakpoint(self.name, "OUT", out)
                 self.output.send(out, now)
         finally:
             if self.latency_tracker is not None:

@@ -44,13 +44,14 @@ def make_scalar_function_builder(scalar: Callable, return_type: Optional[AttrTyp
 
     def builder(args: List[CompiledExpression]) -> CompiledExpression:
         nin = len(args)
+        ufunc = np.frompyfunc(scalar, nin, 1) if nin else None
 
         def fn(env):
             if nin == 0:
                 return scalar()
             vals = [np.atleast_1d(np.asarray(a.fn(env))) for a in args]
             vals = np.broadcast_arrays(*vals)
-            out = np.frompyfunc(scalar, nin, 1)(*vals)
+            out = ufunc(*vals)
             if return_type is not None and return_type != AttrType.OBJECT:
                 return _to_type(out, return_type)
             return out

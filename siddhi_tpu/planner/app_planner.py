@@ -291,11 +291,7 @@ class AppPlanner:
         store_ann = find_annotation(td.annotations, "store")
         if store_ann is None:
             return InMemoryTable(td)
-        options = self._resolve_ref(store_ann)
-        stype = store_ann.element("type") or options.get("type")
-        if stype is None:
-            raise SiddhiAppCreationError(
-                f"table '{td.id}': @store needs a type (inline or via ref)")
+        stype, options = self._transport_config(store_ann, "store")
         factory = self.extensions.lookup("store", stype)
         if factory is None:
             raise SiddhiAppCreationError(

@@ -1,0 +1,139 @@
+"""REST microservice wrapper: deploy/undeploy SiddhiQL apps over HTTP.
+
+Re-design of the reference ``modules/siddhi-service``
+(SiddhiApiServiceImpl.java:51 deploy, :100 undeploy) on the stdlib HTTP
+server instead of MSF4J:
+
+    POST /siddhi-artifact-deploy            body = SiddhiQL app string
+    GET  /siddhi-artifact-undeploy/{name}
+    GET  /siddhi-apps                       (list deployed app names)
+
+Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from siddhi_tpu.core.manager import SiddhiManager
+
+
+class SiddhiService:
+    """In-process deploy/undeploy service around one SiddhiManager."""
+
+    def __init__(self, manager: Optional[SiddhiManager] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager or SiddhiManager()
+        self._runtimes: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet test output
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/siddhi-artifact-deploy":
+                    self._send(404, {"status": "ERROR", "message": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                app_str = self.rfile.read(length).decode("utf-8")
+                code, payload = service.deploy(app_str)
+                self._send(code, payload)
+
+            def do_GET(self):
+                parts = self.path.rstrip("/").split("/")
+                if len(parts) == 3 and parts[1] == "siddhi-artifact-undeploy":
+                    code, payload = service.undeploy(parts[2])
+                    self._send(code, payload)
+                elif self.path.rstrip("/") == "/siddhi-apps":
+                    self._send(200, {"status": "OK", "apps": service.app_names()})
+                else:
+                    self._send(404, {"status": "ERROR", "message": "not found"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- operations (also usable without HTTP) -------------------------------
+
+    def deploy(self, app_str: str):
+        """reference: SiddhiApiServiceImpl.siddhiArtifactDeployPost:51"""
+        try:
+            with self._lock:
+                runtime = self.manager.create_siddhi_app_runtime(
+                    app_str, register=False)
+                if runtime.name in self._runtimes:
+                    runtime.shutdown()
+                    return 409, {
+                        "status": "ERROR",
+                        "message": f"Siddhi app '{runtime.name}' already exists",
+                    }
+                try:
+                    runtime.start()
+                except Exception:
+                    runtime.shutdown()
+                    raise
+                # register only once start() succeeded, so a failed deploy
+                # does not squat the name
+                self.manager._app_runtimes[runtime.name] = runtime
+                self._runtimes[runtime.name] = runtime
+            return 200, {
+                "status": "OK",
+                "message": "Siddhi app is deployed and runtime is created",
+                "name": runtime.name,
+            }
+        except Exception as e:  # noqa: BLE001 — surface planning errors to client
+            return 400, {"status": "ERROR", "message": str(e)}
+
+    def undeploy(self, name: str):
+        """reference: SiddhiApiServiceImpl.siddhiArtifactUndeploySiddhiAppGet:100"""
+        with self._lock:
+            runtime = self._runtimes.pop(name, None)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        runtime.shutdown()
+        return 200, {"status": "OK", "message": f"Siddhi app '{name}' undeployed"}
+
+    def app_names(self):
+        with self._lock:
+            return sorted(self._runtimes)
+
+    def get_runtime(self, name: str):
+        with self._lock:
+            return self._runtimes.get(name)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="siddhi-service", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            runtimes, self._runtimes = dict(self._runtimes), {}
+        for rt in runtimes.values():
+            rt.shutdown()

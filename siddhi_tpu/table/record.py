@@ -476,6 +476,24 @@ class RecordTableRuntime:
                 for r in rows:
                     self.cache.put(self._pk_key(r), r)
 
+    def _insert_row(self, row: Dict, ts: int):
+        """Single-row insert used by update-or-insert's miss branch
+        (signature matches InMemoryTable._insert_row; callers hold
+        self._lock).  Pk-duplicate rows replace via the store."""
+        rec = [_scalar(row[nm]) for nm in self._names]
+        if self.primary_keys:
+            params = self._row_param_map(rec)
+            if self.store.contains(self._row_ir, params):
+                set_map = dict(zip(self._names, rec))
+                self.handler.on_update(
+                    self._row_ir, [params], [set_map], self.store.update)
+                if self.cache is not None:
+                    self.cache.put(self._pk_key(rec), rec)
+                return
+        self.handler.on_add([rec], self.store.add)
+        if self.cache is not None and self.primary_keys:
+            self.cache.put(self._pk_key(rec), rec)
+
     def live_slots(self) -> np.ndarray:
         with self._lock:
             self._fetch_rows = self._find(StoreTrue(), {})

@@ -1,0 +1,120 @@
+"""Debugger tests (reference: debugger/SiddhiDebuggerTestCase.java —
+breakpoints at query IN/OUT, next/play stepping, state inspection)."""
+
+import threading
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.debugger import SiddhiDebugger
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+APP = (
+    "define stream S (symbol string, v long); "
+    "@info(name='q1') from S[v > 10] select symbol, v insert into Out; "
+    "@info(name='q2') from Out select symbol insert into Out2;"
+)
+
+
+def test_breakpoint_at_in_and_out(manager):
+    rt = manager.create_siddhi_app_runtime(APP)
+    hits = []
+
+    def on_debug(events, query, terminal, debugger):
+        hits.append((query, terminal, [e.data for e in events]))
+        debugger.play()  # resume from inside the callback
+
+    dbg = rt.debug()
+    dbg.set_debugger_callback(on_debug)
+    dbg.acquire_break_point("q1", SiddhiDebugger.QueryTerminal.IN)
+    dbg.acquire_break_point("q1", SiddhiDebugger.QueryTerminal.OUT)
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(evs))
+    rt.get_input_handler("S").send(["IBM", 50])
+    rt.shutdown()
+    assert hits == [
+        ("q1", "IN", [["IBM", 50]]),
+        ("q1", "OUT", [["IBM", 50]]),
+    ]
+    assert [e.data for e in got] == [["IBM", 50]]
+
+
+def test_next_steps_to_following_query(manager):
+    rt = manager.create_siddhi_app_runtime(APP)
+    hits = []
+
+    def on_debug(events, query, terminal, debugger):
+        hits.append((query, terminal))
+        if len(hits) < 3:
+            debugger.next()   # step: next checkpoint regardless of acquisition
+        else:
+            debugger.play()
+
+    dbg = rt.debug()
+    dbg.set_debugger_callback(on_debug)
+    dbg.acquire_break_point("q1", "IN")
+    rt.get_input_handler("S").send(["IBM", 50])
+    rt.shutdown()
+    # IN(q1) acquired; next() stops at OUT(q1); next() stops at IN(q2)
+    assert hits == [("q1", "IN"), ("q1", "OUT"), ("q2", "IN")]
+
+
+def test_release_breakpoint(manager):
+    rt = manager.create_siddhi_app_runtime(APP)
+    hits = []
+
+    def on_debug(events, query, terminal, debugger):
+        hits.append((query, terminal))
+        debugger.play()
+
+    dbg = rt.debug()
+    dbg.set_debugger_callback(on_debug)
+    dbg.acquire_break_point("q1", "IN")
+    rt.get_input_handler("S").send(["A", 20])
+    dbg.release_break_point("q1", "IN")
+    rt.get_input_handler("S").send(["B", 30])
+    rt.shutdown()
+    assert hits == [("q1", "IN")]
+
+
+def test_blocked_thread_resumed_externally(manager):
+    rt = manager.create_siddhi_app_runtime(APP)
+    dbg = rt.debug()
+    dbg.acquire_break_point("q1", "IN")
+    reached = threading.Event()
+    hits = []
+
+    def on_debug(events, query, terminal, debugger):
+        hits.append(query)
+        reached.set()  # no resume here: thread must block
+
+    dbg.set_debugger_callback(on_debug)
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(evs))
+    t = threading.Thread(target=lambda: rt.get_input_handler("S").send(["X", 99]))
+    t.start()
+    assert reached.wait(2)
+    assert not got  # still paused before the filter ran downstream
+    dbg.play()
+    t.join(2)
+    rt.shutdown()
+    assert [e.data for e in got] == [["X", 99]]
+
+
+def test_get_query_state(manager):
+    rt = manager.create_siddhi_app_runtime(
+        "define stream S (v long); "
+        "@info(name='w') from S#window.length(3) select sum(v) as t insert into O;"
+    )
+    dbg = rt.debug()
+    rt.get_input_handler("S").send([5])
+    state = dbg.get_query_state("w")
+    rt.shutdown()
+    assert state is not None and "windows" in state

@@ -1,0 +1,22 @@
+"""Doc generator tests (reference: siddhi-doc-gen renders @Extension
+metadata to markdown)."""
+
+from siddhi_tpu.docgen import generate_markdown
+
+
+def test_generates_all_kinds():
+    md = generate_markdown()
+    for heading in ("Windows", "Sources", "Sinks", "Stores", "Script languages"):
+        assert heading in md
+    # a few concrete extensions with their docstrings
+    assert "### `cron`" in md
+    assert "CronWindowProcessor" in md
+    assert "### `inMemory`" in md
+
+
+def test_cli_writes_file(tmp_path):
+    from siddhi_tpu.docgen import main
+
+    out = tmp_path / "ext.md"
+    assert main([str(out)]) == 0
+    assert out.read_text().startswith("# siddhi_tpu extensions")

@@ -1,0 +1,99 @@
+"""REST service tests (reference: siddhi-service deploy/undeploy API)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from siddhi_tpu.service import SiddhiService
+from siddhi_tpu.transport.broker import InMemoryBroker, Subscriber
+
+
+APP = (
+    "@app:name('restApp') "
+    "@source(type='inMemory', topic='rest-in', @map(type='passThrough')) "
+    "define stream S (v long); "
+    "@sink(type='inMemory', topic='rest-out', @map(type='passThrough')) "
+    "define stream Out (v long); "
+    "from S[v > 10] select v insert into Out;"
+)
+
+
+@pytest.fixture
+def service():
+    svc = SiddhiService()
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def post(url, body: str):
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_deploy_process_undeploy(service):
+    base = f"http://127.0.0.1:{service.port}"
+    status, payload = post(f"{base}/siddhi-artifact-deploy", APP)
+    assert status == 200 and payload["status"] == "OK"
+    assert payload["name"] == "restApp"
+
+    got = []
+
+    class Sub(Subscriber):
+        def on_message(self, msg):
+            got.append(msg)
+
+        def get_topic(self):
+            return "rest-out"
+
+    sub = Sub()
+    InMemoryBroker.subscribe(sub)
+    InMemoryBroker.publish("rest-in", [50])
+    InMemoryBroker.publish("rest-in", [5])
+    time.sleep(0.2)
+    InMemoryBroker.unsubscribe(sub)
+    assert [e.data for e in got] == [[50]]
+
+    status, payload = get(f"{base}/siddhi-apps")
+    assert payload["apps"] == ["restApp"]
+
+    status, payload = get(f"{base}/siddhi-artifact-undeploy/restApp")
+    assert status == 200 and payload["status"] == "OK"
+    assert service.app_names() == []
+
+
+def test_duplicate_deploy_conflicts(service):
+    base = f"http://127.0.0.1:{service.port}"
+    assert post(f"{base}/siddhi-artifact-deploy", APP)[0] == 200
+    try:
+        status, payload = post(f"{base}/siddhi-artifact-deploy", APP)
+    except urllib.error.HTTPError as e:
+        status, payload = e.code, json.loads(e.read())
+    assert status == 409 and payload["status"] == "ERROR"
+
+
+def test_bad_app_rejected(service):
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        status, payload = post(f"{base}/siddhi-artifact-deploy", "define nonsense;;;")
+    except urllib.error.HTTPError as e:
+        status, payload = e.code, json.loads(e.read())
+    assert status == 400 and payload["status"] == "ERROR"
+
+
+def test_undeploy_missing_404(service):
+    base = f"http://127.0.0.1:{service.port}"
+    status, payload = get(f"{base}/siddhi-artifact-undeploy/nope")
+    assert status == 404 and payload["status"] == "ERROR"
