@@ -511,6 +511,33 @@ class DensePatternEngine:
     def output_names(self) -> List[str]:
         return [name for name, _ in self.out_spec]
 
+    @property
+    def default_stream(self) -> str:
+        """Junction key of the pattern's first source stream (includes
+        the '#'/'!' prefix for inner/fault streams — make_step matches
+        on spec.stream_key, not the bare definition id)."""
+        for node in self.nodes:
+            for spec in node.specs:
+                return spec.stream_key
+        raise SiddhiAppCreationError("pattern has no source streams")
+
+    @property
+    def stream_keys(self) -> List[str]:
+        keys = []
+        for node in self.nodes:
+            for spec in node.specs:
+                if spec.stream_key not in keys:
+                    keys.append(spec.stream_key)
+        return keys
+
+    def stream_attrs(self, stream_key: str) -> List[str]:
+        """Column keys the step expects for events of one stream."""
+        for node in self.nodes:
+            for spec in node.specs:
+                if spec.stream_key == stream_key:
+                    return list(spec.stream_def.attribute_names)
+        raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
+
 
 def _collision_rounds(part_idx: np.ndarray) -> List[np.ndarray]:
     """Split indices into rounds where each partition appears at most once,

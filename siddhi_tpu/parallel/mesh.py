@@ -1,0 +1,239 @@
+"""Mesh construction, sharded pattern-engine wrapper, event routing."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+
+def distributed_initialize(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None):
+    """Multi-host bring-up: one JAX process per host, ICI within a slice,
+    DCN across slices (the reference has no analog — its clustering is
+    an external k8s operator).  Safe to call once per process before any
+    other JAX call."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "p",
+              devices=None):
+    """1-D device mesh over the partition axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise SiddhiAppCreationError(
+                f"need {n_devices} devices, have {len(devices)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count for CPU testing)"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=(axis_name,))
+
+
+def _pow2(n: int, floor: int = 16) -> int:
+    return max(1 << (max(n, 1) - 1).bit_length(), floor)
+
+
+def route_to_shards(n_shards: int, parts_per_shard: int,
+                    part: np.ndarray, cols: Dict[str, np.ndarray],
+                    ts: np.ndarray,
+                    batch_per_shard: Optional[int] = None
+                    ) -> Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray,
+                               np.ndarray, np.ndarray]:
+    """Host-side event routing: bucket a batch by owning shard
+    (``global_part // parts_per_shard``, shard-major layout), rewrite
+    partition ids to shard-local indices, and pad every shard's bucket
+    to the same pow-2 length (bounding jit recompilation, as the
+    unsharded wrapper does) so the result concatenates into one array
+    whose equal slices are the per-device inputs of a shard_map step.
+
+    Padded rows carry local index ``parts_per_shard`` — each shard's
+    dedicated scratch row — so their scatter-back can never collide
+    with a real partition's update.
+
+    Returns ``(local_part, cols, ts, valid, pos)`` where ``pos[i]`` is
+    the padded-slot index of input event ``i`` (for mapping per-event
+    emit/out rows back to inputs).  Callers must not route two events of
+    the same partition in one call (gather/scatter would race); use
+    :meth:`ShardedPatternEngine.process`, which splits collision rounds.
+    """
+    part = np.asarray(part)
+    owner = part // parts_per_shard
+    if len(part) and (owner.max() >= n_shards or owner.min() < 0):
+        raise SiddhiAppCreationError(
+            f"partition id out of range for {n_shards} x {parts_per_shard} layout")
+    counts = np.bincount(owner, minlength=n_shards)
+    max_count = int(counts.max()) if len(part) else 0
+    B = int(batch_per_shard) if batch_per_shard is not None else _pow2(max_count)
+    if max_count > B:
+        raise SiddhiAppCreationError(
+            f"shard bucket overflow: {max_count} events for one shard "
+            f"> batch_per_shard={B}")
+    n = n_shards * B
+    # scratch slot: local index parts_per_shard (one reserved row/shard)
+    local_part = np.full(n, parts_per_shard, dtype=np.int32)
+    out_ts = np.zeros(n, dtype=np.asarray(ts).dtype)
+    valid = np.zeros(n, dtype=bool)
+    out_cols = {k: np.zeros(n, dtype=np.asarray(v).dtype) for k, v in cols.items()}
+    # vectorized within-bucket rank (cumcount over stably-sorted owners)
+    order = np.argsort(owner, kind="stable")
+    sorted_owner = owner[order]
+    starts = np.searchsorted(sorted_owner, np.arange(n_shards), side="left")
+    rank_sorted = np.arange(len(part)) - starts[sorted_owner]
+    pos = np.empty(len(part), dtype=np.int64)
+    pos[order] = sorted_owner * B + rank_sorted
+    local_part[pos] = (part % parts_per_shard).astype(np.int32)
+    out_ts[pos] = np.asarray(ts)
+    valid[pos] = True
+    for k, v in cols.items():
+        out_cols[k][pos] = np.asarray(v)
+    return local_part, out_cols, out_ts, valid, pos
+
+
+class ShardedPatternEngine:
+    """A dense NFA engine sharded over a mesh's partition axis.
+
+    Wraps ``siddhi_tpu.ops.dense_nfa.compile_pattern``'s engine: state
+    rows are laid out shard-major with one scratch row per shard
+    (absorbing padded lanes), device_put with a ``P('p', ...)``
+    sharding, and the step runs under ``shard_map`` (shard-local state
+    access, psum'd global match count).
+
+    Use :meth:`process` for the safe high-level path (collision-round
+    splitting, relative-timestamp normalization, per-event output
+    mapping); ``route``/``step`` are the raw building blocks whose
+    callers must uphold those contracts themselves.
+    """
+
+    def __init__(self, engine, mesh, axis_name: str = "p",
+                 stream_key: Optional[str] = None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.engine = engine
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        if engine.n_partitions % self.n_shards:
+            raise SiddhiAppCreationError(
+                f"{engine.n_partitions} partitions not divisible by "
+                f"{self.n_shards} shards")
+        # usable partitions per shard; +1 scratch row per shard
+        self.parts_per_shard = engine.n_partitions // self.n_shards
+        self.rows_per_shard = self.parts_per_shard + 1
+
+        self.stream_key = stream_key or engine.default_stream
+        self.col_keys = engine.stream_attrs(self.stream_key)
+        step = engine.make_step(self.stream_key, jit=False)
+        jnp = engine.jnp
+        a = axis_name
+
+        self.state_specs = {
+            "active": P(a),
+            "first_ts": P(a, None),
+            "counts": P(a, None),
+            "regs": P(a, None, None),
+        }
+        specs = self.state_specs
+
+        def sharded_step(state, part, cols, ts, valid):
+            new_state, emit, out_vals = step(state, part, cols, ts, valid)
+            local = jnp.sum(emit.astype(jnp.int32))
+            total = jax.lax.psum(local, axis_name=a)
+            return new_state, emit, out_vals, total
+
+        self._step = jax.jit(jax.shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=(specs, P(a), {k: P(a) for k in self.col_keys},
+                      P(a), P(a)),
+            out_specs=(specs, P(a), P(a, None), P()),
+        ))
+        self._P = P
+        self._NamedSharding = NamedSharding
+        self._jax = jax
+
+    # -- state ---------------------------------------------------------------
+
+    def _put(self, x, spec):
+        return self._jax.device_put(
+            x, self._NamedSharding(self.mesh, spec))
+
+    def init_state(self):
+        """Zero state with shard-major layout: each shard owns
+        ``parts_per_shard`` partition rows plus one trailing scratch
+        row (same per-row init values as the unsharded engine)."""
+        host = {k: np.asarray(v) for k, v in self.engine.init_state().items()}
+        n_rows = self.n_shards * self.rows_per_shard
+        state = {}
+        for k, v in host.items():
+            arr = np.zeros((n_rows,) + v.shape[1:], dtype=v.dtype)
+            # replicate the engine's per-row init (row 0 of the host
+            # state — all rows are initialized identically)
+            arr[...] = v[0]
+            state[k] = self._put(arr, self.state_specs[k])
+        return state
+
+    # -- stepping ------------------------------------------------------------
+
+    def route(self, part, cols, ts, batch_per_shard=None):
+        """Host arrays -> device arrays routed/padded per shard; also
+        returns the input->slot map.  Caller contract: at most one event
+        per partition per call, timestamps already relative int32."""
+        P = self._P
+        a = self.axis_name
+        lp, rc, rts, valid, pos = route_to_shards(
+            self.n_shards, self.parts_per_shard, part, cols, ts,
+            batch_per_shard)
+        return (
+            self._put(lp, P(a)),
+            {k: self._put(np.asarray(v, dtype=np.float32), P(a)) for k, v in rc.items()},
+            self._put(np.asarray(rts, dtype=np.int32), P(a)),
+            self._put(valid, P(a)),
+        ), pos
+
+    def step(self, state, part, cols, ts, valid):
+        """One sharded step: ``(state', emit_mask, out_vals, global_matches)``."""
+        return self._step(state, part, cols, ts, valid)
+
+    def process(self, state, part: np.ndarray, cols: Dict[str, np.ndarray],
+                ts: np.ndarray):
+        """Safe batch entry point mirroring DensePatternEngine.process:
+        splits rounds so each partition appears at most once per step,
+        normalizes timestamps, and maps per-event emit/out rows back to
+        input order.  Returns ``(state, emit[n] bool, out[n, n_out],
+        total_matches)``."""
+        from siddhi_tpu.ops.dense_nfa import _collision_rounds
+
+        part = np.asarray(part)
+        rel = self.engine._rel_ts(np.asarray(ts, dtype=np.int64))
+        n = len(part)
+        emit_all = np.zeros(n, dtype=bool)
+        out_all = np.zeros((n, max(len(self.engine.out_spec), 1)), dtype=np.float32)
+        total = 0
+        for ridx in _collision_rounds(part):
+            args, pos = self.route(
+                part[ridx],
+                {k: np.asarray(v)[ridx] for k, v in cols.items()},
+                rel[ridx],
+            )
+            state, emit, out_vals, round_total = self.step(state, *args)
+            emit_np = np.asarray(emit)
+            out_np = np.asarray(out_vals)
+            emit_all[ridx] = emit_np[pos]
+            out_all[ridx] = out_np[pos]
+            total += int(round_total)
+        return state, emit_all, out_all, total
