@@ -79,14 +79,30 @@ def main():
         state, emit, _ = step(state, pi, cols, ts, valid)
     emit.block_until_ready()
 
-    t0 = time.perf_counter()
+    # throughput: several async-dispatched windows (sync once per window
+    # so XLA pipelines steps); the median window resists transient
+    # contention on a shared/tunneled chip
+    N_WINDOWS = 5
+    window_rates = []
+    for w in range(N_WINDOWS):
+        t_w = time.perf_counter()
+        for i in range(WARMUP, WARMUP + STEPS):
+            pi, cols, ts, valid = batches[i]
+            state, emit, _ = step(state, pi, cols, ts, valid)
+        emit.block_until_ready()
+        window_rates.append(BATCH * STEPS / (time.perf_counter() - t_w))
+    events_per_sec = float(np.median(window_rates))
+
+    # detection latency: separate synced pass (per-batch wall time incl.
+    # host round trip — the north-star's p99 axis)
+    per_step = []
     for i in range(WARMUP, WARMUP + STEPS):
         pi, cols, ts, valid = batches[i]
+        t0 = time.perf_counter()
         state, emit, _ = step(state, pi, cols, ts, valid)
-    emit.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    events_per_sec = BATCH * STEPS / dt
+        emit.block_until_ready()
+        per_step.append(time.perf_counter() - t0)
+    p99_batch_ms = float(np.percentile(np.asarray(per_step), 99) * 1e3)
     print(
         json.dumps(
             {
@@ -94,6 +110,10 @@ def main():
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / JVM_BASELINE_EVENTS_PER_SEC, 2),
+                "p99_batch_latency_ms": round(p99_batch_ms, 3),
+                "batch": BATCH,
+                "n_partitions": N_PARTITIONS,
+                "n_states": N_STATES,
             }
         )
     )

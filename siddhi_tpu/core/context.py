@@ -58,6 +58,10 @@ class SiddhiContext:
 
         self.config_manager = InMemoryConfigManager()
         self.attributes: Dict[str, object] = {}
+        self.data_sources: Dict[str, object] = {}
+        self.source_handler_manager = None
+        self.sink_handler_manager = None
+        self.record_table_handler_manager = None
 
 
 class SiddhiAppContext:

@@ -23,16 +23,16 @@ from siddhi_tpu.planner.expr import CompiledExpression, N_KEY, TS_KEY
 from siddhi_tpu.query_api import AttrType, JoinInputStream
 
 
-def _null_value(t: AttrType):
-    """Unmatched-side fill for outer joins.  Float lanes carry NaN (the
-    in-batch null); string/object lanes carry None; int/bool lanes have no
-    null representation and fill with zero (documented deviation from the
-    reference's boxed nulls)."""
+def _null_column(t: AttrType, n: int) -> np.ndarray:
+    """Unmatched-side fill for outer joins: a column of nulls.  Float
+    lanes carry NaN (the in-batch null); every other type switches the
+    lane to object dtype holding None so callbacks observe real nulls
+    (reference: boxed nulls in joined StateEvents)."""
     if t in (AttrType.FLOAT, AttrType.DOUBLE):
-        return np.nan
-    if t in (AttrType.STRING, AttrType.OBJECT):
-        return None
-    return 0
+        return np.full(n, np.nan, dtype=t.np_dtype)
+    col = np.empty(n, dtype=object)
+    col[:] = None
+    return col
 
 
 class JoinSide:
@@ -256,8 +256,7 @@ class JoinRuntime:
         for a in side.definition.attributes:
             cols[side.qualified_key(a.name)] = rows.columns[a.name]
         for a in other.definition.attributes:
-            fill = _null_value(a.type)
-            cols[other.qualified_key(a.name)] = np.full(n, fill, dtype=a.type.np_dtype)
+            cols[other.qualified_key(a.name)] = _null_column(a.type, n)
         return EventBatch(
             self.out_stream_id,
             self._out_names,

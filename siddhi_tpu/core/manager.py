@@ -102,6 +102,29 @@ class SiddhiManager:
     def set_persistence_store(self, store):
         self.siddhi_context.persistence_store = store
 
+    def set_source_handler_manager(self, m):
+        """HA interception for sources (reference:
+        SiddhiManager.setSourceHandlerManager:185)."""
+        self.siddhi_context.source_handler_manager = m
+
+    def set_sink_handler_manager(self, m):
+        """reference: SiddhiManager.setSinkHandlerManager:176"""
+        self.siddhi_context.sink_handler_manager = m
+
+    def set_record_table_handler_manager(self, m):
+        """reference: SiddhiManager.setRecordTableHandlerManager:194"""
+        self.siddhi_context.record_table_handler_manager = m
+
+    def set_data_source(self, name: str, data_source):
+        """Named shared data sources for store extensions
+        (reference: SiddhiManager.setDataSource:245)."""
+        self.siddhi_context.data_sources[name] = data_source
+
+    setSourceHandlerManager = set_source_handler_manager
+    setSinkHandlerManager = set_sink_handler_manager
+    setRecordTableHandlerManager = set_record_table_handler_manager
+    setDataSource = set_data_source
+
     def set_config_manager(self, config_manager):
         """Deployment config source for extensions and refs
         (reference: SiddhiManager.setConfigManager:203)."""

@@ -155,13 +155,15 @@ class ShardedPatternEngine:
             total = jax.lax.psum(local, axis_name=a)
             return new_state, emit, out_vals, total
 
+        # donate the state pytree: at 1M+ partitions the rows dominate
+        # HBM and double-buffering them would halve capacity
         self._step = jax.jit(jax.shard_map(
             sharded_step,
             mesh=mesh,
             in_specs=(specs, P(a), {k: P(a) for k in self.col_keys},
                       P(a), P(a)),
             out_specs=(specs, P(a), P(a, None), P()),
-        ))
+        ), donate_argnums=(0,))
         self._P = P
         self._NamedSharding = NamedSharding
         self._jax = jax

@@ -194,6 +194,9 @@ class AppPlanner:
                 src = factory()
                 src.config_reader = self.siddhi_context.config_manager.generate_config_reader(
                     "source", stype)
+                shm = self.siddhi_context.source_handler_manager
+                if shm is not None:
+                    src.handler = shm.generate(self.name, definition.id)
                 src.init(definition, opts, mapper, junction, self.app_context)
                 self.sources.append(src)
             elif nm == "sink":
@@ -223,6 +226,9 @@ class AppPlanner:
                     sink = factory()
                 sink.config_reader = self.siddhi_context.config_manager.generate_config_reader(
                     "sink", stype)
+                khm = self.siddhi_context.sink_handler_manager
+                if khm is not None:
+                    sink.handler = khm.generate(self.name, definition.id)
                 sink.init(definition, opts, mapper, self.app_context)
                 junction.subscribe(SinkStreamCallback(sink))
                 self.sinks.append(sink)
@@ -299,6 +305,10 @@ class AppPlanner:
         store = factory()
         reader = self.siddhi_context.config_manager.generate_config_reader("store", stype)
         store.init(td, options, reader)
+        handler = None
+        rthm = self.siddhi_context.record_table_handler_manager
+        if rthm is not None:
+            handler = rthm.generate(self.name, td.id)
         cache = None
         cache_ann = store_ann.nested("cache")
         if cache_ann is not None:
@@ -306,7 +316,7 @@ class AppPlanner:
             policy = (cache_ann.element("cache.policy")
                       or cache_ann.element("policy") or "FIFO")
             cache = TableCache(size, policy)
-        return RecordTableRuntime(td, store, cache=cache)
+        return RecordTableRuntime(td, store, cache=cache, handler=handler)
 
     def build(self):
         from siddhi_tpu.core.app_runtime import SiddhiAppRuntime

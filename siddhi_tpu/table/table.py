@@ -129,6 +129,15 @@ class InMemoryTable:
                 row = {nm: batch.columns[nm][i] for nm in self.definition.attribute_names}
                 self._insert_row(row, int(batch.timestamps[i]))
 
+    def _promote_to_object(self, nm: str):
+        """Switch a typed column to object dtype so it can hold nulls
+        (outer-join unmatched lanes insert None — the reference's boxed
+        rows hold nulls natively; scans on object columns stay correct,
+        just slower)."""
+        col = self._cols[nm]
+        if col.dtype != object:
+            self._cols[nm] = col.astype(object)
+
     def _insert_row(self, row: Dict, ts: int) -> int:
         if self.primary_keys:
             vals = tuple(_scalar(row[k]) for k in self.primary_keys)
@@ -141,7 +150,14 @@ class InMemoryTable:
         else:
             slot = self._alloc()
         for nm in self.definition.attribute_names:
-            self._cols[nm][slot] = row[nm]
+            v = row[nm]
+            if v is None and self._cols[nm].dtype != object:
+                self._promote_to_object(nm)
+            try:
+                self._cols[nm][slot] = v
+            except (TypeError, ValueError):
+                self._promote_to_object(nm)
+                self._cols[nm][slot] = v
         self._ts[slot] = ts
         self._live[slot] = True
         for attr, index in self.indexes.items():

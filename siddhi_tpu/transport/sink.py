@@ -98,6 +98,9 @@ class Sink(ConnectRetryMixin):
 
     def send_batch(self, batch: EventBatch):
         events = events_from_batch(batch)
+        hook = getattr(self, "handler", None)
+        if hook is not None:
+            events = hook.on_events(events)
         if not events:
             return
         for payload in self.mapper.map(events):
@@ -265,6 +268,9 @@ class DistributedSink(Sink):
 
     def send_batch(self, batch: EventBatch):
         events = events_from_batch(batch)
+        hook = getattr(self, "handler", None)
+        if hook is not None:
+            events = hook.on_events(events)
         if not events:
             return
         payloads = self.mapper.map(events)

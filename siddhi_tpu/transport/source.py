@@ -138,6 +138,11 @@ class Source(ConnectRetryMixin):
     def _send_events(self, events: List[Event]):
         from siddhi_tpu.core.stream import InputHandler
 
+        hook = getattr(self, "handler", None)
+        if hook is not None:
+            events = hook.on_events(events)
+            if not events:
+                return
         handler = getattr(self, "_handler", None)
         if handler is None:
             handler = self._handler = InputHandler(self.junction, self.app_context)

@@ -80,7 +80,7 @@ def test_left_outer_join(manager):
     rt.get_input_handler("A").send(["X", 1.0])  # no match -> null right
     rt.get_input_handler("B").send(["X", 10])  # matches buffered A
     rt.get_input_handler("B").send(["Y", 20])  # right arrival, no emit (left outer keeps left)
-    assert got == [["X", 1.0, 0], ["X", 1.0, 10]]
+    assert got == [["X", 1.0, None], ["X", 1.0, 10]]
 
 
 def test_unidirectional_join(manager):

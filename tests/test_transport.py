@@ -217,3 +217,92 @@ def test_source_connect_retry(manager):
         time.sleep(0.01)
     assert len(attempts) >= 2
     assert rt.sources[0].connected
+
+
+class TestHandlerManagers:
+    def test_source_and_sink_handlers(self, manager):
+        import time
+
+        from siddhi_tpu.transport.broker import InMemoryBroker, Subscriber
+        from siddhi_tpu.transport.handler import (
+            SinkHandler,
+            SinkHandlerManager,
+            SourceHandler,
+            SourceHandlerManager,
+        )
+
+        seen = {"in": [], "out": []}
+
+        class CountingSourceHandler(SourceHandler):
+            def on_events(self, events):
+                seen["in"].extend(e.data for e in events)
+                return events
+
+        class TaggingSinkHandler(SinkHandler):
+            def on_events(self, events):
+                seen["out"].extend(e.data for e in events)
+                return events
+
+        class SrcMgr(SourceHandlerManager):
+            def generate_source_handler(self):
+                return CountingSourceHandler()
+
+        class SnkMgr(SinkHandlerManager):
+            def generate_sink_handler(self):
+                return TaggingSinkHandler()
+
+        manager.set_source_handler_manager(SrcMgr())
+        manager.set_sink_handler_manager(SnkMgr())
+        rt = manager.create_siddhi_app_runtime(
+            "@source(type='inMemory', topic='h-in', @map(type='passThrough')) "
+            "define stream S (v long); "
+            "@sink(type='inMemory', topic='h-out', @map(type='passThrough')) "
+            "define stream Out (v long); "
+            "from S[v > 1] select v insert into Out;"
+        )
+        got = []
+
+        class Sub(Subscriber):
+            def on_message(self, m):
+                got.append(m)
+
+            def get_topic(self):
+                return "h-out"
+
+        sub = Sub()
+        InMemoryBroker.subscribe(sub)
+        rt.start()
+        InMemoryBroker.publish("h-in", [5])
+        InMemoryBroker.publish("h-in", [0])
+        time.sleep(0.15)
+        rt.shutdown()
+        InMemoryBroker.unsubscribe(sub)
+        assert seen["in"] == [[5], [0]]     # source handler saw everything
+        assert seen["out"] == [[5]]         # sink handler saw filtered output
+        assert [e.data for e in got] == [[5]]
+
+    def test_record_table_handler_manager(self, manager):
+        from siddhi_tpu.table.record import RecordTableHandler
+        from siddhi_tpu.transport.handler import RecordTableHandlerManager
+
+        adds = []
+
+        class SpyHandler(RecordTableHandler):
+            def on_add(self, records, call):
+                adds.extend(records)
+                return call(records)
+
+        class Mgr(RecordTableHandlerManager):
+            def generate_record_table_handler(self):
+                return SpyHandler()
+
+        manager.set_record_table_handler_manager(Mgr())
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); "
+            "@store(type='memory') define table T (v long); "
+            "from S select v insert into T;"
+        )
+        rt.start()
+        rt.get_input_handler("S").send([42])
+        rt.shutdown()
+        assert adds == [[42]]
