@@ -40,6 +40,7 @@ class SiddhiAppRuntime:
         sources: Optional[List] = None,
         sinks: Optional[List] = None,
         functions: Optional[Dict[str, object]] = None,
+        handler_registrations: Optional[List] = None,
     ):
         self.name = name
         self.siddhi_app = siddhi_app
@@ -55,6 +56,7 @@ class SiddhiAppRuntime:
         self.sources = sources or []
         self.sinks = sinks or []
         self.functions = functions or {}
+        self._handler_registrations = handler_registrations or []
         self._on_demand_cache: Dict[str, object] = {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
@@ -140,6 +142,9 @@ class SiddhiAppRuntime:
         for t in self.tables.values():
             if hasattr(t, "shutdown"):
                 t.shutdown()
+        for mgr, element_id in self._handler_registrations:
+            mgr.unregister(element_id)
+        self._handler_registrations = []
         self.running = False
         if self._manager is not None:
             # identity-guarded: an unregistered or replaced runtime must
@@ -261,9 +266,11 @@ class SiddhiAppRuntime:
         return svc
 
     def _persistence_store(self):
+        from siddhi_tpu.core.exceptions import NoPersistenceStoreError
+
         store = getattr(self.app_context.siddhi_context, "persistence_store", None)
         if store is None:
-            raise SiddhiAppRuntimeError(
+            raise NoPersistenceStoreError(
                 f"app '{self.name}': no persistence store configured "
                 "(SiddhiManager.set_persistence_store)"
             )

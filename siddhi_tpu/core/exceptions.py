@@ -37,3 +37,66 @@ class OnErrorAction:
     LOG = "log"
     STREAM = "stream"
     STORE = "store"
+
+
+class SiddhiParserException(SiddhiAppCreationError):
+    """Alias space for compiler errors surfaced through app creation."""
+
+
+class NoSuchAttributeError(SiddhiAppCreationError):
+    """Attribute not found on a definition
+    (reference: NoSuchAttributeException)."""
+
+
+class QueryNotExistError(SiddhiAppRuntimeError):
+    """Unknown query name (reference: QueryNotExistException)."""
+
+
+class OperationNotSupportedError(SiddhiAppRuntimeError):
+    """Operation not valid for the target element
+    (reference: OperationNotSupportedException)."""
+
+
+class OnDemandQueryRuntimeError(SiddhiAppRuntimeError):
+    """On-demand query failed during execution
+    (reference: OnDemandQueryRuntimeException)."""
+
+
+class NoPersistenceStoreError(SiddhiAppRuntimeError):
+    """persist() without a configured store
+    (reference: NoPersistenceStoreException)."""
+
+
+class PersistenceStoreError(SiddhiAppRuntimeError):
+    """Store-level save/load failure
+    (reference: PersistenceStoreException)."""
+
+
+class CannotClearSiddhiAppStateError(SiddhiAppRuntimeError):
+    """Revision cleanup failed
+    (reference: CannotClearSiddhiAppStateException)."""
+
+
+class DataPurgingError(SiddhiAppRuntimeError):
+    """Incremental-aggregation purge failure
+    (reference: DataPurgingException)."""
+
+
+class QueryableRecordTableError(SiddhiAppRuntimeError):
+    """Store-side query compilation/execution failure
+    (reference: QueryableRecordTableException)."""
+
+
+class CannotLoadConfigurationError(SiddhiAppCreationError):
+    """Config plane failure (reference: CannotLoadConfigurationException,
+    YAMLConfigManagerException)."""
+
+
+# Java-style aliases (the reference's exact names, for drop-in familiarity)
+SiddhiAppCreationException = SiddhiAppCreationError
+SiddhiAppRuntimeException = SiddhiAppRuntimeError
+OnDemandQueryCreationException = StoreQueryCreationError
+StoreQueryCreationException = StoreQueryCreationError
+CannotRestoreSiddhiAppStateException = CannotRestoreSiddhiAppStateError
+ConnectionUnavailableException = ConnectionUnavailableError
+DefinitionNotExistException = DefinitionNotExistError

@@ -208,7 +208,12 @@ class ShardedPatternEngine:
         ), pos
 
     def step(self, state, part, cols, ts, valid):
-        """One sharded step: ``(state', emit_mask, out_vals, global_matches)``."""
+        """One sharded step: ``(state', emit_mask, out_vals, global_matches)``.
+
+        The input ``state`` is DONATED (its device buffers are consumed
+        on real hardware — snapshot it before stepping if needed; always
+        rebind to the returned state).  CPU meshes ignore donation, so
+        only device runs surface misuse."""
         return self._step(state, part, cols, ts, valid)
 
     def process(self, state, part: np.ndarray, cols: Dict[str, np.ndarray],

@@ -37,6 +37,7 @@ class AppPlanner:
         self.siddhi_context = siddhi_context
         self.extensions = siddhi_context.extensions
 
+        self.handler_registrations = []  # (manager, element_id) to drop on shutdown
         name_ann = find_annotation(siddhi_app.annotations, "app:name")
         import uuid
 
@@ -197,6 +198,7 @@ class AppPlanner:
                 shm = self.siddhi_context.source_handler_manager
                 if shm is not None:
                     src.handler = shm.generate(self.name, definition.id)
+                    self.handler_registrations.append((shm, src.handler.element_id))
                 src.init(definition, opts, mapper, junction, self.app_context)
                 self.sources.append(src)
             elif nm == "sink":
@@ -229,6 +231,7 @@ class AppPlanner:
                 khm = self.siddhi_context.sink_handler_manager
                 if khm is not None:
                     sink.handler = khm.generate(self.name, definition.id)
+                    self.handler_registrations.append((khm, sink.handler.element_id))
                 sink.init(definition, opts, mapper, self.app_context)
                 junction.subscribe(SinkStreamCallback(sink))
                 self.sinks.append(sink)
@@ -309,6 +312,7 @@ class AppPlanner:
         rthm = self.siddhi_context.record_table_handler_manager
         if rthm is not None:
             handler = rthm.generate(self.name, td.id)
+            self.handler_registrations.append((rthm, handler.element_id))
         cache = None
         cache_ann = store_ann.nested("cache")
         if cache_ann is not None:
@@ -413,6 +417,7 @@ class AppPlanner:
             sources=self.sources,
             sinks=self.sinks,
             functions=self.functions,
+            handler_registrations=self.handler_registrations,
         )
 
 

@@ -122,6 +122,36 @@ class Scope:
         return hit
 
 
+_CMP = {
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+def _null_safe_compare(a, b, op: str):
+    """Comparison where null (None in object lanes — e.g. unmatched
+    outer-join fills) compares false instead of raising, matching the
+    reference's null-comparison semantics.  Engages ONLY for numpy
+    object-dtype operands — jax tracers (the dense NFA jit path) and
+    typed arrays take the plain vectorized comparison."""
+    if getattr(a, "dtype", None) != object and getattr(b, "dtype", None) != object:
+        return _CMP[op](a, b)
+    a_arr, b_arr = np.broadcast_arrays(
+        np.atleast_1d(np.asarray(a)), np.atleast_1d(np.asarray(b)))
+    none_mask = np.frompyfunc(lambda x, y: x is None or y is None, 2, 1)(
+        a_arr, b_arr).astype(bool)
+    out = np.zeros(a_arr.shape, dtype=bool)
+    ok = ~none_mask
+    if ok.any():
+        cmp = np.frompyfunc(_CMP[op], 2, 1)(a_arr[ok], b_arr[ok]).astype(bool)
+        out[ok] = cmp
+    return out
+
+
 def _java_int_div(a, b):
     q = a // b
     r = a - q * b
@@ -197,18 +227,10 @@ class ExpressionCompiler:
     def _c_CompareOp(self, e: CompareOp) -> CompiledExpression:
         l, r = self.compile(e.left), self.compile(e.right)
         op = e.op
-        if op == "<":
-            fn = lambda env: l.fn(env) < r.fn(env)
-        elif op == "<=":
-            fn = lambda env: l.fn(env) <= r.fn(env)
-        elif op == ">":
-            fn = lambda env: l.fn(env) > r.fn(env)
-        elif op == ">=":
-            fn = lambda env: l.fn(env) >= r.fn(env)
-        elif op == "==":
-            fn = lambda env: l.fn(env) == r.fn(env)
-        else:
-            fn = lambda env: l.fn(env) != r.fn(env)
+
+        def fn(env):
+            return _null_safe_compare(l.fn(env), r.fn(env), op)
+
         return CompiledExpression(fn, AttrType.BOOL)
 
     # ---- arithmetic -------------------------------------------------------

@@ -203,7 +203,14 @@ class InMemoryTable:
                             if not bucket:
                                 del self.indexes[attr][v_old]
                 for attr, vals in values.items():
-                    self._cols[attr][s] = vals[j]
+                    v = vals[j]
+                    if v is None and self._cols[attr].dtype != object:
+                        self._promote_to_object(attr)
+                    try:
+                        self._cols[attr][s] = v
+                    except (TypeError, ValueError):
+                        self._promote_to_object(attr)
+                        self._cols[attr][s] = v
                     if attr in self.indexes:
                         self.indexes[attr].setdefault(_scalar(self._cols[attr][s]), set()).add(s)
                 if touched_pk:

@@ -52,6 +52,7 @@ class _HandlerManager:
         self._seq += 1
         element_id = f"{base_id}#{self._seq}"
         self.handlers[element_id] = handler
+        handler.element_id = element_id  # lets the runtime unregister it
         return element_id
 
     def unregister(self, element_id: str):
@@ -94,5 +95,9 @@ class RecordTableHandlerManager(_HandlerManager):
 
     def generate(self, app_name: str, table_id: str):
         h = self.generate_record_table_handler()
+        # identity so one manager's handler can route by table
+        # (the reference passes elementId into RecordTableHandler)
+        h.app_name = app_name
+        h.table_id = table_id
         self._register(f"{app_name}:{table_id}", h)
         return h

@@ -96,11 +96,16 @@ class Sink(ConnectRetryMixin):
 
     # -- junction-facing ---------------------------------------------------
 
-    def send_batch(self, batch: EventBatch):
+    def _intercepted_events(self, batch: EventBatch):
+        """Batch -> events, passed through the optional SinkHandler."""
         events = events_from_batch(batch)
         hook = getattr(self, "handler", None)
         if hook is not None:
             events = hook.on_events(events)
+        return events
+
+    def send_batch(self, batch: EventBatch):
+        events = self._intercepted_events(batch)
         if not events:
             return
         for payload in self.mapper.map(events):
@@ -267,10 +272,7 @@ class DistributedSink(Sink):
             c.shutdown()
 
     def send_batch(self, batch: EventBatch):
-        events = events_from_batch(batch)
-        hook = getattr(self, "handler", None)
-        if hook is not None:
-            events = hook.on_events(events)
+        events = self._intercepted_events(batch)
         if not events:
             return
         payloads = self.mapper.map(events)

@@ -549,3 +549,67 @@ class TestScriptFunctions:
         fd = app.function_definitions["double"]
         assert fd.language == "python"
         assert "data[0] * 2" in fd.body
+
+
+class TestFluentBuilder:
+    def test_build_and_run(self):
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.query_api import AttrType
+        from siddhi_tpu.query_api import builder as b
+
+        app = (
+            b.siddhi_app("fluent")
+            .define_stream(
+                b.stream("S").attribute("sym", AttrType.STRING).attribute("v", AttrType.LONG)
+            )
+            .add_query(
+                b.query("q1")
+                .from_stream("S", where=b.compare(b.var("v"), ">", b.value(10)))
+                .select("sym", ("doubled", b.multiply(b.var("v"), b.value(2))))
+                .insert_into("Out")
+            )
+            .build()
+        )
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        assert rt.name == "fluent"
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("S").send(["A", 5])
+        rt.get_input_handler("S").send(["B", 50])
+        rt.shutdown()
+        m.shutdown()
+        assert [e.data[0] for e in got] == ["B"]
+
+    def test_window_group_by_having(self):
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.query_api import AttrType
+        from siddhi_tpu.query_api import builder as b
+
+        app = (
+            b.siddhi_app()
+            .define_stream(
+                b.stream("S").attribute("sym", AttrType.STRING).attribute("v", AttrType.LONG)
+            )
+            .add_query(
+                b.query()
+                .from_stream("S", window=("length", [b.value(10)]))
+                .select("sym", ("total", b.function("sum", b.var("v"))))
+                .group_by("sym")
+                .having(b.compare(b.var("total"), ">", b.value(15)))
+                .insert_into("Out")
+            )
+            .build()
+        )
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["A", 10])    # total 10, filtered by having
+        h.send(["A", 10])    # total 20 -> emitted
+        rt.shutdown()
+        m.shutdown()
+        assert [e.data for e in got] == [["A", 20]]
