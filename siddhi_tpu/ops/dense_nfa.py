@@ -23,7 +23,9 @@ jit-compiled step over **micro-batches of events across partitions**:
 Dense-mode semantics (documented subset of the host engine,
 ops/nfa.py — the planner falls back to the host engine otherwise):
  - linear chains (stream + count nodes; logical and/or as one node),
-   no absent states, <= 32 nodes;
+   no absent states, <= 32 nodes; patterns and strict-continuity
+   sequences (non-matching events kill pending sequence instances
+   pre-advance, start node stays armed);
  - at most one pending instance per (partition, node) — overlapping
    `every` instances collapse to the newest arming;
  - capture references limited to first (``ref.attr``/``ref[0]``) and
@@ -123,6 +125,7 @@ class DensePatternEngine:
         reset_on_emit: bool = True,
         mesh=None,
         partition_axis: str = "p",
+        is_sequence: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -134,6 +137,7 @@ class DensePatternEngine:
         self.n_partitions = n_partitions
         self.every_start = every_start
         self.reset_on_emit = reset_on_emit
+        self.is_sequence = is_sequence
         self.mesh = mesh
         self.partition_axis = partition_axis
         self.S = len(nodes)
@@ -280,6 +284,7 @@ class DensePatternEngine:
         within = self.within_ms
         every_start = self.every_start
         reset_on_emit = self.reset_on_emit
+        is_sequence = self.is_sequence
         R = max(self.alloc.n, 1)
         out_spec = self.out_spec
 
@@ -314,6 +319,58 @@ class DensePatternEngine:
                 counts = jnp.where(expired, 0, counts)
                 first = jnp.where(expired, 0, first)
 
+            # node filters evaluated once against entry-state registers
+            # (the reversed loop reads them before any same-step regs
+            # write could affect them); None = node not on this stream
+            ok_pre = []
+            for s in range(S):
+                node = nodes[s]
+                if node.kind == "logical":
+                    oks = []
+                    for si, sp in enumerate(node.specs):
+                        if sp.stream_key != stream_key:
+                            oks.append(None)
+                            continue
+                        f = node_filters[s][si]
+                        oks.append(
+                            jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool)
+                            if f is not None
+                            else jnp.ones(B, dtype=bool)
+                        )
+                    ok_pre.append(oks)
+                elif node.specs[0].stream_key != stream_key:
+                    ok_pre.append(None)
+                else:
+                    f = node_filters[s][0]
+                    ok_pre.append(
+                        jnp.asarray(f.fn(env_for(s, cols, ts, regs))).astype(bool)
+                        if f is not None
+                        else jnp.ones(B, dtype=bool)
+                    )
+
+            if is_sequence:
+                # strict continuity (reference: SEQUENCE keeps one pending
+                # per state, a non-matching event kills it; the start node
+                # stays armed — StreamPreStateProcessor.addState:217-223):
+                # any pending instance whose node cannot use this event
+                # dies before the advance pass
+                for s in range(1, S):
+                    ok_s = ok_pre[s]
+                    if isinstance(ok_s, list):
+                        m = jnp.zeros(B, dtype=bool)
+                        for o in ok_s:
+                            if o is not None:
+                                m = m | o
+                    elif ok_s is None:
+                        m = jnp.zeros(B, dtype=bool)
+                    else:
+                        m = ok_s
+                    had = ((a >> s) & 1).astype(bool)
+                    kill = had & ~m & valid
+                    a = jnp.where(kill, a & ~jnp.uint32(1 << s), a)
+                    counts = counts.at[:, s].set(jnp.where(kill, 0, counts[:, s]))
+                    first = first.at[:, s].set(jnp.where(kill, 0, first[:, s]))
+
             for s in reversed(range(S)):
                 node = nodes[s]
                 spec = node.specs[0]
@@ -325,12 +382,7 @@ class DensePatternEngine:
                     if s == 0 and every_start:
                         pending = jnp.ones_like(pending)
                     for si in sides:
-                        f = node_filters[s][si]
-                        ok = (
-                            jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool)
-                            if f is not None
-                            else jnp.ones_like(pending)
-                        )
+                        ok = ok_pre[s][si]
                         fire = pending & ok & valid
                         # record side in counts bitfield
                         counts = counts.at[:, s].set(
@@ -342,9 +394,16 @@ class DensePatternEngine:
                                 regs = regs.at[:, s, slot.index].set(
                                     jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
                                 )
-                        first = first.at[:, s].set(
-                            jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
-                        )
+                        if s == 0 and every_start:
+                            # fresh arming each event: the within anchor
+                            # must be this event's ts, not a stale one
+                            first = first.at[:, s].set(
+                                jnp.where(fire & (counts[:, s] == (1 << si)), ts, first[:, s])
+                            )
+                        else:
+                            first = first.at[:, s].set(
+                                jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
+                            )
                     need = (
                         (counts[:, s] & ((1 << len(node.specs)) - 1))
                         if node.logical_op == "and"
@@ -364,13 +423,7 @@ class DensePatternEngine:
                 pending = ((a >> s) & 1).astype(bool)
                 if s == 0 and every_start:
                     pending = jnp.ones_like(pending)
-                f = node_filters[s][0]
-                ok = (
-                    jnp.asarray(f.fn(env_for(s, cols, ts, regs))).astype(bool)
-                    if f is not None
-                    else jnp.ones(B, dtype=bool)
-                )
-                fire = pending & ok & valid
+                fire = pending & ok_pre[s] & valid
                 is_count = not (node.min_count == 1 and node.max_count == 1)
                 if is_count:
                     below_max = (node.max_count == ANY) | (counts[:, s] < node.max_count)
@@ -384,9 +437,14 @@ class DensePatternEngine:
                         regs = regs.at[:, s, slot.index].set(
                             jnp.where(upd, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
                         )
-                    first = first.at[:, s].set(
-                        jnp.where(first_cap & (first[:, s] == 0), ts, first[:, s])
-                    )
+                    if s == 0 and every_start:
+                        first = first.at[:, s].set(
+                            jnp.where(first_cap, ts, first[:, s])
+                        )
+                    else:
+                        first = first.at[:, s].set(
+                            jnp.where(first_cap & (first[:, s] == 0), ts, first[:, s])
+                        )
                     advance = cap & (counts[:, s] == max(node.min_count, 1))
                     a, first, counts, regs, emit, out_vals = _advance(
                         s, advance, a, first, counts, regs, emit, out_vals, cols, ts
@@ -399,10 +457,17 @@ class DensePatternEngine:
                         regs = regs.at[:, s, slot.index].set(
                             jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
                         )
-                    first = first.at[:, s].set(
-                        jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
-                    )
-                    if not (s == 0 and every_start):
+                    if s == 0 and every_start:
+                        first = first.at[:, s].set(jnp.where(fire, ts, first[:, s]))
+                    else:
+                        first = first.at[:, s].set(
+                            jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
+                        )
+                    # sequences keep the start node armed (host semantics:
+                    # "the start node is kept armed"); reset_on_emit still
+                    # stops non-every sequences after their first match
+                    keep_armed = s == 0 and (every_start or is_sequence)
+                    if not keep_armed:
                         a = jnp.where(fire, a & ~jnp.uint32(1 << s), a)
                     a, first, counts, regs, emit, out_vals = _advance(
                         s, fire, a, first, counts, regs, emit, out_vals, cols, ts
@@ -589,11 +654,7 @@ def compile_pattern(
     st = query.input_stream
     if not isinstance(st, StateInputStream):
         raise SiddhiAppCreationError("compile_pattern needs a pattern query")
-    if st.type == StateInputStream.SEQUENCE:
-        raise SiddhiAppCreationError(
-            "dense NFA does not implement strict sequence continuity yet; "
-            "use the host engine for ','-sequences"
-        )
+    is_sequence = st.type == StateInputStream.SEQUENCE
 
     def resolve(s):
         d = app.stream_definitions.get(s.stream_id)
@@ -627,4 +688,5 @@ def compile_pattern(
         select_names=select_names,
         every_start=every_start,
         mesh=mesh,
+        is_sequence=is_sequence,
     )

@@ -141,9 +141,12 @@ def _null_safe_compare(a, b, op: str):
     if getattr(a, "dtype", None) != object and getattr(b, "dtype", None) != object:
         return _CMP[op](a, b)
     a_arr, b_arr = np.broadcast_arrays(
-        np.atleast_1d(np.asarray(a)), np.atleast_1d(np.asarray(b)))
-    none_mask = np.frompyfunc(lambda x, y: x is None or y is None, 2, 1)(
-        a_arr, b_arr).astype(bool)
+        np.atleast_1d(np.asarray(a, dtype=object)), np.atleast_1d(np.asarray(b, dtype=object)))
+    # cheap None scan (elementwise __eq__ against None); string filters —
+    # the common object-lane compare — skip the masked path entirely
+    none_mask = (a_arr == None) | (b_arr == None)  # noqa: E711 — elementwise
+    if not none_mask.any():
+        return _CMP[op](a_arr, b_arr)
     out = np.zeros(a_arr.shape, dtype=bool)
     ok = ~none_mask
     if ok.any():

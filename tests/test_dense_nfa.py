@@ -158,3 +158,119 @@ class TestDenseFraud:
         ts = np.arange(1000, 1000 + len(sends), dtype=np.int64)
         state, emit, out = eng.process(state, "Txn", part, cols, ts)
         assert emit.sum() == 1
+
+
+SEQ_APP = (
+    "define stream Ticks (key long, price double); "
+    "@info(name='seq3') "
+    "from every e1=Ticks[price > 10.0], e2=Ticks[price > e1.price], "
+    "e3=Ticks[price > e2.price] within 1 sec "
+    "select e1.price as p1, e2.price as p2, e3.price as p3 "
+    "insert into Alerts;"
+)
+
+
+class TestDenseSequence:
+    """Strict-continuity sequences on the dense path (BASELINE config #1:
+    3-state `e1, e2, e3 within 1 sec`), validated against the host
+    engine."""
+
+    def _dense(self, sends, app=SEQ_APP, name="seq3"):
+        eng = compile_pattern(app, name, n_partitions=8)
+        state = eng.init_state()
+        part = np.asarray([s[0] for s in sends])
+        cols = {"price": np.asarray([s[1] for s in sends], dtype=np.float64),
+                "key": np.asarray([float(s[0]) for s in sends])}
+        ts = np.asarray([s[2] for s in sends], dtype=np.int64)
+        state, emit, out = eng.process(state, "Ticks", part, cols, ts)
+        return emit, out
+
+    def _host(self, sends, app=SEQ_APP):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("Ticks")
+        for key, price, ts in sends:
+            h.send([key, price], timestamp=ts)
+        rt.shutdown()
+        m.shutdown()
+        return got
+
+    def test_rising_triple_matches_host(self):
+        sends = [(0, 11.0, 100), (0, 12.0, 200), (0, 13.0, 300)]
+        emit, out = self._dense(sends)
+        host = self._host(sends)
+        assert emit.sum() == len(host) == 1
+        assert out[emit][0].tolist() == pytest.approx(host[0].data)
+
+    def test_interruption_kills_and_restarts(self):
+        # 11,12 then a drop (5) breaks continuity; 20,21,22 completes
+        sends = [(0, 11.0, 100), (0, 12.0, 200), (0, 5.0, 300),
+                 (0, 20.0, 400), (0, 21.0, 500), (0, 22.0, 600)]
+        emit, out = self._dense(sends)
+        host = self._host(sends)
+        assert emit.sum() == len(host) == 1
+        assert out[emit][0].tolist() == pytest.approx(host[0].data)  # 20,21,22
+
+    def test_within_expires_sequence(self):
+        sends = [(0, 11.0, 100), (0, 12.0, 200), (0, 13.0, 5000)]
+        emit, out = self._dense(sends)
+        host = self._host(sends)
+        assert emit.sum() == len(host) == 0
+
+    def test_per_partition_isolation(self):
+        sends = [(0, 11.0, 100), (1, 50.0, 150), (0, 12.0, 200),
+                 (1, 51.0, 250), (0, 13.0, 300), (1, 52.0, 350)]
+        emit, out = self._dense(sends)
+        # each key independently completes its own rising triple
+        assert emit.sum() == 2
+
+    def test_randomized_agreement_with_host(self):
+        rng = np.random.default_rng(11)
+        sends = [(0, float(p), 100 * (i + 1))
+                 for i, p in enumerate(rng.uniform(5.0, 30.0, 40).round(1))]
+        emit, out = self._dense(sends)
+        host = self._host(sends)
+        assert int(emit.sum()) == len(host)
+        dense_rows = [r.tolist() for r in out[emit]]
+        host_rows = [e.data for e in host]
+        for d, h in zip(dense_rows, host_rows):
+            assert d == pytest.approx(h)
+
+
+class TestDenseNonEverySequence:
+    def test_non_every_restarts_after_interruption(self):
+        # host semantics: the start node stays armed; 11 advances, 5 kills
+        # the pending instance, 20,21,22 then completes (and non-every
+        # stops after the first match)
+        app = (
+            "define stream Ticks (key long, price double); "
+            "@info(name='ne') "
+            "from e1=Ticks[price > 10.0], e2=Ticks[price > e1.price], "
+            "e3=Ticks[price > e2.price] within 1 sec "
+            "select e1.price as p1, e3.price as p3 insert into Alerts;"
+        )
+        sends = [(0, 11.0, 100), (0, 5.0, 200), (0, 20.0, 300),
+                 (0, 21.0, 400), (0, 22.0, 500), (0, 23.0, 600)]
+        eng = compile_pattern(app, "ne", n_partitions=4)
+        state = eng.init_state()
+        part = np.asarray([s[0] for s in sends])
+        cols = {"price": np.asarray([s[1] for s in sends]),
+                "key": np.zeros(len(sends))}
+        ts = np.asarray([s[2] for s in sends], dtype=np.int64)
+        state, emit, out = eng.process(state, "Ticks", part, cols, ts)
+
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        host = []
+        rt.add_callback("Alerts", lambda evs: host.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("Ticks")
+        for k, p, t in sends:
+            h.send([k, p], timestamp=t)
+        rt.shutdown()
+        m.shutdown()
+        assert int(emit.sum()) == len(host) == 1
+        assert out[emit][0].tolist() == pytest.approx(host[0].data)  # 20 .. 22
