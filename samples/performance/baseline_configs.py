@@ -1,0 +1,123 @@
+"""The five BASELINE.json benchmark configs on the dense engine.
+
+Prints one JSON line per config: events/sec (median window) on the
+available accelerator.  Configs (BASELINE.md):
+  1. 3-state sequence `e1, e2, e3 within 1 sec` (single stream)
+  2. credit-card fraud `every a -> b[amount>a.amount]<3:5> within 10 min`,
+     100K card partitions
+  3. brute-force login `fail<3:> -> success`, 1M user partitions
+  4. multi-stream `stockTick AND newsEvent within 5 sec` (logical NFA)
+  5. IoT anomaly, 32-state escalation pattern, 1M device partitions
+     (the 10M-partition variant needs the sharded multi-chip path)
+
+Run: python samples/performance/baseline_configs.py [seconds-per-config]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def _measure(eng, stream_key, n_partitions, batch, seconds, cols_of):
+    import jax
+
+    state = eng.init_state()
+    step = eng.make_step(stream_key, jit=True)
+    jnp = eng.jnp
+    rng = np.random.default_rng(3)
+    part = jnp.asarray(
+        ((np.arange(batch, dtype=np.int64) * 524287) % n_partitions).astype(np.int32))
+    cols = {k: jnp.asarray(v) for k, v in cols_of(rng, batch).items()}
+    ts = jnp.asarray(np.full(batch, 1_000, dtype=np.int32))
+    valid = jnp.ones(batch, dtype=bool)
+    state, emit, _ = step(state, part, cols, ts, valid)  # compile
+    jax.block_until_ready(emit)
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < seconds / 3:
+            state, emit, _ = step(state, part, cols, ts, valid)
+            n += batch
+        jax.block_until_ready(emit)
+        rates.append(n / (time.perf_counter() - t0))
+    return float(np.median(rates))
+
+
+def main(seconds: float = 3.0):
+    from siddhi_tpu.ops.dense_nfa import compile_pattern
+
+    B = 1 << 15
+
+    def report(name, rate, extra=""):
+        print(json.dumps({"config": name, "events_per_sec": round(rate, 1),
+                          "note": extra}))
+
+    # 1. 3-state sequence
+    eng = compile_pattern(
+        "define stream T (key long, p double); @info(name='q') "
+        "from every e1=T[p > 10.0], e2=T[p > e1.p], e3=T[p > e2.p] within 1 sec "
+        "select e1.p as p1, e3.p as p3 insert into O;",
+        "q", n_partitions=100_000)
+    rate = _measure(eng, "T", 100_000, B, seconds,
+                    lambda r, n: {"p": r.uniform(5, 30, n).astype(np.float32),
+                                  "key": np.zeros(n, dtype=np.float32)})
+    report("1_sequence_3state", rate)
+
+    # 2. credit-card fraud, 100K partitions
+    eng = compile_pattern(
+        "define stream Txn (card long, amount double); @info(name='q') "
+        "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount]<3:5> "
+        "within 10 min select a.amount as base, b[0].amount as b0 insert into O;",
+        "q", n_partitions=100_000)
+    rate = _measure(eng, "Txn", 100_000, B, seconds,
+                    lambda r, n: {"amount": r.uniform(50, 500, n).astype(np.float32),
+                                  "card": np.zeros(n, dtype=np.float32)})
+    report("2_fraud_count_100k", rate)
+
+    # 3. brute-force login, 1M partitions (Kleene count then success)
+    eng = compile_pattern(
+        "define stream Login (user long, ok bool); @info(name='q') "
+        "from every f=Login[ok == false]<3:100> -> s=Login[ok == true] "
+        "within 5 min select f[0].ok as f0 insert into O;",
+        "q", n_partitions=1_000_000)
+    rate = _measure(eng, "Login", 1_000_000, B, seconds,
+                    lambda r, n: {"ok": (r.uniform(0, 1, n) > 0.7).astype(np.float32),
+                                  "user": np.zeros(n, dtype=np.float32)})
+    report("3_bruteforce_kleene_1m", rate)
+
+    # 4. two-stream logical AND
+    eng = compile_pattern(
+        "define stream StockTick (sym long, p double); "
+        "define stream NewsEvent (sym long, sentiment double); @info(name='q') "
+        "from every (t=StockTick[p > 0.0] and n=NewsEvent[sentiment < 0.0]) "
+        "within 5 sec select t.p as p, n.sentiment as s insert into O;",
+        "q", n_partitions=100_000)
+    rate = _measure(eng, "StockTick", 100_000, B, seconds,
+                    lambda r, n: {"p": r.uniform(1, 10, n).astype(np.float32),
+                                  "sym": np.zeros(n, dtype=np.float32)})
+    report("4_two_stream_and", rate, "stockTick side; newsEvent side symmetrical")
+
+    # 5. 32-state escalation, 1M partitions
+    states = ["every e1=D[v > 0.0]"]
+    for i in range(2, 33):
+        states.append(f"e{i}=D[v > {float(i - 1)} and v > e1.v]")
+    eng = compile_pattern(
+        "define stream D (dev long, v double); @info(name='q') "
+        "from " + " -> ".join(states) + " within 10 min "
+        "select e1.v as v1, e32.v as v32 insert into O;",
+        "q", n_partitions=1_000_000)
+    rate = _measure(eng, "D", 1_000_000, B, seconds,
+                    lambda r, n: {"v": r.uniform(0, 40, n).astype(np.float32),
+                                  "dev": np.zeros(n, dtype=np.float32)})
+    report("5_iot_32state_1m", rate,
+           "10M-partition variant runs sharded via siddhi_tpu.parallel")
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 3.0)

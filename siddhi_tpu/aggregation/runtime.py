@@ -297,6 +297,7 @@ class AggregationRuntime:
         s = definition.input_stream
         in_def = app_planner.resolve_stream_definition(s)
         self.input_stream_id = s.stream_id
+        self._init_purge(definition)
         declared = [d for d in DURATIONS if d in definition.durations]
         if not declared:
             raise SiddhiAppCreationError(f"aggregation '{self.name}': no durations")
@@ -403,6 +404,94 @@ class AggregationRuntime:
         self.stores: Dict[str, _DurationStore] = {d: _DurationStore(d) for d in self.durations}
         self.watermark: int = -(1 << 62)
 
+    # -- purging (reference: aggregation/IncrementalDataPurger.java) --------
+
+    _DEFAULT_RETENTION = {
+        "seconds": 120 * 1000,              # 120 sec
+        "minutes": 24 * 3_600_000,          # 24 hours
+        "hours": 30 * 86_400_000,           # 30 days
+        "days": 365 * 86_400_000,           # 1 year
+        "weeks": -1,                        # retain all (reference purger
+        "months": -1,                       # has no WEEKS/MONTHS defaults)
+        "years": -1,
+    }
+    _MIN_RETENTION = {
+        "seconds": 120 * 1000,
+        "minutes": 120 * 60_000,
+        "hours": 25 * 3_600_000,
+        "days": 32 * 86_400_000,
+        "weeks": 5 * 7 * 86_400_000,
+        "months": 13 * 30 * 86_400_000,
+        "years": -1,
+    }
+    _KEY_TO_DURATION = {
+        "sec": "seconds", "seconds": "seconds",
+        "min": "minutes", "minutes": "minutes",
+        "hour": "hours", "hours": "hours",
+        "day": "days", "days": "days",
+        "week": "weeks", "weeks": "weeks",
+        "month": "months", "months": "months",
+        "year": "years", "years": "years",
+    }
+
+    def _init_purge(self, definition):
+        """@purge(enable, interval, @retentionPeriod(sec=..., min=..., ...))
+        (reference: AggregationParser purge handling +
+        IncrementalDataPurger.init:95-130 defaults/minimums)."""
+        from siddhi_tpu.compiler.parser import parse_time_string
+        from siddhi_tpu.query_api.annotation import find_annotation
+
+        self._purge_enabled = True
+        self._purge_interval_ms = 15 * 60_000
+        self._retention = dict(self._DEFAULT_RETENTION)
+        self._last_purge = 0
+        ann = find_annotation(definition.annotations, "purge")
+        if ann is None:
+            return
+        enable = ann.element("enable")
+        if enable is not None:
+            if enable.lower() not in ("true", "false"):
+                raise SiddhiAppCreationError(
+                    f"aggregation '{definition.id}': invalid @purge enable "
+                    f"'{enable}' (true|false)")
+            self._purge_enabled = enable.lower() == "true"
+        interval = ann.element("interval")
+        if interval is not None:
+            self._purge_interval_ms = parse_time_string(interval)
+        rp = ann.nested("retentionPeriod")
+        if rp is not None:
+            for key, value in rp.elements:
+                if key is None:
+                    continue
+                d = self._KEY_TO_DURATION.get(key.lower())
+                if d is None:
+                    raise SiddhiAppCreationError(
+                        f"aggregation '{definition.id}': unknown retention "
+                        f"duration '{key}'")
+                if value.strip().lower() == "all":
+                    self._retention[d] = -1
+                    continue
+                ms = parse_time_string(value)
+                minimum = self._MIN_RETENTION[d]
+                if minimum > 0 and ms < minimum:
+                    raise SiddhiAppCreationError(
+                        f"aggregation '{definition.id}': retention for {d} "
+                        f"must be >= {minimum} ms (got {ms})")
+                self._retention[d] = ms
+
+    def _purge(self, now: int):
+        if not self._purge_enabled or now - self._last_purge < self._purge_interval_ms:
+            return
+        self._last_purge = now
+        for d in self.durations:
+            keep_ms = self._retention.get(d, -1)
+            if keep_ms < 0:
+                continue
+            st = self.stores[d]
+            cutoff = now - keep_ms
+            for k in [k for k in st.finished if bucket_end(k[0], d) < cutoff]:
+                del st.finished[k]
+
     # -- ingest -------------------------------------------------------------
 
     def on_event(self, batch: EventBatch, now: int):
@@ -471,10 +560,18 @@ class AggregationRuntime:
                 store.merge_into(store.running, k, values, int(seg_ts.max()), self.field_ops)
         self.watermark = max(self.watermark, int(ts.max()))
         self._advance(now)
+        self._purge(now)
 
     def _merge_out_of_order(self, key: Tuple[int, Tuple], values: Dict, last_ts: int):
-        """Late event: fold into the finished bucket of every duration."""
+        """Late event: fold into the finished bucket of every duration.
+        Buckets already past a duration's retention cutoff are dropped,
+        not resurrected as partial data."""
         for d in self.durations:
+            keep_ms = self._retention.get(d, -1)
+            if (self._purge_enabled and keep_ms >= 0
+                    and bucket_end(int(bucket_starts(np.asarray([key[0]]), d)[0]), d)
+                    < self.watermark - keep_ms):
+                continue
             st = self.stores[d]
             dk = (int(bucket_starts(np.asarray([key[0]]), d)[0]), key[1])
             target = st.finished if dk in st.finished or d == self.durations[0] else st.running

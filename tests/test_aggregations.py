@@ -169,3 +169,61 @@ def test_aggregation_months_buckets(manager):
     rows = {int(b.columns["AGG_TIMESTAMP"][i]): float(b.columns["total"][i]) for i in range(len(b))}
     assert rows[jun1] == 3.0
     assert rows[jul1] == 10.0
+
+
+class TestAggregationPurge:
+    APP = (
+        "@app:playback "
+        "define stream S (sym string, v long); "
+        "@purge(enable='true', interval='1 sec', "
+        "@retentionPeriod(sec='120 sec', min='1 day')) "
+        "define aggregation Agg from S select sym, sum(v) as total "
+        "group by sym aggregate every sec...min;"
+    )
+
+    def test_purges_old_finished_buckets(self, manager):
+        rt = manager.create_siddhi_app_runtime(self.APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["A", 1], timestamp=1_000)
+        # jump 10 minutes: second-buckets older than 120s purge on the
+        # next batch; minute retention (1 day) keeps the rollup
+        h.send(["A", 2], timestamp=600_000)
+        agg = rt.aggregations["Agg"]
+        sec_finished = agg.stores["seconds"].finished
+        # the early second-bucket was purged; later state remains
+        assert all(k[0] >= 600_000 - 120_000 for k in sec_finished), sec_finished
+        assert len(agg.stores["minutes"].finished) >= 1
+        # the minute rollup still answers historical queries incl. the
+        # purged range's value
+        events = rt.query(
+            "from Agg within 0L, 999999999L per 'minutes' select sym, total")
+        assert any(e.data[0] == "A" and e.data[1] == 1 for e in events), [
+            e.data for e in events]
+        rt.shutdown()
+
+    def test_invalid_retention_below_minimum(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        import pytest as _pytest
+        with _pytest.raises(SiddhiAppCreationError, match="retention"):
+            manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@purge(enable='true', @retentionPeriod(sec='10 sec')) "
+                "define aggregation A from S select sum(v) as t "
+                "aggregate every sec...min;"
+            )
+
+    def test_purge_disabled_retains(self, manager):
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback define stream S (v long); "
+            "@purge(enable='false') "
+            "define aggregation A from S select sum(v) as t aggregate every sec...min;"
+        )
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([1], timestamp=1_000)
+        h.send([2], timestamp=100_000_000)
+        agg = rt.aggregations["A"]
+        assert len(agg.stores["seconds"].finished) >= 1
+        rt.shutdown()
