@@ -17,7 +17,7 @@ from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.event import EventBatch
 
 
-def main(seconds: float = 5.0):
+def main(seconds: float = 5.0, columnar: bool = False):
     manager = SiddhiManager()
     runtime = manager.create_siddhi_app_runtime(
         "define stream StockStream (symbol string, price float, volume long); "
@@ -25,7 +25,18 @@ def main(seconds: float = 5.0):
         "select symbol, price insert into OutputStream;"
     )
     n_out = [0]
-    runtime.add_callback("OutputStream", lambda evs: n_out.__setitem__(0, n_out[0] + len(evs)))
+    if columnar:
+        # columnar subscriber: skips per-event materialization entirely
+        from siddhi_tpu.core.stream import StreamCallback
+
+        class Counter(StreamCallback):
+            def receive_batch(self, batch):
+                n_out[0] += len(batch)
+
+        runtime.add_callback("OutputStream", Counter())
+    else:
+        runtime.add_callback(
+            "OutputStream", lambda evs: n_out.__setitem__(0, n_out[0] + len(evs)))
     runtime.start()
     h = runtime.get_input_handler("StockStream")
 
@@ -49,6 +60,7 @@ def main(seconds: float = 5.0):
         h.send_batch(batch)
         sent += B
     dt = time.perf_counter() - t0
+    print(f"callback mode    : {'columnar batch' if columnar else 'per-event'}")
     print(f"events sent      : {sent}")
     print(f"events matched   : {n_out[0]}")
     print(f"throughput       : {sent / dt:,.0f} events/sec")
@@ -58,4 +70,6 @@ def main(seconds: float = 5.0):
 
 
 if __name__ == "__main__":
-    main(float(sys.argv[1]) if len(sys.argv) > 1 else 5.0)
+    secs = float(sys.argv[1]) if len(sys.argv) > 1 else 5.0
+    main(secs)
+    main(secs, columnar=True)

@@ -234,15 +234,24 @@ def batch_from_events(
 
 
 def events_from_batch(batch: EventBatch) -> List[Event]:
-    """Convert back to row-major Events for user callbacks/sinks."""
-    out: List[Event] = []
+    """Convert back to row-major Events for user callbacks/sinks.
+
+    Columns unbox wholesale via ``ndarray.tolist()`` (one C call per
+    column) instead of per-cell ``.item()``."""
+    n = len(batch)
+    if n == 0:
+        return []
     names = batch.attribute_names
-    cols = [batch.columns[nm] for nm in names]
-    for i in range(len(batch)):
-        data = [_unbox(c[i]) for c in cols]
-        out.append(
-            Event(int(batch.timestamps[i]), data, is_expired=batch.types[i] == EXPIRED)
-        )
+    lists = [batch.columns[nm].tolist() for nm in names]
+    ts_list = batch.timestamps.tolist()
+    expired = (batch.types == EXPIRED).tolist()
+    out: List[Event] = []
+    for i in range(n):
+        e = Event.__new__(Event)
+        e.timestamp = ts_list[i]
+        e.data = [c[i] for c in lists]
+        e.is_expired = expired[i]
+        out.append(e)
     return out
 
 

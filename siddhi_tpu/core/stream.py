@@ -229,8 +229,10 @@ class InputHandler:
             self.junction.send(batch)
 
     def send_batch(self, batch: EventBatch):
-        for t in batch.timestamps:
-            self.app_context.timestamp_generator.set_event_time(int(t))
+        if len(batch):
+            # event time is monotone-max; one update per batch suffices
+            self.app_context.timestamp_generator.set_event_time(
+                int(batch.timestamps.max()))
         with self.app_context.process_lock:
             scheduler = self.app_context.scheduler
             if scheduler is not None:
