@@ -22,6 +22,7 @@ TPU-native equivalent implemented here:
 from siddhi_tpu.parallel.mesh import (
     ShardedPatternEngine,
     distributed_initialize,
+    ensure_virtual_devices,
     make_mesh,
     route_to_shards,
 )
@@ -29,6 +30,7 @@ from siddhi_tpu.parallel.mesh import (
 __all__ = [
     "ShardedPatternEngine",
     "distributed_initialize",
+    "ensure_virtual_devices",
     "make_mesh",
     "route_to_shards",
 ]

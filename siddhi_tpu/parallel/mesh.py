@@ -25,19 +25,61 @@ def distributed_initialize(coordinator_address: Optional[str] = None,
     )
 
 
+def ensure_virtual_devices(n_devices: int) -> bool:
+    """Best-effort bootstrap of >=n virtual CPU devices for mesh testing.
+
+    Must run before the CPU backend initializes (jax.config rejects the
+    update afterwards).  Returns True if >=n CPU devices are configured
+    or already available; False (with a warning) if the update was
+    rejected because backends initialized first — callers then see the
+    real device count and can raise a clear error."""
+    import warnings
+
+    import jax
+
+    try:
+        if int(jax.config.jax_num_cpu_devices or 0) < n_devices:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        return True
+    except Exception as e:
+        try:
+            if len(jax.devices("cpu")) >= n_devices:
+                return True
+        except RuntimeError:
+            pass
+        warnings.warn(
+            f"could not configure {n_devices} virtual CPU devices "
+            f"(backends already initialized?): {e}", RuntimeWarning)
+        return False
+
+
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "p",
               devices=None):
-    """1-D device mesh over the partition axis."""
+    """1-D device mesh over the partition axis.
+
+    Falls back to virtual CPU devices when the default platform is short
+    (e.g. a single real TPU chip): sharding semantics are identical, so
+    the multi-chip path stays testable everywhere.  The fallback must
+    configure the CPU device count BEFORE any backend initializes, so it
+    is attempted before the default jax.devices() lookup."""
     import jax
     from jax.sharding import Mesh
 
     if devices is None:
+        if n_devices is not None:
+            ensure_virtual_devices(n_devices)
         devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            try:
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                pass
     if n_devices is not None:
         if len(devices) < n_devices:
             raise SiddhiAppCreationError(
                 f"need {n_devices} devices, have {len(devices)} "
-                "(set XLA_FLAGS=--xla_force_host_platform_device_count for CPU testing)"
+                "(set JAX_NUM_CPU_DEVICES / "
+                "XLA_FLAGS=--xla_force_host_platform_device_count for CPU testing)"
             )
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), axis_names=(axis_name,))

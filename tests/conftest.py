@@ -2,11 +2,41 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual CPU mesh exactly as the driver's dryrun does.
+
+This environment pre-imports jax at interpreter startup (sitecustomize
+on PYTHONPATH) with JAX_PLATFORMS preset to a TPU plugin, so setting
+environment variables here is too late — they are read at jax import
+time.  Backends initialize lazily, however, so jax.config.update still
+takes effect; anything less than 8 devices is a loud failure (not a
+silent skip) — see _assert_virtual_mesh.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Belt-and-braces for subprocesses that re-exec with this environ.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import jax  # noqa: E402
+
+from siddhi_tpu.parallel import ensure_virtual_devices  # noqa: E402
+
+ensure_virtual_devices(8)
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass  # backends already initialized; the fixture below will complain
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_virtual_mesh():
+    """Fail (don't skip) if the 8-device virtual CPU mesh never
+    materialized — otherwise every sharding test silently skips and the
+    scale-out module merges unexercised."""
+    n = len(jax.devices())
+    platform = jax.devices()[0].platform
+    assert platform == "cpu" and n >= 8, (
+        f"virtual CPU mesh failed to materialize: {n} {platform} devices"
+    )
