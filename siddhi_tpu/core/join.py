@@ -24,12 +24,11 @@ from siddhi_tpu.query_api import AttrType, JoinInputStream
 
 
 def _null_column(t: AttrType, n: int) -> np.ndarray:
-    """Unmatched-side fill for outer joins: a column of nulls.  Float
-    lanes carry NaN (the in-batch null); every other type switches the
-    lane to object dtype holding None so callbacks observe real nulls
-    (reference: boxed nulls in joined StateEvents)."""
-    if t in (AttrType.FLOAT, AttrType.DOUBLE):
-        return np.full(n, np.nan, dtype=t.np_dtype)
+    """Unmatched-side fill for outer joins: a column of object-dtype
+    None for every attribute type — float included — so callbacks
+    observe uniform real nulls (reference: boxed nulls in joined
+    StateEvents).  NaN fills would make ``is None`` checks miss and
+    break equality filters (NaN != NaN)."""
     col = np.empty(n, dtype=object)
     col[:] = None
     return col

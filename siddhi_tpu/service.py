@@ -74,7 +74,12 @@ class SiddhiService:
             with self._lock:
                 runtime = self.manager.create_siddhi_app_runtime(
                     app_str, register=False)
-                if runtime.name in self._runtimes:
+                if (runtime.name in self._runtimes
+                        or self.manager.get_siddhi_app_runtime(runtime.name)
+                        is not None):
+                    # also reject apps registered directly on the shared
+                    # manager: silently replacing that registration would
+                    # leave the old runtime running untracked
                     runtime.shutdown()
                     return 409, {
                         "status": "ERROR",

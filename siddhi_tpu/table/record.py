@@ -286,6 +286,7 @@ class InMemoryRecordStore(AbstractRecordTable):
     reference's test ``testStoreContainingInMemoryTable``)."""
 
     _shared: Dict[str, List[list]] = {}
+    _shared_locks: Dict[str, threading.RLock] = {}
     _shared_lock = threading.Lock()
 
     def init(self, definition, options, config_reader=None):
@@ -294,12 +295,16 @@ class InMemoryRecordStore(AbstractRecordTable):
         if options.get("shared", "false").lower() == "true":
             # rows outlive the runtime, keyed by table name — mirrors the
             # reference test stores' static backing map, letting restart
-            # tests see a store that persisted across app instances
+            # tests see a store that persisted across app instances.
+            # The guarding lock must be shared too: per-instance locks
+            # over shared rows would let two runtimes race on mutation.
             with self._shared_lock:
                 self._rows = self._shared.setdefault(definition.id, [])
+                self._lock = self._shared_locks.setdefault(
+                    definition.id, threading.RLock())
         else:
             self._rows = []
-        self._lock = threading.RLock()
+            self._lock = threading.RLock()
 
     def _as_dict(self, row: list) -> Dict:
         return dict(zip(self._names, row))
@@ -343,6 +348,9 @@ class TableCache:
         policy = policy.upper()
         if policy not in ("FIFO", "LRU", "LFU"):
             raise SiddhiAppCreationError(f"unknown cache policy '{policy}'")
+        if max_size < 1:
+            raise SiddhiAppCreationError(
+                f"@cache size must be >= 1, got {max_size}")
         self.max_size = max_size
         self.policy = policy
         self._d: "OrderedDict" = OrderedDict()

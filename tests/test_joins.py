@@ -187,3 +187,23 @@ def test_join_expired_events_flow(manager):
     rt.get_input_handler("A").send(["X"])   # joins; evicts previous A -> expired join
     assert current == [["X"], ["X"]]
     assert expired == [["X"]]
+
+
+def test_left_outer_join_float_null_is_none(manager):
+    # ADVICE r1: unmatched-side float lanes used to surface NaN while
+    # other types surfaced None; nulls must be uniform across types.
+    app = (
+        "define stream A (sym string, qty long); "
+        "define stream B (sym string, price double, n long); "
+        "from A#window.length(5) as a "
+        "left outer join B#window.length(5) as b "
+        "on a.sym == b.sym "
+        "select a.sym as sym, b.price as price, b.n as n "
+        "insert into OutStream;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = collect_stream(rt, "OutStream")
+    rt.get_input_handler("A").send(["X", 1])
+    assert got == [["X", None, None]]
+    assert got[0][1] is None  # real None, not NaN

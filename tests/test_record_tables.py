@@ -173,3 +173,31 @@ class TestTableCache:
             assert [e.data for e in events] == [[75.5]]
         rt.shutdown()
         assert table.cache.hits >= 2  # first pk probe misses, rest hit
+
+    def test_zero_cache_size_rejected_at_creation(self, manager):
+        # ADVICE r1: max_size=0 used to crash with KeyError on the first
+        # put at runtime; must fail app creation with a typed error.
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        app = APP.replace("@store(type='memory')",
+                          "@store(type='memory', @cache(size='0'))")
+        with pytest.raises(SiddhiAppCreationError):
+            manager.create_siddhi_app_runtime(app)
+
+    def test_shared_store_uses_shared_lock(self):
+        # ADVICE r1: two store instances sharing rows must share the
+        # guarding lock, else concurrent mutation from two runtimes races.
+        from siddhi_tpu.query_api import AttrType
+        from siddhi_tpu.query_api.attribute import Attribute
+        from siddhi_tpu.query_api.definition import TableDefinition
+
+        d = TableDefinition("SharedLockT", [Attribute("v", AttrType.LONG)])
+        s1, s2 = InMemoryRecordStore(), InMemoryRecordStore()
+        s1.init(d, {"shared": "true"})
+        s2.init(d, {"shared": "true"})
+        try:
+            assert s1._rows is s2._rows
+            assert s1._lock is s2._lock
+        finally:
+            InMemoryRecordStore._shared.pop("SharedLockT", None)
+            InMemoryRecordStore._shared_locks.pop("SharedLockT", None)

@@ -97,3 +97,16 @@ def test_undeploy_missing_404(service):
     base = f"http://127.0.0.1:{service.port}"
     status, payload = get(f"{base}/siddhi-artifact-undeploy/nope")
     assert status == 404 and payload["status"] == "ERROR"
+
+
+def test_deploy_conflicts_with_manager_registered_app(service):
+    # ADVICE r1: deploying an app whose name matches a runtime created
+    # directly on the shared manager must 409, not silently replace the
+    # manager registration while the old runtime keeps running.
+    rt = service.manager.create_siddhi_app_runtime(APP)
+    try:
+        status, body = service.deploy(APP)
+        assert status == 409
+        assert service.manager.get_siddhi_app_runtime("restApp") is rt
+    finally:
+        rt.shutdown()
