@@ -92,8 +92,14 @@ class CannotLoadConfigurationError(SiddhiAppCreationError):
     YAMLConfigManagerException)."""
 
 
+class SiddhiAppValidationError(SiddhiAppCreationError):
+    """Plan-time validation failure — bad extension arguments, invalid
+    definitions (reference: SiddhiAppValidationException)."""
+
+
 # Java-style aliases (the reference's exact names, for drop-in familiarity)
 SiddhiAppCreationException = SiddhiAppCreationError
+SiddhiAppValidationException = SiddhiAppValidationError
 SiddhiAppRuntimeException = SiddhiAppRuntimeError
 OnDemandQueryCreationException = StoreQueryCreationError
 StoreQueryCreationException = StoreQueryCreationError

@@ -22,7 +22,13 @@ from siddhi_tpu.core import event as ev
 from siddhi_tpu.core.event import EventBatch
 from siddhi_tpu.core.exceptions import SiddhiAppCreationError
 from siddhi_tpu.extension.registry import extension
+from siddhi_tpu.extension.validator import REPEAT, Param
 from siddhi_tpu.planner.expr import CompiledExpression
+from siddhi_tpu.query_api.attribute import AttrType
+
+# common @Parameter type sets for the builtin window declarations
+_INTS = (AttrType.INT, AttrType.LONG)
+_FLOATS = (AttrType.FLOAT, AttrType.DOUBLE)
 
 
 class WindowProcessor:
@@ -104,6 +110,9 @@ class LengthWindow(WindowProcessor):
     oldest buffered event.
     """
 
+    PARAMETERS = (Param('window.length', _INTS),)
+    OVERLOADS = (('window.length',),)
+
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
         self.length = self._const_int(args[0], "length window size")
@@ -158,6 +167,9 @@ class LengthBatchWindow(WindowProcessor):
     aggregators restart per batch.
     """
 
+    PARAMETERS = (Param('window.length', _INTS),)
+    OVERLOADS = (('window.length',),)
+
     is_batch = True  # selector emits last-row-per-group (ProcessingMode.BATCH)
 
     def __init__(self, args, attribute_names):
@@ -204,6 +216,9 @@ class LengthBatchWindow(WindowProcessor):
 class TimeWindow(WindowProcessor):
     """Sliding time window (reference: TimeWindowProcessor): each event
     expires ``t`` ms after arrival; evictions fire on scheduler ticks."""
+
+    PARAMETERS = (Param('window.time', _INTS),)
+    OVERLOADS = (('window.time',),)
 
     needs_scheduler = True
 
@@ -256,6 +271,9 @@ class TimeBatchWindow(WindowProcessor):
     """Tumbling time window (reference: TimeBatchWindowProcessor): collects
     events per period, flushes CURRENT at each boundary and expires the
     previous flush."""
+
+    PARAMETERS = (Param('window.time', _INTS),)
+    OVERLOADS = (('window.time',),)
 
     needs_scheduler = True
     is_batch = True
@@ -327,6 +345,10 @@ class ExternalTimeWindow(WindowProcessor):
     ExternalTimeWindowProcessor) — expiry driven purely by arriving
     events' timestamps, no scheduler."""
 
+    PARAMETERS = (Param('timestamp', (AttrType.LONG,)),
+                  Param('window.time', _INTS))
+    OVERLOADS = (('timestamp', 'window.time'),)
+
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
         # args: (timestamp variable, duration)
@@ -379,6 +401,12 @@ class ExternalTimeWindow(WindowProcessor):
 class ExternalTimeBatchWindow(WindowProcessor):
     """Tumbling window over an event-time attribute (reference:
     ExternalTimeBatchWindowProcessor)."""
+
+    PARAMETERS = (Param('timestamp', (AttrType.LONG,)),
+                  Param('window.time', _INTS),
+                  Param('start.time', _INTS))
+    OVERLOADS = (('timestamp', 'window.time'),
+                 ('timestamp', 'window.time', 'start.time'))
 
     is_batch = True
 
@@ -444,6 +472,10 @@ class TimeLengthWindow(WindowProcessor):
     """Sliding window bounded by both time and count (reference:
     TimeLengthWindowProcessor)."""
 
+    PARAMETERS = (Param('window.time', _INTS),
+                  Param('window.length', _INTS))
+    OVERLOADS = (('window.time', 'window.length'),)
+
     needs_scheduler = True
 
     def __init__(self, args, attribute_names):
@@ -505,6 +537,9 @@ class DelayWindow(WindowProcessor):
     """Holds events for ``t`` ms, then releases them as CURRENT
     (reference: DelayWindowProcessor)."""
 
+    PARAMETERS = (Param('window.delay', _INTS),)
+    OVERLOADS = (('window.delay',),)
+
     needs_scheduler = True
 
     def __init__(self, args, attribute_names):
@@ -551,6 +586,11 @@ class SortWindow(WindowProcessor):
     """Keeps the N smallest/largest events by sort keys (reference:
     SortWindowProcessor): when over capacity, evicts the greatest (asc)
     or smallest (desc) as EXPIRED."""
+
+    PARAMETERS = (Param('window.length', _INTS),
+                  Param('attribute'))
+    OVERLOADS = (('window.length',),
+                 ('window.length', 'attribute', REPEAT))
 
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
@@ -623,6 +663,11 @@ class FrequentWindow(WindowProcessor):
     FrequentWindowProcessor): keeps events whose key is among the N
     highest-frequency keys; evicted keys' events expire."""
 
+    PARAMETERS = (Param('event.count', _INTS),
+                  Param('attribute'))
+    OVERLOADS = (('event.count',),
+                 ('event.count', 'attribute', REPEAT))
+
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
         self.n = self._const_int(args[0], "frequent count")
@@ -680,6 +725,13 @@ class FrequentWindow(WindowProcessor):
 class LossyFrequentWindow(WindowProcessor):
     """Lossy-counting frequent window (reference:
     LossyFrequentWindowProcessor(support, [error], keys...))."""
+
+    PARAMETERS = (Param('support.threshold', _FLOATS),
+                  Param('error.bound', _FLOATS),
+                  Param('attribute'))
+    OVERLOADS = (('support.threshold',),
+                 ('support.threshold', 'error.bound'),
+                 ('support.threshold', 'error.bound', 'attribute', REPEAT))
 
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
@@ -743,10 +795,112 @@ class LossyFrequentWindow(WindowProcessor):
         self._total = state["total"]
 
 
+@extension("window", "hopping")
+class HoppingWindow(WindowProcessor):
+    """Hopping window ``#window.hopping(windowTime, hopTime)``: every
+    ``hopTime`` emits the pane of events whose timestamps fall within the
+    trailing ``windowTime``; with overlap (hop < window) an event appears
+    in multiple panes, and ``hop == window`` degenerates to the tumbling
+    ``timeBatch``.  Each boundary expires the previous pane wholesale and
+    precedes the new pane with a RESET marker, mirroring
+    TimeBatchWindowProcessor's previous-flush expiry.
+
+    Reference: query/processor/stream/window/HopingWindowProcessor.java —
+    an abstract HOP-mode SPI base with no concrete subclass in-core; this
+    is the concrete realization (pane boundary = the reference's
+    ``_hopingTimestamp`` grouping key, carried here as the EXPIRED/RESET
+    timestamps)."""
+
+    PARAMETERS = (Param('window.time', _INTS),
+                  Param('hop.time', _INTS))
+    OVERLOADS = (('window.time', 'hop.time'),)
+
+    needs_scheduler = True
+    is_batch = True
+
+    def __init__(self, args, attribute_names):
+        super().__init__(args, attribute_names)
+        if len(args) != 2:
+            raise SiddhiAppCreationError(
+                "hopping window needs (windowTime, hopTime), "
+                f"got {len(args)} args")
+        self.window_ms = self._const_int(args[0], "hopping window duration")
+        self.hop_ms = self._const_int(args[1], "hopping window hop")
+        if self.window_ms <= 0 or self.hop_ms <= 0:
+            raise SiddhiAppCreationError(
+                "hopping window duration and hop must be positive")
+        self._buffer: Optional[EventBatch] = None
+        self._last_pane: Optional[EventBatch] = None
+        self._boundary: Optional[int] = None  # next pane-emission time
+
+    def process(self, batch: EventBatch, now: int) -> EventBatch:
+        cur = batch.only(ev.CURRENT)
+        if self._buffer is None:
+            self._buffer = _empty_like(cur)
+        if self._boundary is None and len(cur):
+            self._boundary = int(cur.timestamps[0]) + self.window_ms
+        out = self._maybe_flush(now)
+        if len(cur):
+            self._buffer = EventBatch.concat([self._buffer, cur])
+            if self._boundary is None:
+                # flush above went idle; this arrival starts a new window
+                self._boundary = int(cur.timestamps[0]) + self.window_ms
+        return out if out is not None else _empty_like(cur)
+
+    def _maybe_flush(self, now: int) -> Optional[EventBatch]:
+        if self._boundary is None or now < self._boundary:
+            return None
+        outs: List[EventBatch] = []
+        while self._boundary is not None and now >= self._boundary:
+            b = self._boundary
+            ts = self._buffer.timestamps
+            # pane covers [b - window, b): a boundary-timestamped event
+            # belongs to the NEXT pane, exactly like timeBatch's flush
+            pane = self._buffer.mask((ts >= b - self.window_ms) & (ts < b))
+            # evict rows that can never appear in a later pane
+            self._buffer = self._buffer.mask(
+                ts >= b + self.hop_ms - self.window_ms)
+            if self._last_pane is not None and len(self._last_pane):
+                exp = self._last_pane.with_types(ev.EXPIRED)
+                exp.timestamps = np.full(len(exp), b, dtype=np.int64)
+                outs.append(exp)
+            if len(pane) or (self._last_pane is not None and len(self._last_pane)):
+                outs.append(reset_marker(pane, b))
+            if len(pane):
+                outs.append(pane)
+            self._last_pane = pane
+            if len(self._buffer) == 0 and len(pane) == 0:
+                self._boundary = None  # go idle until next event
+            else:
+                self._boundary += self.hop_ms
+        return EventBatch.concat(outs) if outs else None
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        return self._maybe_flush(now)
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._boundary
+
+    def buffered(self) -> Optional[EventBatch]:
+        return self._buffer
+
+    def snapshot(self):
+        return {"buffer": self._buffer, "last": self._last_pane,
+                "boundary": self._boundary}
+
+    def restore(self, state):
+        self._buffer, self._last_pane, self._boundary = (
+            state["buffer"], state["last"], state["boundary"]
+        )
+
+
 @extension("window", "batch")
 class BatchWindow(WindowProcessor):
     """Chunk-per-arrival window (reference: BatchWindowProcessor): each
     arriving chunk expires the previous chunk."""
+
+    PARAMETERS = ()
+    OVERLOADS = ((),)
 
     is_batch = True
 
@@ -784,6 +938,11 @@ class SessionWindow(WindowProcessor):
     SessionWindowProcessor(gap, [key])): events buffer per session key;
     a session closes when no event arrives for ``gap`` ms, expiring its
     events."""
+
+    PARAMETERS = (Param('window.session', _INTS),
+                  Param('window.key'))
+    OVERLOADS = (('window.session',),
+                 ('window.session', 'window.key'))
 
     needs_scheduler = True
     is_batch = True
@@ -852,6 +1011,9 @@ class CronWindow(WindowProcessor):
     until the cron expression fires; at each fire the previous batch is
     expired (timestamped at fire time) and the held batch is emitted as
     CURRENT, becoming the next expired set."""
+
+    PARAMETERS = (Param('cron.expression', (AttrType.STRING,)),)
+    OVERLOADS = (('cron.expression',),)
 
     needs_scheduler = True
     is_batch = True
@@ -1053,6 +1215,9 @@ class ExpressionWindow(WindowProcessor):
     prior decision): O(buffer) per arrival; eviction scans use offset
     views, not copies."""
 
+    PARAMETERS = (Param('expression', (AttrType.STRING,)),)
+    OVERLOADS = (('expression',),)
+
     def __init__(self, args, attribute_names):
         super().__init__(args, attribute_names)
         expr = args[0].fn({})
@@ -1099,6 +1264,13 @@ class ExpressionBatchWindow(WindowProcessor):
     CURRENT batch.  ``include.triggering.event`` puts the triggering
     event into the flushed batch; ``stream.current.event`` streams
     arrivals through immediately and only expires in batches."""
+
+    PARAMETERS = (Param('expression', (AttrType.STRING,)),
+                  Param('include.triggering.event', (AttrType.BOOL,)),
+                  Param('stream.current.event', (AttrType.BOOL,)))
+    OVERLOADS = (('expression',),
+                 ('expression', 'include.triggering.event'),
+                 ('expression', 'include.triggering.event', 'stream.current.event'))
 
     is_batch = True
 

@@ -17,6 +17,7 @@ from siddhi_tpu.core.exceptions import (
     SiddhiAppCreationError,
 )
 from siddhi_tpu.core.stream import InputManager, StreamJunction
+from siddhi_tpu.extension.validator import validate_extension_args
 from siddhi_tpu.query_api import (
     Attribute,
     AttrType,
@@ -356,6 +357,9 @@ class AppPlanner:
                 wscope.add(wd.id, a.name, a.name, a.type)
             wcompiler = ExpressionCompiler(wscope, functions=self.functions)
             args = [wcompiler.compile(a) for a in fn.args]
+            validate_extension_args(
+                factory, fn.name, [a.type for a in args],
+                where=f"named window '{wd.id}'")
             w = factory(args, wd.attribute_names)
             junction = self.define_stream(
                 StreamDefinition(id=wd.id, attributes=list(wd.attributes)),

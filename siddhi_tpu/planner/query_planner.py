@@ -29,6 +29,7 @@ from siddhi_tpu.core.query import (
     TimeRateLimiter,
     WindowChainProcessor,
 )
+from siddhi_tpu.extension.validator import validate_extension_args
 from siddhi_tpu.ops.aggregators import make_aggregator
 from siddhi_tpu.planner.expr import (
     AGGREGATOR_NAMES,
@@ -440,6 +441,9 @@ class QueryPlanner:
                 if factory is None:
                     raise SiddhiAppCreationError(f"unknown window '#{'window.'}{h.name}()'")
                 args = [compiler.compile(a) for a in h.args]
+                validate_extension_args(
+                    factory, h.name, [a.type for a in args],
+                    where=f"window '#window.{h.name}' on stream '{s.stream_id}'")
                 w = factory(args, definition.attribute_names)
                 windows.append(w)
                 batch_mode = batch_mode or getattr(w, "is_batch", False)
@@ -451,6 +455,9 @@ class QueryPlanner:
                 if factory is None:
                     raise SiddhiAppCreationError(f"unknown stream function '#{h.name}()'")
                 args = [compiler.compile(a) for a in h.args]
+                validate_extension_args(
+                    factory, h.name, [a.type for a in args],
+                    where=f"stream function '#{h.name}' on stream '{s.stream_id}'")
                 from siddhi_tpu.core.query import StreamFunctionChainProcessor
 
                 chain.append(StreamFunctionChainProcessor(factory(args, definition.attribute_names)))

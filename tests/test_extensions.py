@@ -132,3 +132,58 @@ class TestCustomWindowExtension:
                   "insert into O;",
                   [[1], [2], [3]])
         assert [e.data[0] for e in got] == [1, 2, 3]
+
+
+class TestParameterValidation:
+    """Plan-time extension argument validation (reference:
+    util/extension/validator/InputParameterValidator.java)."""
+
+    def test_bad_arity_fails_at_creation(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppValidationError
+
+        with pytest.raises(SiddhiAppValidationError):
+            manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "from S#window.length(2, 3) select v insert into OutputStream;"
+            )
+
+    def test_bad_type_fails_at_creation(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppValidationError
+
+        with pytest.raises(SiddhiAppValidationError):
+            manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "from S#window.length('two') select v insert into OutputStream;"
+            )
+
+    def test_named_window_validated(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppValidationError
+
+        with pytest.raises(SiddhiAppValidationError):
+            manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "define window W (v long) time(1 sec, 2 sec) output all events; "
+                "from S insert into W;"
+            )
+
+    def test_repetitive_overload_accepts_tail(self, manager):
+        # sort(length, attr, 'asc') exercises the REPEAT marker
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); "
+            "from S#window.sort(2, v, 'asc') select v insert into OutputStream;"
+        )
+        rt.shutdown()
+
+    def test_custom_extension_without_declaration_unchecked(self, manager):
+        from siddhi_tpu.ops.windows import WindowProcessor
+
+        class AnyArgsWindow(WindowProcessor):
+            def process(self, batch, now):
+                return batch
+
+        manager.set_extension("anyArgs", AnyArgsWindow, kind="window")
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); "
+            "from S#window.anyArgs(1, 'x', v) select v insert into OutputStream;"
+        )
+        rt.shutdown()
