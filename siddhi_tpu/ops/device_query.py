@@ -1,0 +1,920 @@
+"""Jitted device path for the general query pipeline.
+
+The reference's bread-and-butter hot loop — ProcessStreamReceiver.receive
+(query/input/ProcessStreamReceiver.java:99-179) pushing pooled events
+through FilterProcessor (query/processor/filter/FilterProcessor.java:32),
+a window processor (query/processor/stream/window/*) and
+QuerySelector.process (query/selector/QuerySelector.java:76-99) with
+per-group AttributeAggregatorExecutors — re-designed as ONE jit-compiled
+step over columnar micro-batches:
+
+- **filter**: the jax backend of the compiled expression tree produces a
+  boolean mask over the batch (no per-event virtual calls);
+- **windows**: fixed-capacity ring buffers in device memory.  Sliding
+  aggregates (length/time) are computed with a static ``[B, W]`` window
+  gather + membership mask + reduction — every output row in the batch
+  is computed in parallel, no scan.  Passing rows are compacted with a
+  prefix-sum scatter so filtered-out rows never occupy window slots;
+- **group-by**: group keys are interned host-side to dense slot ids
+  (exactly like the dense NFA's partition interning); per-group
+  aggregator state lives as ``[G]`` device arrays updated with
+  scatter-add/min/max, and within-batch running prefixes use a masked
+  ``[B, B]`` same-group matmul that XLA maps onto the MXU;
+- **tumbling windows** (lengthBatch/timeBatch): per-group accumulators
+  plus a flush kernel emitting one row per touched group; the host
+  wrapper splits incoming batches at pane boundaries so each step call
+  stays a static-shape program.
+
+Device-mode semantics (documented subset of the host engine — the
+planner falls back to the host path otherwise, mirroring the dense NFA
+contract):
+ - single input stream; filters precede at most one window;
+ - windows: none (running aggregates), length, time (sliding, per-event
+   emission), lengthBatch, timeBatch (tumbling, per-flush emission);
+ - aggregators: sum / count / avg / min / max;
+ - filter / select / having expressions must be jax-traceable (numeric
+   attrs, arithmetic/comparison/boolean ops) — checked at compile time
+   by actually tracing them;
+ - tumbling select items may reference only group keys and aggregates
+   (the host engine's last-row-per-group attrs need per-attr registers);
+ - time windows hold at most ``window_capacity`` passing events (the
+   reference buffer is unbounded; overflow drops the oldest).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
+from siddhi_tpu.planner.expr import (
+    AGGREGATOR_NAMES,
+    CompiledExpression,
+    ExpressionCompiler,
+    N_KEY,
+    Scope,
+    TS_KEY,
+)
+from siddhi_tpu.query_api import (
+    AndOp,
+    ArithmeticOp,
+    AttrType,
+    CompareOp,
+    Expression,
+    Filter,
+    FunctionCall,
+    InOp,
+    IsNull,
+    NotOp,
+    OrOp,
+    Query,
+    SingleInputStream,
+    Variable,
+    WindowHandler,
+)
+
+SUPPORTED_AGGS = ("sum", "count", "avg", "min", "max")
+SUPPORTED_WINDOWS = (None, "length", "time", "lengthBatch", "timeBatch")
+
+PER_EVENT = "per_event"
+PER_FLUSH = "per_flush"
+
+
+@dataclass
+class DeviceAgg:
+    kind: str  # sum | count | avg | min | max
+    arg: Optional[CompiledExpression]  # None for count
+    env_key: str
+
+
+class _DeviceAggRewrite:
+    """Replaces aggregator calls in select/having expressions with
+    synthetic variables bound to device aggregation outputs (the device
+    analog of the planner's AggregatorRewrite)."""
+
+    def __init__(self, scope: Scope, compiler: ExpressionCompiler):
+        self.scope = scope
+        self.compiler = compiler
+        self.aggs: List[DeviceAgg] = []
+
+    def rewrite(self, expr: Expression) -> Expression:
+        if (
+            isinstance(expr, FunctionCall)
+            and expr.namespace is None
+            and expr.name in AGGREGATOR_NAMES
+        ):
+            if expr.name not in SUPPORTED_AGGS:
+                raise SiddhiAppCreationError(
+                    f"device query path does not support aggregator '{expr.name}'"
+                )
+            key = f"__dagg_{len(self.aggs)}"
+            arg = None
+            if expr.args:
+                if len(expr.args) > 1:
+                    raise SiddhiAppCreationError(
+                        f"aggregator '{expr.name}' takes one argument")
+                arg = self.compiler.compile(self.rewrite(expr.args[0]))
+            elif expr.name != "count":
+                raise SiddhiAppCreationError(
+                    f"aggregator '{expr.name}' needs an argument")
+            out_t = AttrType.LONG if expr.name == "count" else AttrType.DOUBLE
+            self.aggs.append(DeviceAgg(expr.name, arg, key))
+            self.scope.add_bare(key, out_t)
+            return Variable(attribute=key)
+        if isinstance(expr, ArithmeticOp):
+            return ArithmeticOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, CompareOp):
+            return CompareOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, AndOp):
+            return AndOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, OrOp):
+            return OrOp(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, NotOp):
+            return NotOp(self.rewrite(expr.expr))
+        if isinstance(expr, IsNull):
+            return IsNull(self.rewrite(expr.expr))
+        if isinstance(expr, FunctionCall):
+            return FunctionCall(
+                expr.namespace, expr.name,
+                tuple(self.rewrite(a) for a in expr.args), expr.star,
+            )
+        if isinstance(expr, InOp):
+            raise SiddhiAppCreationError(
+                "device query path does not support table membership (IN)")
+        return expr
+
+
+def _pow2(n: int, floor: int = 16) -> int:
+    return max(1 << (max(n, 1) - 1).bit_length(), floor)
+
+
+class DeviceQueryEngine:
+    """One single-input query compiled into jitted device steps.
+
+    Usage::
+
+        eng = compile_query(app_str, "q1", n_groups=1024)
+        state = eng.init_state()
+        state, rows = eng.process(state, cols, ts)   # rows: emitted dicts
+    """
+
+    def __init__(
+        self,
+        query: Query,
+        stream_def,
+        n_groups: int = 1024,
+        window_capacity: int = 1024,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.jax, self.jnp = jax, jnp
+        self.query = query
+        self.stream_def = stream_def
+        self.n_groups = n_groups
+
+        s = query.input_stream
+        if not isinstance(s, SingleInputStream):
+            raise SiddhiAppCreationError(
+                "device query path needs a single input stream")
+        self.stream_id = s.stream_id
+
+        # -- handler chain: filters then at most one window ------------------
+        self.filter_exprs: List[Expression] = []
+        self.window_name: Optional[str] = None
+        self.window_args: List = []
+        seen_window = False
+        for h in s.handlers:
+            if isinstance(h, Filter):
+                if seen_window:
+                    raise SiddhiAppCreationError(
+                        "device query path: filters must precede the window")
+                self.filter_exprs.append(h.expression)
+            elif isinstance(h, WindowHandler):
+                if seen_window:
+                    raise SiddhiAppCreationError(
+                        "device query path supports at most one window")
+                seen_window = True
+                self.window_name = h.name
+                self.window_args = list(h.args)
+            else:
+                raise SiddhiAppCreationError(
+                    f"device query path: unsupported handler {type(h).__name__}")
+        if self.window_name not in SUPPORTED_WINDOWS:
+            raise SiddhiAppCreationError(
+                f"device query path does not support window "
+                f"'{self.window_name}'")
+        self.mode = (
+            PER_FLUSH if self.window_name in ("lengthBatch", "timeBatch")
+            else PER_EVENT
+        )
+
+        # -- scope / expression compilation ----------------------------------
+        self.attrs = [
+            a.name for a in stream_def.attributes if a.type.is_numeric
+        ]
+        self.all_attrs = list(stream_def.attribute_names)
+        scope = Scope()
+        for a in stream_def.attributes:
+            scope.add(s.alias or s.stream_id, a.name, a.name, a.type)
+            if s.alias:
+                scope.add(s.stream_id, a.name, a.name, a.type)
+        compiler = ExpressionCompiler(scope)
+
+        self.filters = [compiler.compile(e) for e in self.filter_exprs]
+
+        # window parameter (constant)
+        self.window_param: Optional[int] = None
+        if self.window_name is not None:
+            if not self.window_args:
+                raise SiddhiAppCreationError(
+                    f"window '{self.window_name}' needs an argument")
+            c = compiler.compile(self.window_args[0])
+            try:
+                self.window_param = int(c.fn({}))
+            except Exception as e:
+                raise SiddhiAppCreationError(
+                    f"window '{self.window_name}' argument must be constant"
+                ) from e
+
+        # group-by keys (exprs; interned host-side)
+        sel = query.selector
+        self.group_exprs: List[CompiledExpression] = [
+            compiler.compile(g) for g in (sel.group_by or [])
+        ]
+        self.group_raw: List[Expression] = list(sel.group_by or [])
+        # numeric group keys usable inside flush exprs
+        self._numeric_group_keys = [
+            i for i, g in enumerate(self.group_exprs)
+            if g.type.is_numeric
+        ]
+
+        # select items: rewrite aggregators, classify outputs
+        rewriter = _DeviceAggRewrite(scope, compiler)
+        if sel.selection is None:
+            raise SiddhiAppCreationError(
+                "device query path needs an explicit select list")
+        # out_spec entries: ("expr", compiled) | ("group_key", key_index)
+        self.out_spec: List[Tuple[str, object, str]] = []
+        for oa in sel.selection:
+            gk = self._as_group_key(oa.expression)
+            if gk is not None:
+                self.out_spec.append(("group_key", gk, oa.name))
+                continue
+            compiled = compiler.compile(rewriter.rewrite(oa.expression))
+            self.out_spec.append(("expr", compiled, oa.name))
+        self.aggs = rewriter.aggs
+        self.having = (
+            compiler.compile(rewriter.rewrite(sel.having))
+            if sel.having is not None else None
+        )
+        if sel.order_by or sel.limit is not None or sel.offset is not None:
+            raise SiddhiAppCreationError(
+                "device query path does not support order by/limit yet")
+        if self.mode == PER_FLUSH:
+            for kind, _v, name in self.out_spec:
+                if kind == "expr" and not self._flush_expr_ok(_v):
+                    raise SiddhiAppCreationError(
+                        f"tumbling device query: select item '{name}' may "
+                        "reference only group keys and aggregates")
+        if self.mode == PER_EVENT and self.window_name is None and not self.aggs:
+            self.kind = "filter"  # stateless filter/projection query
+        elif self.mode == PER_EVENT and self.window_name is None:
+            self.kind = "running"
+        elif self.mode == PER_EVENT:
+            self.kind = "sliding"
+        else:
+            self.kind = "tumbling"
+
+        # window geometry
+        if self.kind == "sliding":
+            self.W = (
+                int(self.window_param) if self.window_name == "length"
+                else int(window_capacity)
+            )
+            if self.W < 1:
+                raise SiddhiAppCreationError("window size must be >= 1")
+        else:
+            self.W = 0
+
+        self._trace_check()
+        self._step_cache: Dict[str, Callable] = {}
+
+        # host-side interning / pane bookkeeping
+        self._group_ids: Dict = {}
+        self._group_vals: List = []
+        self.base_ts: Optional[int] = None
+        self._pane_end: Optional[int] = None  # timeBatch
+        self._pane_fill = 0  # passing events in the open pane
+        self._prev_pane_fill = 0  # previous pane's fill (idle detection)
+
+    # -- compilation helpers -------------------------------------------------
+
+    def _as_group_key(self, expr: Expression) -> Optional[int]:
+        """Select item that IS a group-by key -> its key index."""
+        if not isinstance(expr, Variable):
+            return None
+        for i, g in enumerate(self.group_raw):
+            if isinstance(g, Variable) and g.attribute == expr.attribute:
+                return i
+        return None
+
+    def _flush_expr_ok(self, compiled) -> bool:
+        """Flush-time exprs can only read aggregate keys / numeric group
+        keys (probed by tracing with exactly that env)."""
+        try:
+            self._trace_one(compiled, self._flush_env_shapes())
+            return True
+        except Exception:
+            return False
+
+    def _env_shapes(self, B: int = 8):
+        import jax
+
+        f32 = jax.ShapeDtypeStruct((B,), np.float32)
+        env = {a: f32 for a in self.attrs}
+        env[TS_KEY] = jax.ShapeDtypeStruct((B,), np.int32)
+        env[N_KEY] = B
+        for a in self.aggs:
+            env[a.env_key] = f32
+        return env
+
+    def _flush_env_shapes(self, G: int = 8):
+        import jax
+
+        f32 = jax.ShapeDtypeStruct((G,), np.float32)
+        env = {a.env_key: f32 for a in self.aggs}
+        for i in self._numeric_group_keys:
+            g = self.group_raw[i]
+            if isinstance(g, Variable):
+                env[g.attribute] = f32
+        env[N_KEY] = G
+        return env
+
+    def _trace_one(self, compiled, shapes):
+        import jax
+
+        jax.eval_shape(lambda env: compiled.fn(env), shapes)
+
+    def _trace_check(self):
+        """Compile-time eligibility: every expression must be
+        jax-traceable (no object-dtype ops, no host-only functions)."""
+        shapes = self._env_shapes()
+        try:
+            for f in self.filters:
+                self._trace_one(f, shapes)
+            for a in self.aggs:
+                if a.arg is not None:
+                    self._trace_one(a.arg, shapes)
+            for g in self.group_exprs:
+                # group keys are evaluated host-side (interning), so any
+                # type is fine — no trace needed
+                pass
+            if self.mode == PER_EVENT:
+                for kind, v, _n in self.out_spec:
+                    if kind == "expr":
+                        self._trace_one(v, shapes)
+                if self.having is not None:
+                    self._trace_one(self.having, shapes)
+            else:
+                fshapes = self._flush_env_shapes()
+                for kind, v, _n in self.out_spec:
+                    if kind == "expr":
+                        self._trace_one(v, fshapes)
+                if self.having is not None:
+                    self._trace_one(self.having, fshapes)
+        except SiddhiAppCreationError:
+            raise
+        except Exception as e:
+            raise SiddhiAppCreationError(
+                f"query not device-eligible (expression not jax-traceable): {e}"
+            ) from e
+
+    # -- state ---------------------------------------------------------------
+
+    def init_state(self):
+        jnp = self.jnp
+        A = max(len(self.aggs), 1)
+        G = self.n_groups
+        state = {}
+        if self.kind == "sliding":
+            W = self.W
+            state["win_vals"] = jnp.zeros((W, A), dtype=jnp.float32)
+            state["win_ts"] = jnp.zeros(W, dtype=jnp.int32)
+            state["win_grp"] = jnp.zeros(W, dtype=jnp.int32)
+            state["win_valid"] = jnp.zeros(W, dtype=bool)
+        elif self.kind in ("running", "tumbling"):
+            kinds = {a.kind for a in self.aggs}
+            if kinds & {"sum", "avg"}:
+                state["acc_sum"] = jnp.zeros((G, A), dtype=jnp.float32)
+            if kinds & {"count", "avg"} or True:
+                # counts always kept: cheap, and avg/flush-valid need them
+                state["acc_cnt"] = jnp.zeros((G, A), dtype=jnp.float32)
+            if "min" in kinds:
+                state["acc_min"] = jnp.full((G, A), jnp.inf, dtype=jnp.float32)
+            if "max" in kinds:
+                state["acc_max"] = jnp.full((G, A), -jnp.inf, dtype=jnp.float32)
+            if self.kind == "tumbling":
+                state["touched"] = jnp.zeros(G, dtype=bool)
+                K = max(len(self._numeric_group_keys), 1)
+                state["grp_keys"] = jnp.zeros((G, K), dtype=jnp.float32)
+        return state
+
+    # -- steps ---------------------------------------------------------------
+
+    def _base_env(self, cols, ts, B):
+        env = {a: cols[a] for a in self.attrs if a in cols}
+        env[TS_KEY] = ts
+        env[N_KEY] = B
+        return env
+
+    def _filter_mask(self, env, valid):
+        jnp = self.jnp
+        m = valid
+        for f in self.filters:
+            m = m & jnp.asarray(f.fn(env)).astype(bool)
+        return m
+
+    def _arg_vals(self, env, B):
+        """[B, A] float32 aggregate-argument values (count -> ones)."""
+        jnp = self.jnp
+        if not self.aggs:
+            return jnp.ones((B, 1), dtype=jnp.float32)
+        cols = []
+        for a in self.aggs:
+            if a.arg is None:
+                cols.append(jnp.ones(B, dtype=jnp.float32))
+            else:
+                v = jnp.asarray(a.arg.fn(env)).astype(jnp.float32)
+                cols.append(jnp.broadcast_to(v, (B,)))
+        return jnp.stack(cols, axis=-1)
+
+    def _emit(self, env_out, fmask, B):
+        """Evaluate select items / having -> (out_valid, out_vals[B, n_out])."""
+        jnp = self.jnp
+        n_out = max(len(self.out_spec), 1)
+        out = jnp.zeros((B, n_out), dtype=jnp.float32)
+        for oi, (kind, v, _name) in enumerate(self.out_spec):
+            if kind == "group_key":
+                continue  # materialized host-side from interned ids
+            col = jnp.asarray(v.fn(env_out)).astype(jnp.float32)
+            out = out.at[:, oi].set(jnp.broadcast_to(col, (B,)))
+        if self.having is not None:
+            fmask = fmask & jnp.asarray(self.having.fn(env_out)).astype(bool)
+        return fmask, out
+
+    def make_step(self, jit: bool = True) -> Callable:
+        """Per-event step (filter / running / sliding kinds):
+
+        step(state, cols {attr: [B] f32}, ts[B] i32 relative-ms,
+             grp[B] i32, valid[B] bool)
+          -> (state, out_valid[B], out_vals[B, n_out])
+        """
+        key = ("step", jit)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        jnp = self.jnp
+        A = max(len(self.aggs), 1)
+        aggs = self.aggs
+
+        def step(state, cols, ts, grp, valid):
+            B = ts.shape[0]
+            env = self._base_env(cols, ts, B)
+            fmask = self._filter_mask(env, valid)
+
+            if self.kind == "filter":
+                env_out = env
+                ov, out = self._emit(env_out, fmask, B)
+                return state, ov, out
+
+            argvals = self._arg_vals(env, B)  # [B, A]
+
+            if self.kind == "running":
+                # within-batch same-group prefix (includes self): the
+                # [B, B] masked matmul rides the MXU
+                tri = jnp.tril(jnp.ones((B, B), dtype=jnp.float32))
+                same = (grp[:, None] == grp[None, :]) & fmask[None, :]
+                m = tri * same.astype(jnp.float32)  # [B, B]
+                masked_vals = argvals * fmask[:, None].astype(jnp.float32)
+                psum = m @ masked_vals  # [B, A]
+                pcnt = m @ fmask[:, None].astype(jnp.float32)  # [B, 1]
+                env_out = dict(env)
+                new_state = dict(state)
+                need_min = any(a.kind == "min" for a in aggs)
+                need_max = any(a.kind == "max" for a in aggs)
+                if need_min or need_max:
+                    big = jnp.float32(np.inf)
+                    vw = jnp.where(
+                        (tri.astype(bool) & same)[:, :, None],
+                        argvals[None, :, :], big)
+                    pmin = jnp.min(vw, axis=1)  # [B, A]
+                    vw2 = jnp.where(
+                        (tri.astype(bool) & same)[:, :, None],
+                        argvals[None, :, :], -big)
+                    pmax = jnp.max(vw2, axis=1)
+                upd = fmask[:, None]
+                for ai, a in enumerate(aggs):
+                    if a.kind in ("sum", "avg", "count"):
+                        prev_sum = state.get("acc_sum")
+                        prev_cnt = state["acc_cnt"]
+                        s_tot = (prev_sum[grp, ai] if prev_sum is not None
+                                 else 0.0) + psum[:, ai]
+                        c_tot = prev_cnt[grp, ai] + pcnt[:, 0]
+                        if a.kind == "sum":
+                            env_out[a.env_key] = s_tot
+                        elif a.kind == "count":
+                            env_out[a.env_key] = c_tot
+                        else:
+                            env_out[a.env_key] = s_tot / jnp.maximum(c_tot, 1.0)
+                    elif a.kind == "min":
+                        env_out[a.env_key] = jnp.minimum(
+                            state["acc_min"][grp, ai], pmin[:, ai])
+                    elif a.kind == "max":
+                        env_out[a.env_key] = jnp.maximum(
+                            state["acc_max"][grp, ai], pmax[:, ai])
+                # state update (scatter; duplicate group rows combine)
+                if "acc_sum" in state:
+                    new_state["acc_sum"] = state["acc_sum"].at[grp].add(
+                        jnp.where(upd, argvals, 0.0))
+                new_state["acc_cnt"] = state["acc_cnt"].at[grp].add(
+                    jnp.where(upd, jnp.ones_like(argvals), 0.0))
+                if "acc_min" in state:
+                    new_state["acc_min"] = state["acc_min"].at[grp].min(
+                        jnp.where(upd, argvals, jnp.inf))
+                if "acc_max" in state:
+                    new_state["acc_max"] = state["acc_max"].at[grp].max(
+                        jnp.where(upd, argvals, -jnp.inf))
+                ov, out = self._emit(env_out, fmask, B)
+                return new_state, ov, out
+
+            # sliding: compact passing rows, gather [B, W] windows
+            W = self.W
+            pos = jnp.cumsum(fmask.astype(jnp.int32)) - 1  # [B]
+            n_pass = jnp.sum(fmask.astype(jnp.int32))
+            sidx = jnp.where(fmask, pos, B)  # dump lane B
+            comp_vals = jnp.zeros((B + 1, A), jnp.float32).at[sidx].set(argvals)[:B]
+            comp_ts = jnp.zeros(B + 1, jnp.int32).at[sidx].set(ts)[:B]
+            comp_grp = jnp.zeros(B + 1, jnp.int32).at[sidx].set(grp)[:B]
+            comp_valid = (jnp.zeros(B + 1, bool)
+                          .at[sidx].set(jnp.ones(B, bool))[:B])
+            cat_vals = jnp.concatenate([state["win_vals"], comp_vals], 0)
+            cat_ts = jnp.concatenate([state["win_ts"], comp_ts], 0)
+            cat_grp = jnp.concatenate([state["win_grp"], comp_grp], 0)
+            cat_valid = jnp.concatenate([state["win_valid"], comp_valid], 0)
+            # window of output row i: concat positions pos[i]+1 .. pos[i]+W
+            # (the W entries ending at the row itself)
+            gidx = pos[:, None] + 1 + jnp.arange(W)[None, :]  # [B, W]
+            gidx = jnp.clip(gidx, 0, W + B - 1)
+            w_vals = cat_vals[gidx]  # [B, W, A]
+            member = cat_valid[gidx] & (cat_grp[gidx] == grp[:, None])
+            if self.window_name == "time":
+                T = self.window_param
+                member = member & (cat_ts[gidx] > (ts[:, None] - T))
+            mf = member.astype(jnp.float32)[:, :, None]
+            env_out = dict(env)
+            wsum = jnp.sum(w_vals * mf, axis=1)  # [B, A]
+            wcnt = jnp.sum(mf, axis=1)  # [B, 1]
+            for ai, a in enumerate(aggs):
+                if a.kind == "sum":
+                    env_out[a.env_key] = wsum[:, ai]
+                elif a.kind == "count":
+                    env_out[a.env_key] = wcnt[:, 0]
+                elif a.kind == "avg":
+                    env_out[a.env_key] = wsum[:, ai] / jnp.maximum(wcnt[:, 0], 1.0)
+                elif a.kind == "min":
+                    env_out[a.env_key] = jnp.min(
+                        jnp.where(member, w_vals[:, :, ai], jnp.inf), axis=1)
+                elif a.kind == "max":
+                    env_out[a.env_key] = jnp.max(
+                        jnp.where(member, w_vals[:, :, ai], -jnp.inf), axis=1)
+            ov, out = self._emit(env_out, fmask, B)
+            # new buffer = last W entries ending at the batch's final
+            # passing row: concat[n_pass : n_pass + W]
+            start = jnp.clip(n_pass, 0, B)
+            new_state = dict(state)
+            dyn = self.jax.lax.dynamic_slice_in_dim
+            new_state["win_vals"] = dyn(cat_vals, start, W, axis=0)
+            new_state["win_ts"] = dyn(cat_ts, start, W, axis=0)
+            new_state["win_grp"] = dyn(cat_grp, start, W, axis=0)
+            new_state["win_valid"] = dyn(cat_valid, start, W, axis=0)
+            return new_state, ov, out
+
+        fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
+        self._step_cache[key] = fn
+        return fn
+
+    def make_acc_step(self, jit: bool = True) -> Callable:
+        """Tumbling accumulate step:
+        (state, cols, ts, grp, grp_key_vals[B,K], valid)
+          -> (state, n_passing)."""
+        key = ("acc", jit)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        jnp = self.jnp
+        aggs = self.aggs
+        K = max(len(self._numeric_group_keys), 1)
+
+        def acc(state, cols, ts, grp, gkv, valid):
+            B = ts.shape[0]
+            env = self._base_env(cols, ts, B)
+            fmask = self._filter_mask(env, valid)
+            argvals = self._arg_vals(env, B)
+            upd = fmask[:, None]
+            new_state = dict(state)
+            if "acc_sum" in state:
+                new_state["acc_sum"] = state["acc_sum"].at[grp].add(
+                    jnp.where(upd, argvals, 0.0))
+            new_state["acc_cnt"] = state["acc_cnt"].at[grp].add(
+                jnp.where(upd, jnp.ones_like(argvals), 0.0))
+            if "acc_min" in state:
+                new_state["acc_min"] = state["acc_min"].at[grp].min(
+                    jnp.where(upd, argvals, jnp.inf))
+            if "acc_max" in state:
+                new_state["acc_max"] = state["acc_max"].at[grp].max(
+                    jnp.where(upd, argvals, -jnp.inf))
+            new_state["touched"] = state["touched"].at[grp].max(fmask)
+            # group-key registers (constant per group, so set is safe)
+            new_state["grp_keys"] = state["grp_keys"].at[grp].set(
+                jnp.where(upd, gkv.astype(jnp.float32),
+                          state["grp_keys"][grp]))
+            return new_state, jnp.sum(fmask.astype(jnp.int32))
+
+        fn = self.jax.jit(acc, donate_argnums=(0,)) if jit else acc
+        self._step_cache[key] = fn
+        return fn
+
+    def make_flush_step(self, jit: bool = True) -> Callable:
+        """Tumbling flush: (state) -> (state, flush_valid[G], out[G, n_out])."""
+        key = ("flush", jit)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        jnp = self.jnp
+        aggs = self.aggs
+        G = self.n_groups
+
+        def flush(state):
+            env = {N_KEY: G}
+            for ai, a in enumerate(aggs):
+                if a.kind == "sum":
+                    env[a.env_key] = state["acc_sum"][:, ai]
+                elif a.kind == "count":
+                    env[a.env_key] = state["acc_cnt"][:, ai]
+                elif a.kind == "avg":
+                    env[a.env_key] = state["acc_sum"][:, ai] / jnp.maximum(
+                        state["acc_cnt"][:, ai], 1.0)
+                elif a.kind == "min":
+                    env[a.env_key] = state["acc_min"][:, ai]
+                elif a.kind == "max":
+                    env[a.env_key] = state["acc_max"][:, ai]
+            for ki, i in enumerate(self._numeric_group_keys):
+                g = self.group_raw[i]
+                if isinstance(g, Variable):
+                    env[g.attribute] = state["grp_keys"][:, ki]
+            valid = state["touched"]
+            ov, out = self._emit(env, valid, G)
+            new_state = dict(state)
+            for k in ("acc_sum", "acc_cnt"):
+                if k in state:
+                    new_state[k] = jnp.zeros_like(state[k])
+            if "acc_min" in state:
+                new_state["acc_min"] = jnp.full_like(state["acc_min"], jnp.inf)
+            if "acc_max" in state:
+                new_state["acc_max"] = jnp.full_like(state["acc_max"], -jnp.inf)
+            new_state["touched"] = jnp.zeros_like(state["touched"])
+            return new_state, ov, out
+
+        fn = self.jax.jit(flush, donate_argnums=(0,)) if jit else flush
+        self._step_cache[key] = fn
+        return fn
+
+    # -- host wrapper --------------------------------------------------------
+
+    def _rel_ts(self, ts: np.ndarray) -> np.ndarray:
+        if self.base_ts is None:
+            self.base_ts = int(ts[0]) - 1 if len(ts) else 0
+        return (ts - self.base_ts).astype(np.int32)
+
+    def _intern_groups(self, cols: Dict[str, np.ndarray],
+                       ts: np.ndarray, n: int) -> np.ndarray:
+        """Evaluate group-key exprs host-side and intern to dense ids."""
+        if not self.group_exprs:
+            return np.zeros(n, dtype=np.int32)
+        env = {a: np.asarray(cols[a]) for a in self.all_attrs if a in cols}
+        env[TS_KEY] = np.asarray(ts)
+        env[N_KEY] = n
+        key_cols = [np.broadcast_to(np.asarray(g.fn(env)), (n,))
+                    for g in self.group_exprs]
+        out = np.empty(n, dtype=np.int32)
+        for i in range(n):
+            k = tuple(c[i] for c in key_cols)
+            k = k[0] if len(k) == 1 else k
+            gid = self._group_ids.get(k)
+            if gid is None:
+                gid = len(self._group_ids)
+                if gid >= self.n_groups:
+                    raise SiddhiAppRuntimeError(
+                        f"device query: group cardinality exceeded "
+                        f"n_groups={self.n_groups}")
+                self._group_ids[k] = gid
+                self._group_vals.append(k)
+            out[i] = gid
+        return out
+
+    def _pad(self, cols, rel, grp, n):
+        jnp = self.jnp
+        B = _pow2(n)
+        valid = np.zeros(B, dtype=bool)
+        valid[:n] = True
+        c = {}
+        for k in self.attrs:
+            col = np.zeros(B, dtype=np.float32)
+            col[:n] = np.asarray(cols[k], dtype=np.float32)[:n] if k in cols else 0
+            c[k] = jnp.asarray(col)
+        t = np.zeros(B, dtype=np.int32)
+        t[:n] = rel[:n]
+        g = np.zeros(B, dtype=np.int32)
+        g[:n] = grp[:n]
+        return c, jnp.asarray(t), jnp.asarray(g), jnp.asarray(valid), B
+
+    def _materialize(self, out_valid, out_vals, grp, n) -> List[Dict]:
+        """Device outputs -> list of {name: value} rows (host types)."""
+        ov = np.asarray(out_valid)[:n]
+        vals = np.asarray(out_vals)[:n]
+        rows = []
+        for i in np.flatnonzero(ov):
+            row = {}
+            for oi, (kind, v, name) in enumerate(self.out_spec):
+                if kind == "group_key":
+                    k = self._group_vals[int(grp[i])]
+                    row[name] = k[v] if isinstance(k, tuple) else k
+                else:
+                    row[name] = float(vals[i, oi])
+            rows.append(row)
+        return rows
+
+    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Host entry point.  Returns ``(state, rows)`` where rows are
+        emitted output dicts in emission order."""
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(ts)
+        rel = self._rel_ts(ts)
+        grp = self._intern_groups(cols, ts, n)
+        if self.kind in ("filter", "running", "sliding"):
+            step = self.make_step()
+            c, t, g, valid, B = self._pad(cols, rel, grp, n)
+            state, ov, out = step(state, c, t, g, valid)
+            return state, self._materialize(ov, out, grp, n)
+        return self._process_tumbling(state, cols, rel, grp, n)
+
+    # -- tumbling host logic -------------------------------------------------
+
+    def _gk_vals(self, grp: np.ndarray, n: int) -> np.ndarray:
+        K = max(len(self._numeric_group_keys), 1)
+        out = np.zeros((n, K), dtype=np.float32)
+        for ki, i in enumerate(self._numeric_group_keys):
+            for r in range(n):
+                k = self._group_vals[int(grp[r])]
+                v = k[i] if isinstance(k, tuple) else k
+                out[r, ki] = np.float32(v)
+        return out
+
+    def _flush(self, state) -> Tuple[object, List[Dict]]:
+        flush = self.make_flush_step()
+        state, ov, out = flush(state)
+        ovn = np.asarray(ov)
+        vals = np.asarray(out)
+        rows = []
+        for gi in np.flatnonzero(ovn):
+            row = {}
+            for oi, (kind, v, name) in enumerate(self.out_spec):
+                if kind == "group_key":
+                    k = self._group_vals[gi]
+                    row[name] = k[v] if isinstance(k, tuple) else k
+                else:
+                    row[name] = float(vals[gi, oi])
+            rows.append(row)
+        return state, rows
+
+    def _acc_segment(self, state, cols, rel, grp, idx) -> Tuple[object, int]:
+        acc = self.make_acc_step()
+        n = len(idx)
+        c, t, g, valid, B = self._pad(
+            {k: np.asarray(v)[idx] for k, v in cols.items()},
+            rel[idx], grp[idx], n)
+        gkv = np.zeros((B, max(len(self._numeric_group_keys), 1)),
+                       dtype=np.float32)
+        gkv[:n] = self._gk_vals(grp[idx], n)
+        state, n_pass = acc(state, c, t, g, self.jnp.asarray(gkv), valid)
+        return state, int(n_pass)
+
+    def _process_tumbling(self, state, cols, rel, grp, n):
+        rows: List[Dict] = []
+        if self.window_name == "timeBatch":
+            # pane bookkeeping mirrors the host TimeBatchWindow: the
+            # first event anchors the boundary, boundaries advance by T
+            # while panes stay non-empty, and the window goes idle
+            # (re-anchoring at the next event) once a pane and its
+            # predecessor are both empty
+            T = int(self.window_param)
+            i = 0
+            while i < n:
+                if self._pane_end is None:
+                    self._pane_end = int(rel[i]) + T
+                    self._pane_fill = 0
+                    self._prev_pane_fill = 0
+                # events belonging to the current pane: ts < pane_end
+                j = int(np.searchsorted(rel[i:], self._pane_end,
+                                        side="left")) + i
+                if j > i:
+                    state, n_pass = self._acc_segment(
+                        state, cols, rel, grp, np.arange(i, j))
+                    self._pane_fill += n_pass
+                    i = j
+                if i < n:  # boundary crossed by remaining events
+                    state, flushed = self._flush(state)
+                    rows.extend(flushed)
+                    if self._pane_fill == 0 and getattr(
+                            self, "_prev_pane_fill", 0) == 0:
+                        self._pane_end = None  # idle; re-anchor at rel[i]
+                    else:
+                        self._pane_end += T
+                        self._prev_pane_fill = self._pane_fill
+                        self._pane_fill = 0
+            return state, rows
+        # lengthBatch: need passing counts to place flush boundaries,
+        # so probe the filter mask first (host-visible)
+        L = int(self.window_param)
+        fmask = self._host_filter_mask(cols, rel, n)
+        i = 0
+        while i < n:
+            remaining = L - self._pane_fill
+            pass_pos = np.flatnonzero(fmask[i:])
+            if len(pass_pos) < remaining:
+                state, _ = self._acc_segment(
+                    state, cols, rel, grp, np.arange(i, n))
+                self._pane_fill += len(pass_pos)
+                break
+            j = i + int(pass_pos[remaining - 1]) + 1
+            state, _ = self._acc_segment(state, cols, rel, grp,
+                                         np.arange(i, j))
+            state, flushed = self._flush(state)
+            rows.extend(flushed)
+            self._pane_fill = 0
+            i = j
+        return state, rows
+
+    def _host_filter_mask(self, cols, rel, n) -> np.ndarray:
+        env = {a: np.asarray(cols[a]) for a in self.all_attrs if a in cols}
+        env[TS_KEY] = np.asarray(rel)
+        env[N_KEY] = n
+        m = np.ones(n, dtype=bool)
+        for f in self.filters:
+            m = m & np.broadcast_to(np.asarray(f.fn(env)).astype(bool), (n,))
+        return m
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def output_names(self) -> List[str]:
+        return [name for _k, _v, name in self.out_spec]
+
+
+# ---------------------------------------------------------------------------
+# High-level compile API (mirrors ops.dense_nfa.compile_pattern)
+# ---------------------------------------------------------------------------
+
+
+def compile_query(
+    app_str: str,
+    query_name: Optional[str] = None,
+    n_groups: int = 1024,
+    window_capacity: int = 1024,
+) -> DeviceQueryEngine:
+    """Compile a SiddhiQL single-stream query into a DeviceQueryEngine."""
+    from siddhi_tpu.compiler import SiddhiCompiler
+    from siddhi_tpu.query_api.annotation import find_annotation
+
+    app = SiddhiCompiler.parse(app_str)
+    query = None
+    for i, q in enumerate(app.queries):
+        info = find_annotation(q.annotations, "info")
+        nm = (info.element("name") if info else None) or f"query_{i}"
+        if query_name is None or nm == query_name:
+            query = q
+            break
+    if query is None:
+        raise SiddhiAppCreationError(f"query '{query_name}' not found")
+    s = query.input_stream
+    if not isinstance(s, SingleInputStream):
+        raise SiddhiAppCreationError(
+            "compile_query needs a single-input-stream query")
+    d = app.stream_definitions.get(s.stream_id)
+    if d is None:
+        raise SiddhiAppCreationError(f"stream '{s.stream_id}' is not defined")
+    return DeviceQueryEngine(
+        query, d, n_groups=n_groups, window_capacity=window_capacity)

@@ -1,0 +1,260 @@
+"""Device (jitted) general-query pipeline vs the host engine.
+
+Every test runs the same SiddhiQL app through BOTH paths on the same
+event series — the host engine via the public SiddhiManager API
+(playback mode so event time drives windows deterministically) and the
+device engine via ops.device_query.compile_query — and asserts the
+emitted rows agree.  Reference behavior being pinned:
+QuerySelector.java:76-99 (+ aggregator executors), FilterProcessor,
+Length/Time/LengthBatch/TimeBatchWindowProcessor.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.ops.device_query import compile_query
+
+
+def host_rows(app, sends, out="OutputStream"):
+    """Run via the public API in playback mode -> list of row dicts."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
+        rt.shutdown()
+        names = rt.junctions[out].definition.attribute_names
+        return [dict(zip(names, e.data)) for e in got]
+    finally:
+        m.shutdown()
+
+
+def device_rows(app, sends, attrs, **kw):
+    eng = compile_query(app, **kw)
+    state = eng.init_state()
+    cols = {a: np.asarray([r[i] for r, _t in sends], dtype=np.float64)
+            for i, a in enumerate(attrs)}
+    ts = np.asarray([t for _r, t in sends], dtype=np.int64)
+    state, rows = eng.process(state, cols, ts)
+    return rows
+
+
+def assert_rows_close(host, dev, ordered=True):
+    assert len(host) == len(dev), f"{len(host)} host vs {len(dev)} device rows"
+
+    def norm(row):
+        return tuple(
+            round(float(v), 3) if isinstance(v, (int, float, np.number))
+            else v
+            for v in row.values()
+        )
+
+    h = [norm(r) for r in host]
+    d = [norm(r) for r in dev]
+    if not ordered:
+        h, d = sorted(h), sorted(d)
+    for i, (a, b) in enumerate(zip(h, d)):
+        assert a == pytest.approx(b, rel=1e-4, abs=1e-3), (
+            f"row {i}: host {a} != device {b}")
+
+
+def series(n, seed, n_keys=4, t0=1000, dt_max=400):
+    rng = np.random.default_rng(seed)
+    ts = t0 + np.cumsum(rng.integers(1, dt_max, size=n))
+    keys = rng.integers(0, n_keys, size=n)
+    vals = rng.integers(1, 100, size=n).astype(float)
+    return [([int(k), float(v)], int(t)) for k, v, t in zip(keys, vals, ts)]
+
+
+APP_ATTRS = ["k", "v"]
+DEFINE = "define stream S (k long, v double); "
+
+
+class TestFilterQuery:
+    APP = DEFINE + "from S[v > 50.0] select k, v, v * 2.0 as dbl insert into OutputStream;"
+
+    def test_equivalence(self):
+        sends = series(200, seed=1)
+        assert_rows_close(
+            host_rows(self.APP, sends),
+            device_rows(self.APP, sends, APP_ATTRS),
+        )
+
+    def test_no_window_no_state(self):
+        eng = compile_query(self.APP)
+        assert eng.kind == "filter"
+        assert eng.init_state() == {}
+
+
+class TestRunningAggregates:
+    def test_ungrouped_running_sum_count(self):
+        app = DEFINE + (
+            "from S[v > 20.0] select sum(v) as s, count() as c, avg(v) as a "
+            "insert into OutputStream;")
+        sends = series(150, seed=2)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_grouped_running_min_max(self):
+        app = DEFINE + (
+            "from S select k, min(v) as lo, max(v) as hi, sum(v) as s "
+            "group by k insert into OutputStream;")
+        sends = series(200, seed=3, n_keys=7)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_multiple_batches_carry_state(self):
+        app = DEFINE + (
+            "from S select k, sum(v) as s group by k "
+            "insert into OutputStream;")
+        sends = series(120, seed=4)
+        eng = compile_query(app)
+        state = eng.init_state()
+        dev = []
+        for lo in range(0, 120, 37):  # uneven batch splits
+            chunk = sends[lo:lo + 37]
+            cols = {a: np.asarray([r[i] for r, _t in chunk], dtype=float)
+                    for i, a in enumerate(APP_ATTRS)}
+            ts = np.asarray([t for _r, t in chunk], dtype=np.int64)
+            state, rows = eng.process(state, cols, ts)
+            dev.extend(rows)
+        assert_rows_close(host_rows(app, sends), dev)
+
+
+class TestSlidingLengthWindow:
+    def test_ungrouped(self):
+        app = DEFINE + (
+            "from S#window.length(5) select sum(v) as s, count() as c "
+            "insert into OutputStream;")
+        sends = series(100, seed=5)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_grouped_with_filter(self):
+        app = DEFINE + (
+            "from S[v > 30.0]#window.length(8) "
+            "select k, sum(v) as s, min(v) as lo, max(v) as hi, avg(v) as a "
+            "group by k insert into OutputStream;")
+        sends = series(250, seed=6, n_keys=5)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_cross_batch_window_carry(self):
+        app = DEFINE + (
+            "from S#window.length(6) select k, sum(v) as s group by k "
+            "insert into OutputStream;")
+        sends = series(90, seed=7)
+        eng = compile_query(app)
+        state = eng.init_state()
+        dev = []
+        for lo in range(0, 90, 23):
+            chunk = sends[lo:lo + 23]
+            cols = {a: np.asarray([r[i] for r, _t in chunk], dtype=float)
+                    for i, a in enumerate(APP_ATTRS)}
+            ts = np.asarray([t for _r, t in chunk], dtype=np.int64)
+            state, rows = eng.process(state, cols, ts)
+            dev.extend(rows)
+        assert_rows_close(host_rows(app, sends), dev)
+
+
+class TestSlidingTimeWindow:
+    def test_ungrouped(self):
+        app = DEFINE + (
+            "from S#window.time(1 sec) select sum(v) as s, count() as c "
+            "insert into OutputStream;")
+        sends = series(120, seed=8)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_grouped(self):
+        app = DEFINE + (
+            "from S#window.time(2 sec) select k, sum(v) as s, avg(v) as a "
+            "group by k insert into OutputStream;")
+        sends = series(200, seed=9, n_keys=6)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+
+class TestTumblingTimeBatch:
+    def test_grouped_flushes(self):
+        app = DEFINE + (
+            "from S#window.timeBatch(1 sec) select k, sum(v) as s "
+            "group by k insert into OutputStream;")
+        sends = series(150, seed=10, n_keys=4)
+        assert_rows_close(
+            host_rows(app, sends),
+            device_rows(app, sends, APP_ATTRS),
+            ordered=False,  # flush rows: group order is incidental
+        )
+
+    def test_sparse_panes_idle_reanchor(self):
+        app = DEFINE + (
+            "from S#window.timeBatch(1 sec) select sum(v) as s "
+            "insert into OutputStream;")
+        # long silences force the idle/re-anchor path
+        sends = [([0, 10.0], 1000), ([0, 20.0], 1400),
+                 ([0, 30.0], 9000), ([0, 40.0], 9500),
+                 ([0, 50.0], 30000)]
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+    def test_ungrouped_avg(self):
+        app = DEFINE + (
+            "from S[v > 25.0]#window.timeBatch(2 sec) "
+            "select avg(v) as a, count() as c insert into OutputStream;")
+        sends = series(180, seed=11)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+
+class TestTumblingLengthBatch:
+    def test_grouped(self):
+        app = DEFINE + (
+            "from S#window.lengthBatch(10) select k, sum(v) as s, count() as c "
+            "group by k insert into OutputStream;")
+        sends = series(95, seed=12, n_keys=3)
+        assert_rows_close(
+            host_rows(app, sends),
+            device_rows(app, sends, APP_ATTRS),
+            ordered=False,
+        )
+
+    def test_filtered_flush_boundaries(self):
+        # boundaries are placed on PASSING events only
+        app = DEFINE + (
+            "from S[v > 50.0]#window.lengthBatch(7) select sum(v) as s "
+            "insert into OutputStream;")
+        sends = series(160, seed=13)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
+
+
+class TestEligibility:
+    def test_string_filter_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        app = ("define stream S (sym string, v double); "
+               "from S[sym == 'IBM'] select v insert into OutputStream;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_query(app)
+
+    def test_unsupported_window_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        app = DEFINE + ("from S#window.sort(5, v) select v "
+                        "insert into OutputStream;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_query(app)
+
+    def test_having_supported(self):
+        app = DEFINE + (
+            "from S select k, sum(v) as s group by k having s > 100.0 "
+            "insert into OutputStream;")
+        sends = series(80, seed=14)
+        assert_rows_close(host_rows(app, sends),
+                          device_rows(app, sends, APP_ATTRS))
