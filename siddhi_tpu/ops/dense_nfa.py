@@ -42,7 +42,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
 from siddhi_tpu.ops.nfa import ANY, NFABuilder, Node, PatternScope, Spec
 from siddhi_tpu.planner.expr import (
     CompiledExpression,
@@ -233,24 +236,30 @@ class DensePatternEngine:
 
     # -- state --------------------------------------------------------------
 
-    def init_state(self):
-        jnp = self.jnp
+    def init_state_host(self) -> Dict[str, np.ndarray]:
+        """Zero state as NUMPY arrays — no device allocation, so callers
+        (e.g. the sharded wrapper) can lay out rows before any backend
+        is selected."""
         # one scratch row (index P) absorbs padded/invalid batch rows so
         # their scatter-back cannot collide with a real partition
         P, S, R = self.n_partitions + 1, self.S, max(self.alloc.n, 1)
-        active0 = jnp.zeros(P, dtype=jnp.uint32)
+        active0 = np.zeros(P, dtype=np.uint32)
         if not self.every_start:
             # non-every: node 0 armed once per partition; after a match
             # reset_on_emit clears it and the partition's automaton is done
-            active0 = active0 | jnp.uint32(1)
-        state = {
+            active0 |= np.uint32(1)
+        return {
             "active": active0,
             # relative ms since self.base_ts (int32: ~24 days of horizon),
             # 0 == unset
-            "first_ts": jnp.zeros((P, S), dtype=jnp.int32),
-            "counts": jnp.zeros((P, S), dtype=jnp.int32),
-            "regs": jnp.zeros((P, S, R), dtype=jnp.float32),
+            "first_ts": np.zeros((P, S), dtype=np.int32),
+            "counts": np.zeros((P, S), dtype=np.int32),
+            "regs": np.zeros((P, S, R), dtype=np.float32),
         }
+
+    def init_state(self):
+        jnp = self.jnp
+        state = {k: jnp.asarray(v) for k, v in self.init_state_host().items()}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as Pspec
 
@@ -534,11 +543,75 @@ class DensePatternEngine:
     # -- host wrapper -------------------------------------------------------
 
     base_ts: Optional[int] = None
+    # re-anchor before relative ms approach int32 range (~24.8 days of
+    # stream time); headroom covers one batch + the within horizon
+    _REL_LIMIT = 2**31 - 2**24
 
-    def _rel_ts(self, ts: np.ndarray) -> np.ndarray:
+    def rel_ts64(self, ts: np.ndarray) -> np.ndarray:
         if self.base_ts is None:
             self.base_ts = int(ts[0]) - 1 if len(ts) else 0
-        return (ts - self.base_ts).astype(np.int32)
+        return ts - self.base_ts
+
+    def maybe_re_anchor(self, state, rel64: np.ndarray, to_device=None):
+        """Shift base_ts forward when relative timestamps approach the
+        int32 range (they silently wrap after ~24.8 days otherwise and
+        `within` checks corrupt).  ``first_ts`` anchors shift with it;
+        instances whose anchor falls outside the `within` horizon are
+        already expired and get their bits/counters cleared host-side
+        (a once-per-24-days op, so the host round trip is fine).
+
+        ``to_device(key, np_array)`` converts arrays back (defaults to
+        jnp.asarray; the sharded wrapper passes a resharding put)."""
+        if not len(rel64) or int(rel64.max()) < self._REL_LIMIT:
+            return state, rel64
+        horizon = self.within_ms or 0
+        delta = int(rel64.min()) - 1 - horizon
+        if delta <= 0 or int(rel64.max()) - delta >= 2**31:
+            raise SiddhiAppRuntimeError(
+                "dense NFA: timestamp span of one batch plus the within "
+                "horizon exceeds the int32 relative-time range")
+        self.base_ts += delta
+        rel64 = rel64 - delta
+        first = np.asarray(state["first_ts"]).astype(np.int64)
+        shifted = np.where(first > 0, first - delta, 0)
+        if self.within_ms is not None:
+            # anchors at/below the new zero were expired before the shift
+            dead = (first > 0) & (shifted <= 0)
+            active = np.asarray(state["active"]).copy()
+            counts = np.asarray(state["counts"]).copy()
+            if dead.any():
+                for s in range(self.S):
+                    active[dead[:, s]] &= ~np.uint32(1 << s)
+                counts[dead] = 0
+                shifted = np.where(dead, 0, shifted)
+        else:
+            # no within: anchors are semantically inert, clamp to stay
+            # "set" (>0) without wrapping
+            active = np.asarray(state["active"])
+            counts = np.asarray(state["counts"])
+            shifted = np.where(first > 0, np.maximum(shifted, 1), 0)
+        if to_device is not None:
+            conv = to_device
+        elif self.mesh is not None:
+            # keep the partition-axis sharding init_state applied — a
+            # plain jnp.asarray would silently collapse state onto the
+            # default device after a re-anchor
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+            specs = {
+                "active": Pspec(self.partition_axis),
+                "first_ts": Pspec(self.partition_axis, None),
+                "counts": Pspec(self.partition_axis, None),
+            }
+            conv = lambda k, v: self.jax.device_put(
+                v, NamedSharding(self.mesh, specs[k]))
+        else:
+            conv = lambda _k, v: self.jnp.asarray(v)
+        state = dict(state)
+        state["first_ts"] = conv("first_ts", shifted.astype(np.int32))
+        state["active"] = conv("active", active)
+        state["counts"] = conv("counts", counts)
+        return state, rel64
 
     def process(self, state, stream_key: str, part_idx: np.ndarray, cols: Dict[str, np.ndarray], ts: np.ndarray):
         """Process a batch, splitting rounds so each partition appears at
@@ -546,7 +619,9 @@ class DensePatternEngine:
         padded to powers of two to bound jit recompilation."""
         jnp = self.jnp
         step = self.make_step(stream_key)
-        rel = self._rel_ts(np.asarray(ts, dtype=np.int64))
+        rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
+        state, rel64 = self.maybe_re_anchor(state, rel64)
+        rel = rel64.astype(np.int32)
         n = len(part_idx)
         emit_all = np.zeros(n, dtype=bool)
         out_all = np.zeros((n, max(len(self.out_spec), 1)), dtype=np.float32)

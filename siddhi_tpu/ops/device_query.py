@@ -92,6 +92,32 @@ class DeviceAgg:
     env_key: str
 
 
+def _map_children(expr: Expression, fn) -> Expression:
+    """Rebuild a composite expression node with ``fn`` applied to each
+    child; leaves return unchanged.  The single structural walk shared
+    by every AST pass in this module — add new composite node types
+    HERE, not in the passes."""
+    if isinstance(expr, ArithmeticOp):
+        return ArithmeticOp(expr.op, fn(expr.left), fn(expr.right))
+    if isinstance(expr, CompareOp):
+        return CompareOp(expr.op, fn(expr.left), fn(expr.right))
+    if isinstance(expr, AndOp):
+        return AndOp(fn(expr.left), fn(expr.right))
+    if isinstance(expr, OrOp):
+        return OrOp(fn(expr.left), fn(expr.right))
+    if isinstance(expr, NotOp):
+        return NotOp(fn(expr.expr))
+    if isinstance(expr, IsNull):
+        return IsNull(fn(expr.expr))
+    if isinstance(expr, FunctionCall):
+        return FunctionCall(
+            expr.namespace, expr.name, tuple(fn(a) for a in expr.args),
+            expr.star)
+    if isinstance(expr, InOp):
+        return InOp(fn(expr.expr), expr.source_id)
+    return expr
+
+
 class _DeviceAggRewrite:
     """Replaces aggregator calls in select/having expressions with
     synthetic variables bound to device aggregation outputs (the device
@@ -126,27 +152,21 @@ class _DeviceAggRewrite:
             self.aggs.append(DeviceAgg(expr.name, arg, key))
             self.scope.add_bare(key, out_t)
             return Variable(attribute=key)
-        if isinstance(expr, ArithmeticOp):
-            return ArithmeticOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
-        if isinstance(expr, CompareOp):
-            return CompareOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
-        if isinstance(expr, AndOp):
-            return AndOp(self.rewrite(expr.left), self.rewrite(expr.right))
-        if isinstance(expr, OrOp):
-            return OrOp(self.rewrite(expr.left), self.rewrite(expr.right))
-        if isinstance(expr, NotOp):
-            return NotOp(self.rewrite(expr.expr))
-        if isinstance(expr, IsNull):
-            return IsNull(self.rewrite(expr.expr))
-        if isinstance(expr, FunctionCall):
-            return FunctionCall(
-                expr.namespace, expr.name,
-                tuple(self.rewrite(a) for a in expr.args), expr.star,
-            )
         if isinstance(expr, InOp):
             raise SiddhiAppCreationError(
                 "device query path does not support table membership (IN)")
+        return _map_children(expr, self.rewrite)
+
+
+def _subst_aliases(expr: Expression, aliases: Dict[str, Expression]) -> Expression:
+    """Replace bare Variable references to select aliases with the select
+    item's (already aggregator-rewritten) expression.  An alias shadows a
+    same-named input attribute, matching the host selector's scope order."""
+    if isinstance(expr, Variable):
+        if expr.stream_id is None and expr.attribute in aliases:
+            return aliases[expr.attribute]
         return expr
+    return _map_children(expr, lambda e: _subst_aliases(e, aliases))
 
 
 def _pow2(n: int, floor: int = 16) -> int:
@@ -261,16 +281,25 @@ class DeviceQueryEngine:
                 "device query path needs an explicit select list")
         # out_spec entries: ("expr", compiled) | ("group_key", key_index)
         self.out_spec: List[Tuple[str, object, str]] = []
+        # select alias -> rewritten expression AST, so `having s > 100`
+        # referencing `sum(v) as s` resolves (the host path registers
+        # output attrs in scope, planner/query_planner.py:530-535; here
+        # aliases substitute inline before compiling having)
+        alias_map: Dict[str, Expression] = {}
         for oa in sel.selection:
             gk = self._as_group_key(oa.expression)
             if gk is not None:
                 self.out_spec.append(("group_key", gk, oa.name))
+                alias_map[oa.name] = oa.expression
                 continue
-            compiled = compiler.compile(rewriter.rewrite(oa.expression))
+            rewritten = rewriter.rewrite(oa.expression)
+            compiled = compiler.compile(rewritten)
             self.out_spec.append(("expr", compiled, oa.name))
+            alias_map[oa.name] = rewritten
         self.aggs = rewriter.aggs
         self.having = (
-            compiler.compile(rewriter.rewrite(sel.having))
+            compiler.compile(rewriter.rewrite(
+                _subst_aliases(sel.having, alias_map)))
             if sel.having is not None else None
         )
         if sel.order_by or sel.limit is not None or sel.offset is not None:
@@ -638,10 +667,19 @@ class DeviceQueryEngine:
                 new_state["acc_max"] = state["acc_max"].at[grp].max(
                     jnp.where(upd, argvals, -jnp.inf))
             new_state["touched"] = state["touched"].at[grp].max(fmask)
-            # group-key registers (constant per group, so set is safe)
-            new_state["grp_keys"] = state["grp_keys"].at[grp].set(
-                jnp.where(upd, gkv.astype(jnp.float32),
-                          state["grp_keys"][grp]))
+            # group-key registers: scatter only PASSING rows (filtered
+            # rows go to a dump row G) — a same-batch passing+filtered
+            # pair for one group would otherwise write two different
+            # values in XLA-undefined order; every value written to a
+            # real group row is the true (constant-per-group) key
+            G = state["grp_keys"].shape[0]
+            dump_idx = jnp.where(fmask, grp, G)
+            padded = jnp.concatenate(
+                [state["grp_keys"],
+                 jnp.zeros((1,) + state["grp_keys"].shape[1:], jnp.float32)],
+                axis=0)
+            new_state["grp_keys"] = padded.at[dump_idx].set(
+                gkv.astype(jnp.float32))[:G]
             return new_state, jnp.sum(fmask.astype(jnp.int32))
 
         fn = self.jax.jit(acc, donate_argnums=(0,)) if jit else acc
@@ -694,10 +732,40 @@ class DeviceQueryEngine:
 
     # -- host wrapper --------------------------------------------------------
 
-    def _rel_ts(self, ts: np.ndarray) -> np.ndarray:
-        if self.base_ts is None:
-            self.base_ts = int(ts[0]) - 1 if len(ts) else 0
-        return (ts - self.base_ts).astype(np.int32)
+    # re-anchor before relative ms approach int32 range (~24.8 days of
+    # stream time); headroom covers one batch + window horizon
+    _REL_LIMIT = 2**31 - 2**24
+
+    def _re_anchor(self, state, rel64: np.ndarray):
+        """Shift base_ts forward so relative timestamps stay well inside
+        int32 (they silently wrap after ~24.8 days otherwise — sliding
+        time windows and timeBatch panes would corrupt).  Live window
+        entries and the open pane boundary shift with it."""
+        horizon = (
+            int(self.window_param) if self.window_name in ("time", "timeBatch")
+            else 0
+        )
+        delta = int(rel64.min()) - 1 - horizon
+        # all representability checks BEFORE any mutation, so a caller
+        # catching the error keeps a consistent (anchor, window-state)
+        # pair for subsequent batches
+        if delta <= 0 or int(rel64.max()) - delta >= 2**31:
+            raise SiddhiAppRuntimeError(
+                "device query: timestamp span of one batch plus the window "
+                "horizon exceeds the int32 relative-time range")
+        self.base_ts += delta
+        rel64 = rel64 - delta
+        if "win_ts" in state:
+            state = dict(state)
+            # entries older than the horizon go negative and stay
+            # excluded; a delta beyond int32 means EVERY buffered entry
+            # is expired, so the shift clamps (old values in [0, 2^31)
+            # minus the clamp land in (-2^31, 1) — no wrap either way)
+            shift = np.int32(min(delta, 2**31 - 1))
+            state["win_ts"] = state["win_ts"] - shift
+        if self._pane_end is not None:
+            self._pane_end -= delta
+        return state, rel64
 
     def _intern_groups(self, cols: Dict[str, np.ndarray],
                        ts: np.ndarray, n: int) -> np.ndarray:
@@ -762,7 +830,12 @@ class DeviceQueryEngine:
         emitted output dicts in emission order."""
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
-        rel = self._rel_ts(ts)
+        if self.base_ts is None:
+            self.base_ts = int(ts[0]) - 1 if n else 0
+        rel64 = ts - self.base_ts
+        if n and int(rel64.max()) >= self._REL_LIMIT:
+            state, rel64 = self._re_anchor(state, rel64)
+        rel = rel64.astype(np.int32)
         grp = self._intern_groups(cols, ts, n)
         if self.kind in ("filter", "running", "sliding"):
             step = self.make_step()

@@ -219,8 +219,13 @@ class ShardedPatternEngine:
     def init_state(self):
         """Zero state with shard-major layout: each shard owns
         ``parts_per_shard`` partition rows plus one trailing scratch
-        row (same per-row init values as the unsharded engine)."""
-        host = {k: np.asarray(v) for k, v in self.engine.init_state().items()}
+        row (same per-row init values as the unsharded engine).
+
+        Built from the engine's NUMPY init (init_state_host) — calling
+        the device init here would allocate on the default backend,
+        which may be a TPU the caller never intends to touch (the
+        round-2 dryrun crash)."""
+        host = self.engine.init_state_host()
         n_rows = self.n_shards * self.rows_per_shard
         state = {}
         for k, v in host.items():
@@ -268,7 +273,11 @@ class ShardedPatternEngine:
         from siddhi_tpu.ops.dense_nfa import _collision_rounds
 
         part = np.asarray(part)
-        rel = self.engine._rel_ts(np.asarray(ts, dtype=np.int64))
+        rel64 = self.engine.rel_ts64(np.asarray(ts, dtype=np.int64))
+        state, rel64 = self.engine.maybe_re_anchor(
+            state, rel64,
+            to_device=lambda k, v: self._put(v, self.state_specs[k]))
+        rel = rel64.astype(np.int32)
         n = len(part)
         emit_all = np.zeros(n, dtype=bool)
         out_all = np.zeros((n, max(len(self.engine.out_spec), 1)), dtype=np.float32)

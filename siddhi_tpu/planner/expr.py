@@ -155,6 +155,29 @@ def _null_safe_compare(a, b, op: str):
     return out
 
 
+def _null_safe_arith(a, b, op):
+    """Arithmetic where null (None in object lanes — e.g. unmatched
+    outer-join fills) propagates to a null result instead of raising
+    TypeError, matching the reference's arithmetic executors
+    (MultiplyExpressionExecutorDouble.java:43-45 returns null when an
+    operand is null).  Engages ONLY for numpy object-dtype operands —
+    jax tracers and typed arrays take the plain vectorized op."""
+    if getattr(a, "dtype", None) != object and getattr(b, "dtype", None) != object:
+        return op(a, b)
+    a_arr, b_arr = np.broadcast_arrays(
+        np.atleast_1d(np.asarray(a, dtype=object)),
+        np.atleast_1d(np.asarray(b, dtype=object)))
+    none_mask = (a_arr == None) | (b_arr == None)  # noqa: E711 — elementwise
+    if not none_mask.any():
+        return np.frompyfunc(op, 2, 1)(a_arr, b_arr)
+    out = np.empty(a_arr.shape, dtype=object)
+    out[none_mask] = None
+    ok = ~none_mask
+    if ok.any():
+        out[ok] = np.frompyfunc(op, 2, 1)(a_arr[ok], b_arr[ok])
+    return out
+
+
 def _java_int_div(a, b):
     q = a // b
     r = a - q * b
@@ -248,23 +271,18 @@ class ExpressionCompiler:
         is_int = out_t in (AttrType.INT, AttrType.LONG)
         op = e.op
         if op == "+":
-            fn = lambda env: l.fn(env) + r.fn(env)
+            raw = lambda a, b: a + b
         elif op == "-":
-            fn = lambda env: l.fn(env) - r.fn(env)
+            raw = lambda a, b: a - b
         elif op == "*":
-            fn = lambda env: l.fn(env) * r.fn(env)
+            raw = lambda a, b: a * b
         elif op == "/":
-            if is_int:
-                fn = lambda env: _java_int_div(l.fn(env), r.fn(env))
-            else:
-                fn = lambda env: l.fn(env) / r.fn(env)
+            raw = _java_int_div if is_int else (lambda a, b: a / b)
         elif op == "%":
-            if is_int:
-                fn = lambda env: _java_int_mod(l.fn(env), r.fn(env))
-            else:
-                fn = lambda env: l.fn(env) % r.fn(env)
+            raw = _java_int_mod if is_int else (lambda a, b: a % b)
         else:
             raise SiddhiAppCreationError(f"unknown arithmetic op {op!r}")
+        fn = lambda env: _null_safe_arith(l.fn(env), r.fn(env), raw)
         return CompiledExpression(fn, out_t)
 
     # ---- null / membership ------------------------------------------------

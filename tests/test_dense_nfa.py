@@ -274,3 +274,37 @@ class TestDenseNonEverySequence:
         m.shutdown()
         assert int(emit.sum()) == len(host) == 1
         assert out[emit][0].tolist() == pytest.approx(host[0].data)  # 20 .. 22
+
+
+class TestReAnchor:
+    def test_rel_ts_re_anchor_past_int32(self):
+        """Streams past ~24.8 days of relative time re-anchor the base
+        instead of wrapping int32; expired armed instances are cleared."""
+        app = (
+            "define stream Txn (card long, amount double); "
+            "@info(name='q') "
+            "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+            "within 10 min "
+            "select a.amount as base, b.amount as bv insert into Alerts;"
+        )
+        eng = compile_pattern(app, "q", n_partitions=4)
+        state = eng.init_state()
+
+        def send(state, amount, ts):
+            return eng.process(
+                state, "Txn", np.asarray([0]),
+                {"amount": np.asarray([amount]),
+                 "card": np.asarray([0.0])},
+                np.asarray([ts], dtype=np.int64))
+
+        state, emit, _ = send(state, 150.0, 1_000)      # arms a=150
+        assert not emit.any()
+        base0 = eng.base_ts
+        far = 1_000 + 3_000_000_000                      # ~34 days later
+        state, emit, _ = send(state, 200.0, far)         # old arm expired
+        assert eng.base_ts > base0
+        assert not emit.any()                            # 200 only re-arms a
+        state, emit, out = send(state, 250.0, far + 50)  # completes a->b
+        assert emit.sum() == 1
+        row = dict(zip(eng.output_names, out[emit][0]))
+        assert row["base"] == 200.0 and row["bv"] == 250.0

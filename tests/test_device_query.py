@@ -258,3 +258,58 @@ class TestEligibility:
         sends = series(80, seed=14)
         assert_rows_close(host_rows(app, sends),
                           device_rows(app, sends, APP_ATTRS))
+
+
+class TestAdvisorRegressions:
+    def test_having_select_alias(self):
+        """`sum(v) as s ... having s > X` resolves the alias on the
+        device path (round-2 advisor high finding)."""
+        app = (
+            "define stream S (k int, v double); "
+            "@info(name='q') from S select k as k, sum(v) as s "
+            "group by k having s > 100.0 insert into OutputStream;"
+        )
+        sends = [([1, 60.0], 10), ([1, 50.0], 20), ([2, 10.0], 30)]
+        host = host_rows(app, sends)
+        dev = device_rows(app, sends, ["k", "v"])
+        assert_rows_close(host, dev)
+
+    def test_tumbling_group_key_register_with_filtered_duplicate(self):
+        """A batch holding both a filtered and a passing row of the SAME
+        first-seen group must record the true key (round-2 advisor
+        medium: duplicate-index scatter could clobber grp_keys with the
+        stale 0 via the filtered lane)."""
+        app = (
+            "define stream S (k int, v double); "
+            "@info(name='q') from S[v > 0.0]#window.lengthBatch(2) "
+            "select k + 0.5 as kk, sum(v) as s "
+            "group by k insert into OutputStream;"
+        )
+        # filtered row of group 3 arrives FIRST in the same batch
+        sends = [([3, -1.0], 10), ([3, 1.0], 20), ([3, 2.0], 30)]
+        host = host_rows(app, sends)
+        dev = device_rows(app, sends, ["k", "v"])
+        assert_rows_close(host, dev)
+        assert dev and dev[0]["kk"] == 3.5
+
+    def test_rel_ts_re_anchor_past_int32(self):
+        """Streams running past ~24.8 days of relative time re-anchor
+        instead of silently wrapping int32 (round-2 advisor low)."""
+        app = (
+            "define stream S (k int, v double); "
+            "@info(name='q') from S#window.time(10 sec) "
+            "select sum(v) as s insert into OutputStream;"
+        )
+        eng = compile_query(app)
+        state = eng.init_state()
+        state, rows1 = eng.process(
+            state, {"k": np.asarray([1]), "v": np.asarray([1.0])},
+            np.asarray([1_000]))
+        base0 = eng.base_ts
+        far = 1_000 + 3_000_000_000  # ~34 days later, past int32 ms range
+        state, rows2 = eng.process(
+            state, {"k": np.asarray([1]), "v": np.asarray([2.0])},
+            np.asarray([far]))
+        assert eng.base_ts > base0  # re-anchored
+        assert [r["s"] for r in rows1] == [1.0]
+        assert [r["s"] for r in rows2] == [2.0]  # old event left the window

@@ -207,3 +207,51 @@ def test_left_outer_join_float_null_is_none(manager):
     rt.get_input_handler("A").send(["X", 1])
     assert got == [["X", None, None]]
     assert got[0][1] is None  # real None, not NaN
+
+
+def test_left_outer_join_null_arithmetic(manager):
+    """Arithmetic over a nullable outer-join column propagates null
+    instead of raising (reference:
+    MultiplyExpressionExecutorDouble.java:43-45 returns null on null
+    operand)."""
+    app = (
+        "define stream A (symbol string, qty int); "
+        "define stream B (symbol string, price double); "
+        "@info(name='q') "
+        "from A#window.length(5) as a "
+        "left outer join B#window.length(5) as b "
+        "on a.symbol == b.symbol "
+        "select a.symbol as symbol, b.price * 2.0 as doubled "
+        "insert into OutStream;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = collect_stream(rt, "OutStream")
+    rt.get_input_handler("A").send(["WSO2", 1])   # no match -> null price
+    rt.get_input_handler("B").send(["IBM", 10.0])
+    rt.get_input_handler("A").send(["IBM", 2])    # match -> 20.0
+    assert got == [["WSO2", None], ["IBM", 20.0]]
+
+
+def test_outer_join_null_comparison_filters_false(manager):
+    """Comparisons against a null outer-join column are false, not an
+    error (null-comparison semantics of the reference compare
+    executors)."""
+    app = (
+        "define stream A (symbol string, qty int); "
+        "define stream B (symbol string, price double); "
+        "@info(name='q') "
+        "from A#window.length(5) as a "
+        "left outer join B#window.length(5) as b "
+        "on a.symbol == b.symbol "
+        "select a.symbol as symbol, b.price as price "
+        "having price > 5.0 "
+        "insert into OutStream;"
+    )
+    rt = manager.create_siddhi_app_runtime(app)
+    rt.start()
+    got = collect_stream(rt, "OutStream")
+    rt.get_input_handler("A").send(["WSO2", 1])   # null price -> filtered
+    rt.get_input_handler("B").send(["IBM", 10.0])
+    rt.get_input_handler("A").send(["IBM", 2])
+    assert got == [["IBM", 10.0]]
