@@ -75,6 +75,12 @@ class SiddhiAppContext:
         self.playback_idle_ms = 0
         self.enforce_order = False
         self.root_metrics_level = "off"
+        # @app:execution('tpu' | 'host'): 'tpu' routes eligible queries
+        # through the jitted device paths with host fallback (the
+        # BASELINE.json north-star gate); tpu_partitions sizes the
+        # partition axis of dense pattern state
+        self.execution_mode = "host"
+        self.tpu_partitions = 65536
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -1,0 +1,397 @@
+"""Dense (jitted TPU) pattern execution inside the product engine.
+
+This is the glue the planner uses to route `SiddhiManager`-created
+pattern/sequence queries through the bit-parallel dense NFA
+(ops/dense_nfa.py) instead of the host instance engine (ops/nfa.py) —
+the analog of the reference planner wiring the pattern hot path into the
+runtime (util/parser/StateInputStreamParser.java:76-146,
+QueryParser.java:90), re-designed so the hot path is one jit-compiled
+step over partition-sharded state rows instead of a processor chain.
+
+Activation: ``@app:execution('tpu')`` (the north-star gating from
+BASELINE.json).  The planner attempts dense lowering for every
+pattern/sequence query and falls back to the host engine — logging the
+reason — when the query needs semantics outside the dense subset
+(absent states, optional min-0 nodes, >32 nodes, non-float captures/
+filters/selects, aggregating selectors, ...).  Known approximation of
+the dense subset (documented in ops/dense_nfa.py): at most one pending
+instance per (partition, node), so `every` arms that overlap BEFORE the
+first completes collapse to the newest — the instance axis planned for
+the dense engine lifts this.
+
+Partitioned form: ``partition with (key of S) begin <pattern query> end``
+lowers to ONE dense engine whose partition axis is the interned key —
+per-key NFA state rows in device memory, no per-key Python instances.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
+from siddhi_tpu.query_api import AttrType, StateInputStream, Variable
+
+log = logging.getLogger("siddhi_tpu")
+
+
+def build_dense_engine(query, st: StateInputStream, resolve_def,
+                       n_partitions: int):
+    """Lower one pattern/sequence query to a DensePatternEngine or raise
+    SiddhiAppCreationError with the reason it is not dense-eligible."""
+    from siddhi_tpu.ops.dense_nfa import DensePatternEngine
+    from siddhi_tpu.ops.nfa import NFABuilder
+
+    sel = query.selector
+    if sel.group_by or sel.having is not None:
+        raise SiddhiAppCreationError(
+            "dense path: group-by/having selectors run on the host engine")
+    if not sel.selection:
+        raise SiddhiAppCreationError(
+            "dense path: select * is not supported for patterns")
+
+    select_vars: List[Variable] = []
+    select_names: List[str] = []
+    for oa in sel.selection:
+        if not isinstance(oa.expression, Variable) or oa.expression.stream_id is None:
+            raise SiddhiAppCreationError(
+                "dense path: select items must be event references (e1.attr)")
+        select_vars.append(oa.expression)
+        select_names.append(oa.name)
+
+    builder = NFABuilder(st, resolve_def)
+    nodes = builder.build()
+    for node in nodes:
+        for spec in node.specs:
+            if spec.filter_presence_keys:
+                raise SiddhiAppCreationError(
+                    "dense path: 'is null' event-presence checks need the "
+                    "host engine")
+
+    every_start = any(n.rearm_to is not None for n in nodes)
+    eng = DensePatternEngine(
+        nodes=nodes,
+        ref_defs=builder.ref_defs,
+        stream_to_ref=builder.stream_to_ref,
+        within_ms=st.within_ms,
+        n_partitions=n_partitions,
+        select_vars=select_vars,
+        select_names=select_names,
+        every_start=every_start,
+        # `every`: a match consumes only the matched instance — siblings
+        # (incl. the re-armed start) keep running, as in the host engine;
+        # non-every stops the partition's automaton after its match
+        reset_on_emit=not every_start,
+        is_sequence=st.type == StateInputStream.SEQUENCE,
+    )
+
+    # every capture register and output must be float-typed: registers
+    # are a float32 bank, so INT/LONG captures (card numbers, ids) would
+    # silently round above 2^24 — those queries keep the exact host
+    # engine until the integer register bank lands.  String keys belong
+    # on the partition axis.
+    _FLOAT_OK = (AttrType.FLOAT, AttrType.DOUBLE)
+
+    def _check_lane(ref_def, attr, what):
+        if ref_def is None or attr not in ref_def.attribute_names:
+            raise SiddhiAppCreationError(
+                f"dense path: cannot type {what}")
+        t = ref_def.attribute_type(attr)
+        if t not in _FLOAT_OK:
+            raise SiddhiAppCreationError(
+                f"dense path: {what} has type {t.value}; float32 lanes "
+                "would lose integer precision — host engine used")
+
+    for (ref, attr, _last) in eng.alloc.slots:
+        _check_lane(builder.ref_defs.get(ref), attr, f"capture '{ref}.{attr}'")
+    for _name, src in eng.out_spec:
+        if isinstance(src, tuple):
+            ref_def = None
+            for spec in nodes[-1].specs:
+                if src[1] in spec.stream_def.attribute_names:
+                    ref_def = spec.stream_def
+            _check_lane(ref_def, src[1], f"select attribute '{src[1]}'")
+    # filter operands too: candidate columns are cast to float32 before
+    # the step, so an INT/LONG comparison (card == 16777217) would
+    # collide above 2^24 — captured-ref operands are already covered by
+    # the register check above
+    for node in nodes:
+        for spec in node.specs:
+            if spec.raw_filter is None:
+                continue
+            for var in _walk_variables(spec.raw_filter):
+                sid = var.stream_id
+                if sid is None:
+                    if var.attribute in spec.stream_def.attribute_names:
+                        _check_lane(spec.stream_def, var.attribute,
+                                    f"filter attribute '{var.attribute}'")
+                elif sid == spec.ref or sid == spec.stream_key.lstrip("#!"):
+                    if var.attribute in spec.stream_def.attribute_names:
+                        _check_lane(spec.stream_def, var.attribute,
+                                    f"filter attribute '{sid}.{var.attribute}'")
+
+    _trace_check(eng)
+    return eng
+
+
+def _walk_variables(expr):
+    """Yield every Variable node of an expression tree (read-only walk)."""
+    from siddhi_tpu.query_api import (
+        AndOp, ArithmeticOp, CompareOp, FunctionCall, InOp, IsNull, NotOp,
+        OrOp,
+    )
+
+    if isinstance(expr, Variable):
+        yield expr
+    elif isinstance(expr, (AndOp, OrOp, ArithmeticOp, CompareOp)):
+        yield from _walk_variables(expr.left)
+        yield from _walk_variables(expr.right)
+    elif isinstance(expr, NotOp):
+        yield from _walk_variables(expr.expr)
+    elif isinstance(expr, IsNull):
+        yield from _walk_variables(expr.expr)
+    elif isinstance(expr, InOp):
+        yield from _walk_variables(expr.expr)
+    elif isinstance(expr, FunctionCall):
+        for a in expr.args:
+            yield from _walk_variables(a)
+
+
+def output_attr_types(eng) -> List[AttrType]:
+    """Declared attribute type of each engine output lane (the engine
+    computes in float32; callbacks/definitions keep the source types)."""
+    out: List[AttrType] = []
+    for _name, src in eng.out_spec:
+        t = None
+        if isinstance(src, tuple):  # ('cand', attr): from the last node
+            for node in eng.nodes:
+                for spec in node.specs:
+                    if src[1] in spec.stream_def.attribute_names:
+                        t = spec.stream_def.attribute_type(src[1])
+        else:
+            d = eng.ref_defs.get(src.ref)
+            if d is not None and src.attr in d.attribute_names:
+                t = d.attribute_type(src.attr)
+        out.append(t or AttrType.DOUBLE)
+    return out
+
+
+def _numeric_attrs(eng, stream_key: str) -> List[str]:
+    for node in eng.nodes:
+        for spec in node.specs:
+            if spec.stream_key == stream_key:
+                return [
+                    a.name for a in spec.stream_def.attributes
+                    if a.type.is_numeric
+                ]
+    raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
+
+
+def _trace_check(eng):
+    """Abstractly trace every per-stream step with exactly the env the
+    runtime will provide (numeric columns only) so ineligible filters —
+    e.g. referencing a string attribute — fail at plan time, not on the
+    first event (mirrors DeviceQueryEngine._trace_check)."""
+    import jax
+
+    host = eng.init_state_host()
+    state_shapes = {
+        k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in host.items()
+    }
+    B = 16
+    i32 = jax.ShapeDtypeStruct((B,), np.int32)
+    b1 = jax.ShapeDtypeStruct((B,), bool)
+    try:
+        for sk in eng.stream_keys:
+            cols = {
+                a: jax.ShapeDtypeStruct((B,), np.float32)
+                for a in _numeric_attrs(eng, sk)
+            }
+            step = eng.make_step(sk, jit=False)
+            jax.eval_shape(step, state_shapes, i32, cols, i32, b1)
+    except SiddhiAppCreationError:
+        raise
+    except Exception as e:
+        raise SiddhiAppCreationError(
+            f"dense path: step not traceable ({e})") from e
+
+
+class DensePatternRuntime:
+    """Product-side wrapper of one DensePatternEngine: converts junction
+    batches to device columns, advances state with the jitted step, and
+    emits match batches into the query's selector/output chain.
+
+    ``key_fn(batch) -> list`` supplies partition keys (a partitioned
+    pattern); plain queries run as one partition (row 0).
+    """
+
+    def __init__(self, engine, out_stream_id: str,
+                 emit: Callable[[EventBatch], None],
+                 key_fn: Optional[Callable] = None):
+        self.engine = engine
+        self.out_stream_id = out_stream_id
+        self.emit_cb = emit
+        self.key_fn = key_fn
+        self.state = engine.init_state()
+        self.step_invocations = 0  # proof the jitted path ran (tests)
+        self._key_rows: Dict = {}
+        self._next_row = 0
+        self._free_rows: List[int] = []
+        # host-side per-row activity clock driving idle-key reclamation
+        # (@purge on dense partitions; the instance path purges whole
+        # PartitionInstances instead)
+        self._row_last_used = np.zeros(engine.n_partitions, dtype=np.int64)
+        # output dtypes: cast the engine's float32 lanes back to the
+        # declared attribute types for callbacks/sinks
+        self._out_dtypes: List[np.dtype] = [
+            t.np_dtype for t in output_attr_types(engine)
+        ]
+
+    # -- partition interning -------------------------------------------------
+
+    def intern_keys(self, keys) -> np.ndarray:
+        """Partition-key values -> dense engine row ids (stable until the
+        key is purged; shared by all source streams)."""
+        out = np.zeros(len(keys), dtype=np.int32)
+        rows = self._key_rows
+        cap = self.engine.n_partitions
+        for i, k in enumerate(keys):
+            row = rows.get(k)
+            if row is None:
+                if self._free_rows:
+                    row = self._free_rows.pop()
+                elif self._next_row < cap:
+                    row = self._next_row
+                    self._next_row += 1
+                else:
+                    raise SiddhiAppRuntimeError(
+                        f"dense pattern: partition-key cardinality exceeded "
+                        f"capacity {cap} (raise it via "
+                        f"@app:execution('tpu', partitions='N') or enable "
+                        "@purge on the partition)")
+                rows[k] = row
+            out[i] = row
+        return out
+
+    def purge_idle(self, now: int, idle_ms: int):
+        """Reclaim rows of keys idle for >= idle_ms: reset their device
+        state to the init row and recycle the row ids (the dense analog
+        of PartitionRuntime's idle-instance purge)."""
+        if not self._key_rows:
+            return
+        idle = [
+            (k, r) for k, r in self._key_rows.items()
+            if now - int(self._row_last_used[r]) >= idle_ms
+        ]
+        if not idle:
+            return
+        rows = np.asarray([r for _k, r in idle], dtype=np.int32)
+        init = self.engine.init_state_host()
+        jnp = self.engine.jnp
+        state = dict(self.state)
+        for key, arr in state.items():
+            # every init row is identical; row 0 is the template
+            state[key] = arr.at[rows].set(jnp.asarray(init[key][0]))
+        self.state = state
+        for k, r in idle:
+            del self._key_rows[k]
+            self._free_rows.append(r)
+
+    def _part_ids(self, batch: EventBatch) -> np.ndarray:
+        if self.key_fn is None:
+            return np.zeros(len(batch), dtype=np.int32)
+        return self.intern_keys(self.key_fn(batch))
+
+    # -- event path ----------------------------------------------------------
+
+    def process_stream_batch(self, stream_key: str, batch: EventBatch,
+                             part: Optional[np.ndarray] = None):
+        """Advance the NFA with a junction batch.  ``part`` overrides the
+        partition-row assignment (the partitioned receiver computes it
+        from the partition executor + intern_keys)."""
+        cur = batch.only(ev.CURRENT)
+        n = len(cur)
+        if n == 0:
+            return
+        eng = self.engine
+        cols = {}
+        for a in _numeric_attrs(eng, stream_key):
+            col = cur.columns.get(a)
+            if col is None:
+                continue
+            cols[a] = np.asarray(col, dtype=np.float32)
+        if part is None:
+            part = self._part_ids(cur)
+        ts = np.asarray(cur.timestamps, dtype=np.int64)
+        if len(ts):
+            np.maximum.at(self._row_last_used, part, ts)
+        self.state, emit, out = eng.process(self.state, stream_key, part, cols, ts)
+        self.step_invocations += 1
+        if not emit.any():
+            return
+        idx = np.flatnonzero(emit)
+        out_cols: Dict[str, np.ndarray] = {}
+        names = eng.output_names
+        for oi, name in enumerate(names):
+            out_cols[name] = out[idx, oi].astype(self._out_dtypes[oi])
+        mb = EventBatch(
+            self.out_stream_id, names, out_cols,
+            ts[idx], np.full(len(idx), ev.CURRENT, dtype=np.int8),
+        )
+        self.emit_cb(mb)
+
+    # -- snapshot contract ---------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        return {
+            "dense_state": {k: np.asarray(v) for k, v in self.state.items()},
+            "base_ts": self.engine.base_ts,
+            "key_rows": dict(self._key_rows),
+            "next_row": self._next_row,
+            "free_rows": list(self._free_rows),
+            "row_last_used": self._row_last_used.copy(),
+        }
+
+    def restore(self, state: Dict):
+        jnp = self.engine.jnp
+        self.state = {k: jnp.asarray(v) for k, v in state["dense_state"].items()}
+        self.engine.base_ts = state["base_ts"]
+        self._key_rows = dict(state["key_rows"])
+        self._next_row = state.get("next_row", len(self._key_rows))
+        self._free_rows = list(state.get("free_rows", []))
+        rlu = state.get("row_last_used")
+        if rlu is not None:
+            self._row_last_used = np.asarray(rlu).copy()
+
+    # -- scheduler-compatible no-ops (within expiry is event-driven on
+    # the dense path, like StreamPreStateProcessor's on-arrival pruning)
+
+    def on_time(self, now: int):
+        pass
+
+    def next_wakeup(self):
+        return None
+
+    def fire(self, now: int):
+        pass
+
+    def on_start(self, now: int):
+        pass
+
+
+class _DenseStreamReceiver:
+    """Junction subscriber feeding one source stream of a dense pattern."""
+
+    def __init__(self, runtime: DensePatternRuntime, stream_key: str):
+        self.runtime = runtime
+        self.stream_key = stream_key
+
+    def receive(self, batch: EventBatch):
+        self.runtime.process_stream_batch(self.stream_key, batch)

@@ -104,6 +104,11 @@ class _InstancePlanner:
     partitioned inputs and ``#inner`` streams overlay the app's global
     namespace; everything else delegates."""
 
+    # per-key clones must use the host pattern engine — the dense TPU
+    # form of a partitioned pattern is ONE engine with interned keys,
+    # wired by PartitionRuntime, not one engine per instance
+    in_partition_instance = True
+
     def __init__(self, app_planner, partitioned_defs: Dict[str, StreamDefinition], key):
         self._app = app_planner
         self.key = key
@@ -219,6 +224,68 @@ class PartitionInstance:
             j.stop()
 
 
+def _pattern_stream_ids(st) -> List[str]:
+    """Junction keys of every source stream in a pattern input (AST walk
+    — no planning side effects)."""
+    from siddhi_tpu.query_api import (
+        CountStateElement,
+        LogicalStateElement,
+        NextStateElement,
+        EveryStateElement,
+        StreamStateElement,
+    )
+
+    out: List[str] = []
+
+    def walk(el):
+        if isinstance(el, NextStateElement):
+            walk(el.element)
+            walk(el.next)
+        elif isinstance(el, EveryStateElement):
+            walk(el.element)
+        elif isinstance(el, CountStateElement):
+            walk(el.stream_state)
+        elif isinstance(el, LogicalStateElement):
+            walk(el.element1)
+            walk(el.element2)
+        elif isinstance(el, StreamStateElement):
+            s = el.stream
+            prefix = "#" if s.is_inner else ("!" if s.is_fault else "")
+            key = prefix + s.stream_id
+            if key not in out:
+                out.append(key)
+
+    walk(st.state)
+    return out
+
+
+class DensePartitionReceiver:
+    """Subscriber on a partitioned stream's global junction for the dense
+    TPU form: evaluates the partition executor once per batch, interns
+    keys to engine rows, and advances every dense pattern runtime that
+    reads this stream — no per-key instances, no per-key routing."""
+
+    def __init__(self, stream_id: str, executor, runtimes: List):
+        self.stream_id = stream_id
+        self.executor = executor
+        self.runtimes = runtimes
+
+    def receive(self, batch: EventBatch):
+        cur = batch.only(ev.CURRENT)
+        if len(cur) == 0:
+            return
+        keys = self.executor.keys(cur)
+        if any(k is None for k in keys):  # range partitions drop unmatched
+            keep = np.asarray([k is not None for k in keys])
+            cur = cur.mask(keep)
+            keys = [k for k in keys if k is not None]
+            if len(cur) == 0:
+                return
+        for rt in self.runtimes:
+            part = rt.intern_keys(keys)
+            rt.process_stream_batch(self.stream_id, cur, part=part)
+
+
 class PartitionStreamReceiver:
     """Subscriber on a partitioned stream's global junction: evaluates
     the partition executor once per batch, groups rows by key, and routes
@@ -284,17 +351,44 @@ class PartitionRuntime:
             else:
                 raise SiddhiAppCreationError(f"unknown partition type {pt!r}")
             self._executors[sid] = ex
-            app_planner.junctions[sid].subscribe(
-                PartitionStreamReceiver(self, sid, ex)
-            )
 
-        # plan an inert template instance eagerly: creates the global output
-        # junctions (so downstream queries/callbacks can bind at build time)
-        # and surfaces plan errors at app creation instead of first event
-        template = PartitionInstance(
-            "__template__", partition, app_planner, self.partitioned_defs
-        )
-        template.close()  # only its planning side effects are needed
+        # @app:execution('tpu'): a partition whose body is all
+        # dense-eligible pattern queries lowers to ONE engine per query
+        # with the partition key interned onto the engine's partition
+        # axis — per-key state rows in device memory instead of per-key
+        # Python instances (the 1M-key hot path, BASELINE.json configs)
+        self.dense_query_runtimes: Dict[str, object] = {}
+        self.is_dense = False
+        if app_planner.app_context.execution_mode == "tpu":
+            import logging
+
+            try:
+                self._plan_dense(partition, app_planner)
+                self.is_dense = True
+                logging.getLogger("siddhi_tpu").info(
+                    "%s: lowered to the dense TPU path (%d queries, "
+                    "%d key rows)", self.name,
+                    len(self.dense_query_runtimes),
+                    app_planner.app_context.tpu_partitions)
+            except SiddhiAppCreationError as e:
+                self.dense_query_runtimes = {}
+                logging.getLogger("siddhi_tpu").info(
+                    "%s: dense TPU path unavailable (%s); using per-key "
+                    "instances", self.name, e)
+
+        if not self.is_dense:
+            for sid, ex in self._executors.items():
+                app_planner.junctions[sid].subscribe(
+                    PartitionStreamReceiver(self, sid, ex)
+                )
+            # plan an inert template instance eagerly: creates the global
+            # output junctions (so downstream queries/callbacks can bind at
+            # build time) and surfaces plan errors at app creation instead
+            # of first event
+            template = PartitionInstance(
+                "__template__", partition, app_planner, self.partitioned_defs
+            )
+            template.close()  # only its planning side effects are needed
 
         # @purge(enable='true', interval='..', idle.period='..')
         self._purge_interval_ms: Optional[int] = None
@@ -307,6 +401,71 @@ class PartitionRuntime:
             self._purge_interval_ms = parse_time_string(purge.element("interval") or "1 min")
             self._purge_idle_ms = parse_time_string(purge.element("idle.period") or "15 min")
             app_planner.scheduler.register_task(self)
+
+    def _plan_dense(self, partition: Partition, app_planner):
+        """Lower every inner query to the dense engine or raise (caller
+        falls back to per-key instances wholesale — mixed mode would
+        split one partition's semantics across two engines)."""
+        from siddhi_tpu.planner.query_planner import QueryPlanner
+        from siddhi_tpu.query_api import (
+            InsertIntoStream,
+            Query,
+            ReturnStream,
+            StateInputStream,
+        )
+        from siddhi_tpu.query_api.annotation import find_annotation as _find
+
+        # cheap AST-level validation of EVERY query before planning any,
+        # so a late ineligibility doesn't leak side effects of earlier
+        # fully-planned queries
+        for q in partition.queries:
+            if not isinstance(q, Query):
+                raise SiddhiAppCreationError("nested element not a query")
+            st = q.input_stream
+            if not isinstance(st, StateInputStream):
+                raise SiddhiAppCreationError(
+                    "partition body has a non-pattern query")
+            out = q.output_stream
+            if isinstance(out, InsertIntoStream) and out.is_inner:
+                raise SiddhiAppCreationError(
+                    "'insert into #inner' needs per-key instances")
+            elif not isinstance(out, (InsertIntoStream, ReturnStream)) and out is not None:
+                raise SiddhiAppCreationError(
+                    "table/window outputs need per-key instances")
+            for sid in _pattern_stream_ids(st):
+                if sid not in self.partitioned_defs:
+                    raise SiddhiAppCreationError(
+                        f"pattern input '{sid}' is not a partitioned stream")
+
+        qp = QueryPlanner(app_planner)
+        planned = []  # (name, qr, DensePatternRuntime)
+        try:
+            for qi, q in enumerate(partition.queries):
+                info = _find(q.annotations, "info")
+                name = (info.element("name") if info else None) or f"{self.name}_q{qi}"
+                qr = qp._plan_dense_state(
+                    q, name, q.input_stream,
+                    n_partitions=app_planner.app_context.tpu_partitions,
+                    subscribe=False,
+                )
+                planned.append((name, qr, qr.pattern_processor))
+        except SiddhiAppCreationError:
+            # unwind scheduler tasks of already-planned siblings before
+            # the wholesale fallback to per-key instances
+            for _n, qr, _r in planned:
+                task = getattr(qr, "_rate_task", None)
+                if task is not None:
+                    app_planner.scheduler.unregister_task(task)
+            raise
+        # all queries lowered — wire key-routed receivers
+        for name, qr, runtime in planned:
+            self.dense_query_runtimes[name] = qr
+        for sid, ex in self._executors.items():
+            runtimes = [r for _n, _qr, r in planned if sid in r.engine.stream_keys]
+            if runtimes:
+                app_planner.junctions[sid].subscribe(
+                    DensePartitionReceiver(sid, ex, runtimes)
+                )
 
     def instance_for(self, key) -> PartitionInstance:
         inst = self.instances.get(key)
@@ -329,6 +488,12 @@ class PartitionRuntime:
     def fire(self, now: int):
         while self._next_purge is not None and self._next_purge <= now:
             self._next_purge += self._purge_interval_ms
+        if self.is_dense:
+            # reclaim idle key rows of the shared engines (the dense
+            # analog of dropping idle PartitionInstances)
+            for qr in self.dense_query_runtimes.values():
+                qr.pattern_processor.purge_idle(now, self._purge_idle_ms)
+            return
         dead = [
             k
             for k, inst in self.instances.items()
@@ -340,6 +505,13 @@ class PartitionRuntime:
     # -- snapshot contract --------------------------------------------------
 
     def snapshot(self) -> Dict:
+        if self.is_dense:
+            return {
+                "__dense__": {
+                    qname: qr.snapshot_state()
+                    for qname, qr in self.dense_query_runtimes.items()
+                }
+            }
         out: Dict = {}
         for k, inst in self.instances.items():
             qstates: Dict = {}
@@ -350,6 +522,13 @@ class PartitionRuntime:
         return out
 
     def restore(self, state: Dict):
+        if self.is_dense:
+            dense = state.get("__dense__", {})
+            for qname, qs in dense.items():
+                qr = self.dense_query_runtimes.get(qname)
+                if qr is not None:
+                    qr.restore_state(qs)
+            return
         for inst in self.instances.values():
             inst.close()
         self.instances.clear()

@@ -62,6 +62,24 @@ class AppPlanner:
             # the sync dispatch path is ordered by construction; the flag is
             # kept for API parity (reference: SiddhiAppParser.java:199-213)
             self.app_context.enforce_order = True
+        exec_ann = find_annotation(siddhi_app.annotations, "app:execution")
+        if exec_ann is not None:
+            mode = (exec_ann.element() or "host").lower()
+            if mode not in ("host", "tpu"):
+                raise SiddhiAppCreationError(
+                    f"@app:execution('{mode}'): mode must be 'host' or 'tpu'")
+            self.app_context.execution_mode = mode
+            parts = exec_ann.element("partitions")
+            if parts:
+                try:
+                    n = int(parts)
+                except ValueError:
+                    n = -1
+                if n < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: partitions='{parts}' must be a "
+                        "positive integer")
+                self.app_context.tpu_partitions = n
 
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 

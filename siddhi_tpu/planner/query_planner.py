@@ -329,6 +329,25 @@ class QueryPlanner:
             _collect_presence,
         )
 
+        # @app:execution('tpu'): attempt the jitted dense-NFA path first
+        # (reference analog: StateInputStreamParser wiring the pattern hot
+        # path, StateInputStreamParser.java:76-146); host fallback below
+        if (
+            self.app.app_context.execution_mode == "tpu"
+            and not getattr(self.app, "in_partition_instance", False)
+        ):
+            import logging
+
+            try:
+                qr = self._plan_dense_state(query, name, st)
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': pattern lowered to the dense TPU path", name)
+                return qr
+            except SiddhiAppCreationError as e:
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': dense TPU path unavailable (%s); "
+                    "using host pattern engine", name, e)
+
         builder = NFABuilder(st, self.app.resolve_stream_definition)
         nodes = builder.build()
 
@@ -381,6 +400,74 @@ class QueryPlanner:
                         f"stream '{spec.stream_key}' is not defined"
                     )
                 junction.subscribe(_PatternStreamReceiver(processor, spec.stream_key))
+        return qr
+
+    def _plan_dense_state(
+        self, query: Query, name: str, st, key_fn=None,
+        n_partitions: Optional[int] = None, subscribe: bool = True,
+    ) -> QueryRuntime:
+        """Plan a pattern query onto the dense jitted engine; raises
+        SiddhiAppCreationError when the query is outside the dense
+        subset (caller falls back to the host engine).
+
+        ``key_fn``/``n_partitions`` come from the partitioned form
+        (one engine, interned keys); ``subscribe=False`` lets the
+        partition runtime do its own key-routed wiring."""
+        from siddhi_tpu.core.dense_pattern import (
+            DensePatternRuntime,
+            _DenseStreamReceiver,
+            build_dense_engine,
+            output_attr_types,
+        )
+
+        if n_partitions is None:
+            n_partitions = 1 if key_fn is None else self.app.app_context.tpu_partitions
+        engine = build_dense_engine(
+            query, st, self.app.resolve_stream_definition, n_partitions)
+
+        sel = query.selector
+        out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
+        out_names = engine.output_names
+        out_attrs = [
+            Attribute(nm, t) for nm, t in zip(out_names, output_attr_types(engine))
+        ]
+        order_by = []
+        for ob in sel.order_by:
+            if ob.variable.attribute not in out_names:
+                raise SiddhiAppCreationError(
+                    f"order by attribute '{ob.variable.attribute}' not in select output"
+                )
+            order_by.append((ob.variable.attribute, ob.ascending))
+        const_compiler = ExpressionCompiler(Scope())
+        limit = self._const_int(sel.limit, const_compiler, "limit")
+        offset = self._const_int(sel.offset, const_compiler, "offset")
+        selector = QuerySelector(
+            out_target, None, out_names, [], [], None, order_by, limit, offset,
+        )
+        out_def = StreamDefinition(id=out_target, attributes=out_attrs)
+        output = self._plan_output(query, out_def)
+        rate_limiter = self._plan_rate_limiter(query)
+        qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
+
+        runtime = DensePatternRuntime(
+            engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
+            key_fn=key_fn,
+        )
+        qr.pattern_processor = runtime
+        if subscribe:
+            for sk in engine.stream_keys:
+                junction = self.app.junctions.get(sk)
+                if junction is None:
+                    raise DefinitionNotExistError(f"stream '{sk}' is not defined")
+                junction.subscribe(_DenseStreamReceiver(runtime, sk))
+        # registered LAST: nothing above may raise afterwards, so a
+        # fallback to the host path never leaks a live scheduler task;
+        # the task handle is kept so multi-query callers (partition
+        # lowering) can unregister if a LATER query fails eligibility
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+            task = _RateLimiterTask(qr, rate_limiter)
+            qr._rate_task = task
+            self.app.scheduler.register_task(task)
         return qr
 
     # -- single stream ------------------------------------------------------

@@ -1,0 +1,315 @@
+"""Dense (jitted) pattern path integrated in the product engine.
+
+`@app:execution('tpu')` routes eligible pattern queries created through
+the public SiddhiManager API onto the dense NFA (ops/dense_nfa.py) —
+asserted via the runtime's step-invocation counter — with host-engine
+fallback for queries outside the dense subset.  Reference analog: the
+planner wiring the pattern hot path
+(util/parser/StateInputStreamParser.java:76-146).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+TPU = "@app:execution('tpu') "
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run_app(manager, app, sends, out="Alerts", stream="Txn"):
+    rt = manager.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for row, ts in sends:
+        h.send(row, timestamp=ts)
+    rt.shutdown()
+    return rt, got
+
+
+PATTERN_APP = (
+    "define stream Txn (card long, amount double); "
+    "@info(name='q') "
+    "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+    "within 10 min "
+    "select a.amount as base, b.amount as bv insert into Alerts;"
+)
+
+SENDS = [
+    ([1, 150.0], 1000),
+    ([1, 90.0], 1500),    # matches neither filter
+    ([1, 200.0], 2000),   # completes a->b
+    ([1, 300.0], 3000),   # next every cycle: 200 armed? (host semantics)
+]
+
+
+class TestDensePath:
+    def test_dense_path_executes_jitted_step(self, manager):
+        rt, got = run_app(manager, TPU + PATTERN_APP, SENDS)
+        qr = rt.query_runtimes["q"]
+        assert isinstance(qr.pattern_processor, DensePatternRuntime)
+        assert qr.pattern_processor.step_invocations == len(SENDS)
+        assert got  # matches flowed through selector/output to callback
+
+    def test_dense_matches_host_output(self, manager):
+        # non-`every` pattern: dense and host semantics coincide exactly
+        # (overlapping-`every` instances are the multi-instance work —
+        # see test_dense_nfa for the dense-subset contract)
+        app = PATTERN_APP.replace("from every a=", "from a=")
+        _rt, dense = run_app(manager, TPU + app, SENDS)
+        m2 = SiddhiManager()
+        _rt2, host = run_app(m2, app, SENDS)
+        m2.shutdown()
+        assert dense == host == [[150.0, 200.0]]
+
+    def test_dense_every_rearm_matches_host(self, manager):
+        # `every`: a match must consume only the matched instance — the
+        # completing event re-arms the start in the SAME step, so the
+        # next event completes again (reset-on-emit would lose it)
+        _rt, dense = run_app(manager, TPU + PATTERN_APP, SENDS)
+        m2 = SiddhiManager()
+        _rt2, host = run_app(m2, PATTERN_APP, SENDS)
+        m2.shutdown()
+        assert dense == host == [[150.0, 200.0], [200.0, 300.0]]
+
+    def test_fallback_on_long_filter_operand(self, manager):
+        # filters comparing LONG attributes would collide above 2^24 in
+        # float32 columns — host engine keeps exact semantics
+        app = TPU + (
+            "define stream Txn (card long, amount double); "
+            "@info(name='q') "
+            "from a=Txn[card == 16777217] -> b=Txn[amount > a.amount] "
+            "select a.amount as base, b.amount as bv insert into Alerts;"
+        )
+        rt, got = run_app(manager, app, [
+            ([16777216, 150.0], 1000),   # NOT the filtered card value
+            ([16777217, 140.0], 1500),
+            ([16777217, 200.0], 2000),
+        ])
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+        assert got == [[140.0, 200.0]]  # exact host comparison
+
+    def test_host_mode_untouched(self, manager):
+        rt, _ = run_app(manager, PATTERN_APP, SENDS)
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+
+    def test_fallback_on_absent_pattern(self, manager):
+        app = TPU + (
+            "define stream A (v double); define stream B (v double); "
+            "@info(name='q') from A -> not B for 1 sec "
+            "select a.v as av insert into Alerts;"
+        ).replace("from A ->", "from a=A ->")
+        rt = manager.create_siddhi_app_runtime(app)
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+
+    def test_fallback_on_string_capture(self, manager):
+        app = TPU + (
+            "define stream Txn (card string, amount double); "
+            "@info(name='q') "
+            "from every a=Txn[amount > 100.0] -> b=Txn[card == a.card] "
+            "select a.amount as base insert into Alerts;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+
+    def test_fallback_on_aggregating_selector(self, manager):
+        app = TPU + (
+            "define stream Txn (card long, amount double); "
+            "@info(name='q') "
+            "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+            "select a.amount as base, b.amount as bv "
+            "group by base insert into Alerts;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+
+    def test_dense_persist_restore(self, manager):
+        rt = manager.create_siddhi_app_runtime(TPU + PATTERN_APP)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        h.send([1, 150.0], timestamp=1000)      # arms a=150
+        snap = rt.snapshot()
+        h.send([1, 200.0], timestamp=2000)      # completes
+        assert got == [[150.0, 200.0]]
+        rt.restore(snap)                         # back to armed-only
+        h.send([1, 180.0], timestamp=3000)
+        assert got == [[150.0, 200.0], [150.0, 180.0]]
+        rt.shutdown()
+
+
+PARTITIONED_APP = (
+    "@app:execution('tpu', partitions='64') "
+    "define stream Txn (card string, amount double); "
+    "partition with (card of Txn) begin "
+    "@info(name='q') "
+    "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+    "within 10 min "
+    "select a.amount as base, b.amount as bv insert into Alerts; "
+    "end;"
+)
+
+
+class TestDensePartition:
+    def test_partition_lowered_to_one_engine(self, manager):
+        rt, got = run_app(manager, PARTITIONED_APP, [
+            (["c1", 150.0], 1000),
+            (["c2", 500.0], 1100),
+            (["c1", 200.0], 2000),   # completes c1
+            (["c2", 400.0], 2100),   # not > 500
+            (["c2", 600.0], 2200),   # completes c2
+        ])
+        pr = rt.partitions["partition_0"]
+        assert pr.is_dense
+        assert got == [[150.0, 200.0], [500.0, 600.0]]
+        runtime = next(iter(pr.dense_query_runtimes.values())).pattern_processor
+        assert runtime.step_invocations == 5
+        assert len(runtime._key_rows) == 2
+
+    def test_partition_matches_host_instances(self, manager):
+        # per-key isolation with non-`every` patterns: each key matches
+        # once independently, identical to per-key host instances
+        sends = [
+            (["c1", 150.0], 1000),
+            (["c2", 500.0], 1100),
+            (["c1", 90.0], 1500),    # c1: matches neither filter
+            (["c1", 200.0], 2000),   # completes c1
+            (["c2", 600.0], 2200),   # completes c2
+            (["c3", 90.0], 2300),    # never arms
+        ]
+        app = PARTITIONED_APP.replace("from every a=", "from a=")
+        _rt, dense = run_app(manager, app, sends)
+        m2 = SiddhiManager()
+        host_app = app.replace("@app:execution('tpu', partitions='64') ", "")
+        _rt2, host = run_app(m2, host_app, sends)
+        m2.shutdown()
+        assert sorted(map(tuple, dense)) == sorted(map(tuple, host))
+        assert len(dense) == 2
+
+    def test_partition_key_capacity_enforced(self, manager):
+        app = PARTITIONED_APP.replace("partitions='64'", "partitions='2'")
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        h.send(["c1", 150.0], timestamp=1000)
+        h.send(["c2", 150.0], timestamp=1001)
+        errors = []
+        rt.app_context.exception_listeners.append(
+            lambda e: errors.append(e))
+        h.send(["c3", 150.0], timestamp=1002)  # third key exceeds cap 2
+        rt.shutdown()
+        assert errors  # routed to the app's exception listeners
+
+    def test_partition_fallback_on_non_pattern_body(self, manager):
+        app = (
+            "@app:execution('tpu') "
+            "define stream S (k string, v double); "
+            "partition with (k of S) begin "
+            "@info(name='q') from S select k, sum(v) as total "
+            "insert into Out; end;"
+        )
+        rt, got = run_app(manager, app, [
+            (["a", 1.0], 10), (["a", 2.0], 20), (["b", 5.0], 30),
+        ], out="Out", stream="S")
+        pr = rt.partitions["partition_0"]
+        assert not pr.is_dense  # per-key instances still work under tpu mode
+        assert got == [["a", 1.0], ["a", 3.0], ["b", 5.0]]
+
+    def test_partition_dense_persist_restore(self, manager):
+        rt = manager.create_siddhi_app_runtime(PARTITIONED_APP)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        h.send(["c1", 150.0], timestamp=1000)
+        snap = rt.snapshot()
+        h.send(["c1", 200.0], timestamp=2000)
+        assert got == [[150.0, 200.0]]
+        rt.restore(snap)
+        h.send(["c1", 180.0], timestamp=3000)
+        assert got == [[150.0, 200.0], [150.0, 180.0]]
+        rt.shutdown()
+
+
+class TestReviewRegressions:
+    def test_fallback_on_long_capture(self, manager):
+        """INT/LONG captures fall back to the exact host engine (float32
+        register lanes would round card numbers above 2^24)."""
+        app = TPU + (
+            "define stream Txn (card long, amount double); "
+            "@info(name='q') "
+            "from a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+            "select a.card as card, b.amount as bv insert into Alerts;"
+        )
+        rt, got = run_app(manager, app, [
+            ([4111111111111111, 150.0], 1000),
+            ([4111111111111111, 200.0], 2000),
+        ])
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+        assert got == [[4111111111111111, 200.0]]  # exact on host path
+
+    def test_partitions_element_validated(self, manager):
+        import pytest as _pytest
+
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        for bad in ("0", "-5", "abc"):
+            with _pytest.raises(SiddhiAppCreationError):
+                manager.create_siddhi_app_runtime(
+                    f"@app:execution('tpu', partitions='{bad}') "
+                    "define stream S (v double); "
+                    "@info(name='q') from a=S -> b=S "
+                    "select a.v as av insert into Out;")
+
+    def test_purge_reclaims_idle_key_rows(self, manager):
+        """@purge on a dense partition recycles idle key rows, so key
+        churn beyond capacity keeps working (host analog: idle
+        PartitionInstance purge)."""
+        app = (
+            "@app:playback "
+            "@app:execution('tpu', partitions='4') "
+            "define stream Txn (card string, amount double); "
+            "@purge(enable='true', interval='1 sec', idle.period='2 sec') "
+            "partition with (card of Txn) begin "
+            "@info(name='q') "
+            "from a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+            "select a.amount as base, b.amount as bv insert into Alerts; "
+            "end;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        # 4 distinct keys fill capacity
+        for i, k in enumerate(["a", "b", "c", "d"]):
+            h.send([k, 150.0], timestamp=1000 + i)
+        pr = rt.partitions["partition_0"]
+        runtime = next(iter(pr.dense_query_runtimes.values())).pattern_processor
+        assert len(runtime._key_rows) == 4
+        # playback time advances far past idle.period; purge fires on the
+        # watermark advance
+        h.send(["a", 90.0], timestamp=20_000)  # keeps 'a' alive, no arm
+        rt.scheduler.advance(20_001)
+        assert len(runtime._key_rows) < 4
+        # a fresh key now fits again and completes a match
+        h.send(["e", 150.0], timestamp=21_000)
+        h.send(["e", 250.0], timestamp=21_500)
+        assert [150.0, 250.0] in got
+        rt.shutdown()
