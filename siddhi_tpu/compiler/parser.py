@@ -1129,7 +1129,13 @@ class Parser:
             return TimeConstant(self._parse_time_value())
         self.next()
         if t.kind == T.INT:
-            return Constant(int(t.value), AttrType.INT)
+            v = int(t.value)
+            # un-suffixed literals beyond int32 widen to LONG (Java would
+            # reject them outright; widening keeps 64-bit ids writable
+            # without the 'L' suffix)
+            if -(2**31) <= v < 2**31:
+                return Constant(v, AttrType.INT)
+            return Constant(v, AttrType.LONG)
         if t.kind == T.LONG:
             return Constant(int(t.value), AttrType.LONG)
         if t.kind == T.FLOAT:

@@ -1,0 +1,129 @@
+"""Device (jitted TPU) execution of general single-stream queries inside
+the product engine.
+
+The glue the planner uses to route `SiddhiManager`-created
+filter/window/group-by queries through the jitted device pipeline
+(ops/device_query.py) instead of the host columnar chain — the analog of
+the reference planner wiring ProcessStreamReceiver -> FilterProcessor ->
+WindowProcessor -> QuerySelector
+(util/parser/QueryParser.java:90, query/input/ProcessStreamReceiver.java:99-179,
+query/selector/QuerySelector.java:76-99), re-designed so the hot path is
+one jit-compiled step over columnar micro-batches with per-group state
+rows in device memory.
+
+Activation: ``@app:execution('tpu')``.  The planner attempts device
+lowering for every eligible single-stream query and falls back to the
+host engine — logging the reason — when the query is outside the device
+subset (unsupported windows/aggregators, non-traceable expressions,
+LONG-typed device operands, order-by/limit, non-CURRENT output event
+types, ...).  See ops/device_query.py's module docstring for the full
+subset contract, including the float32 precision stance.
+
+Emission subset: the device path emits CURRENT events only (the default
+``insert into``/callback contract).  Queries whose output event type is
+'expired' or 'all' — i.e. consumers of window-expiry events — keep the
+host engine, as do queries reading named windows (whose CURRENT+EXPIRED
+feed drives add/remove aggregation).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.event import EventBatch
+
+import logging
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class DeviceQueryRuntime:
+    """Product-side wrapper of one DeviceQueryEngine: converts junction
+    batches to device columns, advances per-group state with the jitted
+    step, and emits output batches into the query's output chain.
+
+    Also a scheduler task: ``next_wakeup``/``fire`` drive timer-based
+    timeBatch pane flushes so tumbling panes close on watermark time
+    even when no further events arrive (the host TimeBatchWindow's
+    scheduler contract)."""
+
+    def __init__(self, engine, out_stream_id: str,
+                 emit: Callable[[EventBatch], None]):
+        self.engine = engine
+        self.out_stream_id = out_stream_id
+        self.emit_cb = emit
+        self.state = engine.init_state()
+        self.step_invocations = 0  # proof the jitted path ran (tests)
+
+    # -- event path ----------------------------------------------------------
+
+    def process_stream_batch(self, batch: EventBatch):
+        """Advance the device pipeline with a junction batch.  Only
+        CURRENT rows drive it (control events — TIMER/RESET — have no
+        device meaning; RESET cannot reach a device query because batch
+        windows, their only producer, are ineligible upstream)."""
+        cur = batch.only(ev.CURRENT)
+        n = len(cur)
+        if n == 0:
+            return
+        eng = self.engine
+        cols = {
+            a: np.asarray(cur.columns[a])
+            for a in eng.all_attrs if a in cur.columns
+        }
+        ts = np.asarray(cur.timestamps, dtype=np.int64)
+        self.state, out_cols, out_ts = eng.process_batch(self.state, cols, ts)
+        self.step_invocations += 1
+        self._emit(out_cols, out_ts)
+
+    def _emit(self, out_cols: Dict[str, np.ndarray], out_ts: np.ndarray):
+        if len(out_ts) == 0:
+            return
+        mb = EventBatch(
+            self.out_stream_id, self.engine.output_names, out_cols,
+            out_ts, np.full(len(out_ts), ev.CURRENT, dtype=np.int8),
+        )
+        self.emit_cb(mb)
+
+    # -- scheduler task (timeBatch pane flushes) -----------------------------
+
+    def next_wakeup(self) -> Optional[int]:
+        return self.engine.pane_wakeup()
+
+    def fire(self, now: int):
+        self.state, out_cols, out_ts = self.engine.flush_due(self.state, now)
+        self._emit(out_cols, out_ts)
+
+    def on_start(self, now: int):
+        pass
+
+    def on_time(self, now: int):
+        pass
+
+    # -- snapshot contract ---------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        return {
+            "device_state": {k: np.asarray(v) for k, v in self.state.items()},
+            "host": self.engine.host_snapshot(),
+        }
+
+    def restore(self, state: Dict):
+        jnp = self.engine.jnp
+        self.state = {
+            k: jnp.asarray(v) for k, v in state["device_state"].items()
+        }
+        self.engine.host_restore(state["host"])
+
+
+class _DeviceQueryReceiver:
+    """Junction subscriber feeding one device-lowered query."""
+
+    def __init__(self, runtime: DeviceQueryRuntime):
+        self.runtime = runtime
+
+    def receive(self, batch: EventBatch):
+        self.runtime.process_stream_batch(batch)

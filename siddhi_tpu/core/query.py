@@ -634,6 +634,9 @@ class QueryRuntime:
         pp = getattr(self, "pattern_processor", None)
         if pp is not None:
             state["pattern"] = pp.snapshot()
+        dr = getattr(self, "device_runtime", None)
+        if dr is not None:
+            state["device"] = dr.snapshot()
         return state
 
     def restore_state(self, state: Dict):
@@ -652,6 +655,9 @@ class QueryRuntime:
         pp = getattr(self, "pattern_processor", None)
         if pp is not None and "pattern" in state:
             pp.restore(state["pattern"])
+        dr = getattr(self, "device_runtime", None)
+        if dr is not None and "device" in state:
+            dr.restore(state["device"])
 
     def on_time(self, now: int, payloads: Optional[EventBatch] = None):
         """Scheduler tick: run time-window evictions through the tail of

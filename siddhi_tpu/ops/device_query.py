@@ -39,6 +39,20 @@ contract):
    (the host engine's last-row-per-group attrs need per-attr registers);
  - time windows hold at most ``window_capacity`` passing events (the
    reference buffer is unbounded; overflow drops the oldest).
+
+Numeric lanes (TPU-first dtype policy):
+ - INT attributes ride int32 lanes — bit-exact;
+ - FLOAT/DOUBLE attributes ride float32 lanes, and aggregation state
+   accumulates in float32 (the MXU-native dtype) — a documented
+   precision subset of the host engine's float64 numpy;
+ - LONG attributes referenced by device-evaluated expressions (filters,
+   aggregate arguments, computed select items, having) make the query
+   ineligible until the int64 lane lands — float32 would silently round
+   above 2^24.  LONG *is* fine as a group-by key or a bare select item:
+   both are materialized host-side at native width (group keys are
+   interned host-side; bare ``select attr`` items gather from the input
+   batch, never touching a device lane).
+ - emitted columns are cast back to the declared attribute types.
 """
 
 from __future__ import annotations
@@ -235,9 +249,16 @@ class DeviceQueryEngine:
         )
 
         # -- scope / expression compilation ----------------------------------
-        self.attrs = [
-            a.name for a in stream_def.attributes if a.type.is_numeric
-        ]
+        # device lanes: INT rides int32 (bit-exact), FLOAT/DOUBLE ride
+        # float32.  LONG gets NO lane — it is host-only (group keys /
+        # bare select items); _check_value_types rejects device-expr use
+        self._lane_dtype: Dict[str, np.dtype] = {
+            a.name: (np.dtype(np.int32) if a.type == AttrType.INT
+                     else np.dtype(np.float32))
+            for a in stream_def.attributes
+            if a.type.is_numeric and a.type != AttrType.LONG
+        }
+        self.attrs = list(self._lane_dtype)
         self.all_attrs = list(stream_def.attribute_names)
         scope = Scope()
         for a in stream_def.attributes:
@@ -280,7 +301,10 @@ class DeviceQueryEngine:
             raise SiddhiAppCreationError(
                 "device query path needs an explicit select list")
         # out_spec entries: ("expr", compiled) | ("group_key", key_index)
+        # | ("passthrough", attr_name) — passthroughs gather the input
+        # column host-side at native width (any type, incl. LONG/STRING)
         self.out_spec: List[Tuple[str, object, str]] = []
+        self._device_expr_raw: List[Expression] = []
         # select alias -> rewritten expression AST, so `having s > 100`
         # referencing `sum(v) as s` resolves (the host path registers
         # output attrs in scope, planner/query_planner.py:530-535; here
@@ -292,11 +316,27 @@ class DeviceQueryEngine:
                 self.out_spec.append(("group_key", gk, oa.name))
                 alias_map[oa.name] = oa.expression
                 continue
+            pt = self._as_passthrough(oa.expression, stream_def, s)
+            if pt is not None:
+                self.out_spec.append(("passthrough", pt, oa.name))
+                alias_map[oa.name] = oa.expression
+                continue
             rewritten = rewriter.rewrite(oa.expression)
             compiled = compiler.compile(rewritten)
             self.out_spec.append(("expr", compiled, oa.name))
+            self._device_expr_raw.append(oa.expression)
             alias_map[oa.name] = rewritten
         self.aggs = rewriter.aggs
+        # declared output type per lane (emitted columns are cast back)
+        self.out_types: List[AttrType] = []
+        for kind, v, _name in self.out_spec:
+            if kind == "group_key":
+                self.out_types.append(self.group_exprs[v].type)
+            elif kind == "passthrough":
+                self.out_types.append(stream_def.attribute_type(v))
+            else:
+                self.out_types.append(v.type)
+        self._check_value_types(stream_def, s, sel)
         self.having = (
             compiler.compile(rewriter.rewrite(
                 _subst_aliases(sel.having, alias_map)))
@@ -307,6 +347,10 @@ class DeviceQueryEngine:
                 "device query path does not support order by/limit yet")
         if self.mode == PER_FLUSH:
             for kind, _v, name in self.out_spec:
+                if kind == "passthrough":
+                    raise SiddhiAppCreationError(
+                        f"tumbling device query: select item '{name}' may "
+                        "reference only group keys and aggregates")
                 if kind == "expr" and not self._flush_expr_ok(_v):
                     raise SiddhiAppCreationError(
                         f"tumbling device query: select item '{name}' may "
@@ -353,6 +397,49 @@ class DeviceQueryEngine:
                 return i
         return None
 
+    @staticmethod
+    def _as_passthrough(expr: Expression, stream_def, s) -> Optional[str]:
+        """Select item that is a bare input-attribute reference -> the
+        attribute name (materialized host-side at native width)."""
+        if not isinstance(expr, Variable):
+            return None
+        if expr.stream_id not in (None, s.stream_id, s.alias):
+            return None
+        if expr.attribute not in stream_def.attribute_names:
+            return None
+        return expr.attribute
+
+    def _check_value_types(self, stream_def, s, sel):
+        """Reject device-evaluated expressions (filters, computed select
+        items incl. aggregate arguments, having) that read a LONG
+        attribute: it has no device lane — float32 would silently round
+        above 2^24 (the reference is per-type exact,
+        executor/math/ & condition/compare/).  Group-by keys and bare
+        select items stay host-side and may be any type."""
+        names = set(stream_def.attribute_names)
+        ids = (None, s.stream_id, s.alias)
+
+        def walk(e):
+            if isinstance(e, Variable):
+                if e.stream_id in ids and e.attribute in names:
+                    t = stream_def.attribute_type(e.attribute)
+                    if t == AttrType.LONG:
+                        raise SiddhiAppCreationError(
+                            f"device query path: attribute '{e.attribute}' "
+                            "is LONG and has no 64-bit device lane yet; "
+                            "float32 would lose precision above 2^24 — "
+                            "host engine used (LONG is fine as a group-by "
+                            "key or bare select item)")
+                return e
+            return _map_children(e, walk)
+
+        for f in self.filter_exprs:
+            walk(f)
+        for e in self._device_expr_raw:
+            walk(e)
+        if sel.having is not None:
+            walk(sel.having)
+
     def _flush_expr_ok(self, compiled) -> bool:
         """Flush-time exprs can only read aggregate keys / numeric group
         keys (probed by tracing with exactly that env)."""
@@ -365,12 +452,14 @@ class DeviceQueryEngine:
     def _env_shapes(self, B: int = 8):
         import jax
 
-        f32 = jax.ShapeDtypeStruct((B,), np.float32)
-        env = {a: f32 for a in self.attrs}
+        env = {
+            a: jax.ShapeDtypeStruct((B,), self._lane_dtype[a])
+            for a in self.attrs
+        }
         env[TS_KEY] = jax.ShapeDtypeStruct((B,), np.int32)
         env[N_KEY] = B
         for a in self.aggs:
-            env[a.env_key] = f32
+            env[a.env_key] = jax.ShapeDtypeStruct((B,), np.float32)
         return env
 
     def _flush_env_shapes(self, G: int = 8):
@@ -489,8 +578,8 @@ class DeviceQueryEngine:
         n_out = max(len(self.out_spec), 1)
         out = jnp.zeros((B, n_out), dtype=jnp.float32)
         for oi, (kind, v, _name) in enumerate(self.out_spec):
-            if kind == "group_key":
-                continue  # materialized host-side from interned ids
+            if kind in ("group_key", "passthrough"):
+                continue  # materialized host-side
             col = jnp.asarray(v.fn(env_out)).astype(jnp.float32)
             out = out.at[:, oi].set(jnp.broadcast_to(col, (B,)))
         if self.having is not None:
@@ -800,8 +889,10 @@ class DeviceQueryEngine:
         valid[:n] = True
         c = {}
         for k in self.attrs:
-            col = np.zeros(B, dtype=np.float32)
-            col[:n] = np.asarray(cols[k], dtype=np.float32)[:n] if k in cols else 0
+            lane = self._lane_dtype[k]
+            col = np.zeros(B, dtype=lane)
+            if k in cols:
+                col[:n] = np.asarray(cols[k])[:n].astype(lane)
             c[k] = jnp.asarray(col)
         t = np.zeros(B, dtype=np.int32)
         t[:n] = rel[:n]
@@ -809,31 +900,60 @@ class DeviceQueryEngine:
         g[:n] = grp[:n]
         return c, jnp.asarray(t), jnp.asarray(g), jnp.asarray(valid), B
 
-    def _materialize(self, out_valid, out_vals, grp, n) -> List[Dict]:
-        """Device outputs -> list of {name: value} rows (host types)."""
-        ov = np.asarray(out_valid)[:n]
-        vals = np.asarray(out_vals)[:n]
-        rows = []
-        for i in np.flatnonzero(ov):
-            row = {}
-            for oi, (kind, v, name) in enumerate(self.out_spec):
-                if kind == "group_key":
-                    k = self._group_vals[int(grp[i])]
-                    row[name] = k[v] if isinstance(k, tuple) else k
-                else:
-                    row[name] = float(vals[i, oi])
-            rows.append(row)
-        return rows
+    def _out_columns(self, vals, sel, gids, in_cols, in_sel) -> Dict[str, np.ndarray]:
+        """Assemble output columns (declared dtypes) for the selected
+        rows.  ``vals``: [*, n_out] float32 device matrix; ``sel``: row
+        indices into it; ``gids``: group id per output row;
+        ``in_cols``/``in_sel``: input batch columns + row indices for
+        passthrough items (None for flush outputs, which cannot have
+        passthroughs)."""
+        cols: Dict[str, np.ndarray] = {}
+        for oi, (kind, v, name) in enumerate(self.out_spec):
+            t = self.out_types[oi]
+            if kind == "group_key":
+                comp = [self._group_vals[int(g)] for g in gids]
+                comp = [k[v] if isinstance(k, tuple) else k for k in comp]
+                cols[name] = (
+                    np.asarray(comp, dtype=t.np_dtype) if comp
+                    else np.empty(0, dtype=t.np_dtype))
+            elif kind == "passthrough":
+                cols[name] = np.asarray(in_cols[v])[in_sel].astype(
+                    t.np_dtype, copy=False)
+            else:
+                cols[name] = vals[sel, oi].astype(t.np_dtype)
+        return cols
 
-    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
-        """Host entry point.  Returns ``(state, rows)`` where rows are
-        emitted output dicts in emission order."""
+    def _empty_cols(self) -> Dict[str, np.ndarray]:
+        return {
+            name: np.empty(0, dtype=self.out_types[oi].np_dtype)
+            for oi, (_k, _v, name) in enumerate(self.out_spec)
+        }
+
+    def _concat_chunks(self, chunks) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """chunks: [(cols, ts_scalar, n_rows)] -> (cols, ts)."""
+        chunks = [c for c in chunks if c[2]]
+        if not chunks:
+            return self._empty_cols(), np.empty(0, dtype=np.int64)
+        names = self.output_names
+        out_cols = {
+            nm: np.concatenate([c[0][nm] for c in chunks]) for nm in names
+        }
+        out_ts = np.concatenate(
+            [np.full(c[2], c[1], dtype=np.int64) for c in chunks])
+        return out_cols, out_ts
+
+    def process_batch(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Columnar host entry point: ``(state, out_cols, out_ts)`` with
+        output columns cast back to the declared attribute types (the
+        product runtime builds an EventBatch straight from these)."""
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
+        if n == 0:
+            return state, self._empty_cols(), np.empty(0, dtype=np.int64)
         if self.base_ts is None:
-            self.base_ts = int(ts[0]) - 1 if n else 0
+            self.base_ts = int(ts[0]) - 1
         rel64 = ts - self.base_ts
-        if n and int(rel64.max()) >= self._REL_LIMIT:
+        if int(rel64.max()) >= self._REL_LIMIT:
             state, rel64 = self._re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
         grp = self._intern_groups(cols, ts, n)
@@ -841,8 +961,24 @@ class DeviceQueryEngine:
             step = self.make_step()
             c, t, g, valid, B = self._pad(cols, rel, grp, n)
             state, ov, out = step(state, c, t, g, valid)
-            return state, self._materialize(ov, out, grp, n)
-        return self._process_tumbling(state, cols, rel, grp, n)
+            idx = np.flatnonzero(np.asarray(ov)[:n])
+            out_cols = self._out_columns(
+                np.asarray(out)[:n], idx, grp[idx], cols, idx)
+            return state, out_cols, ts[idx]
+        state, out_cols, out_ts = self._process_tumbling(
+            state, cols, rel, grp, n)
+        return state, out_cols, out_ts
+
+    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Host entry point.  Returns ``(state, rows)`` where rows are
+        emitted output dicts in emission order."""
+        state, out_cols, out_ts = self.process_batch(state, cols, ts)
+        names = self.output_names
+        rows = [
+            {nm: out_cols[nm][i] for nm in names}
+            for i in range(len(out_ts))
+        ]
+        return state, rows
 
     # -- tumbling host logic -------------------------------------------------
 
@@ -856,22 +992,47 @@ class DeviceQueryEngine:
                 out[r, ki] = np.float32(v)
         return out
 
-    def _flush(self, state) -> Tuple[object, List[Dict]]:
+    def _flush_cols(self, state) -> Tuple[object, Dict[str, np.ndarray], int]:
         flush = self.make_flush_step()
         state, ov, out = flush(state)
-        ovn = np.asarray(ov)
-        vals = np.asarray(out)
-        rows = []
-        for gi in np.flatnonzero(ovn):
-            row = {}
-            for oi, (kind, v, name) in enumerate(self.out_spec):
-                if kind == "group_key":
-                    k = self._group_vals[gi]
-                    row[name] = k[v] if isinstance(k, tuple) else k
-                else:
-                    row[name] = float(vals[gi, oi])
-            rows.append(row)
-        return state, rows
+        gidx = np.flatnonzero(np.asarray(ov))
+        out_cols = self._out_columns(np.asarray(out), gidx, gidx, None, None)
+        return state, out_cols, len(gidx)
+
+    def _advance_pane(self):
+        """Post-flush timeBatch pane bookkeeping (mirrors the host
+        TimeBatchWindow): boundaries advance by T while panes stay
+        non-empty; after two consecutive empty panes the window goes
+        idle and re-anchors at the next event."""
+        if self._pane_fill == 0 and self._prev_pane_fill == 0:
+            self._pane_end = None
+        else:
+            self._pane_end += int(self.window_param)
+            self._prev_pane_fill = self._pane_fill
+            self._pane_fill = 0
+
+    def pane_wakeup(self) -> Optional[int]:
+        """Absolute ms at which the open timeBatch pane closes (the
+        scheduler hook driving timer flushes, the host TimeBatchWindow's
+        Scheduler.notifyAt analog); None when nothing is pending."""
+        if (self.window_name != "timeBatch" or self._pane_end is None
+                or self.base_ts is None):
+            return None
+        return self.base_ts + self._pane_end
+
+    def flush_due(self, state, now: int):
+        """Timer-driven flush: close every pane whose boundary <= now.
+        Returns (state, out_cols, out_ts)."""
+        chunks = []
+        while True:
+            w = self.pane_wakeup()
+            if w is None or w > now:
+                break
+            state, fcols, nf = self._flush_cols(state)
+            chunks.append((fcols, w, nf))
+            self._advance_pane()
+        out_cols, out_ts = self._concat_chunks(chunks)
+        return state, out_cols, out_ts
 
     def _acc_segment(self, state, cols, rel, grp, idx) -> Tuple[object, int]:
         acc = self.make_acc_step()
@@ -886,13 +1047,14 @@ class DeviceQueryEngine:
         return state, int(n_pass)
 
     def _process_tumbling(self, state, cols, rel, grp, n):
-        rows: List[Dict] = []
+        chunks = []  # (cols, abs_ts, n_rows)
         if self.window_name == "timeBatch":
             # pane bookkeeping mirrors the host TimeBatchWindow: the
             # first event anchors the boundary, boundaries advance by T
             # while panes stay non-empty, and the window goes idle
             # (re-anchoring at the next event) once a pane and its
-            # predecessor are both empty
+            # predecessor are both empty.  Flushes are stamped with the
+            # pane boundary time, matching the timer-driven path.
             T = int(self.window_param)
             i = 0
             while i < n:
@@ -909,16 +1071,12 @@ class DeviceQueryEngine:
                     self._pane_fill += n_pass
                     i = j
                 if i < n:  # boundary crossed by remaining events
-                    state, flushed = self._flush(state)
-                    rows.extend(flushed)
-                    if self._pane_fill == 0 and getattr(
-                            self, "_prev_pane_fill", 0) == 0:
-                        self._pane_end = None  # idle; re-anchor at rel[i]
-                    else:
-                        self._pane_end += T
-                        self._prev_pane_fill = self._pane_fill
-                        self._pane_fill = 0
-            return state, rows
+                    boundary = self.base_ts + self._pane_end
+                    state, fcols, nf = self._flush_cols(state)
+                    chunks.append((fcols, boundary, nf))
+                    self._advance_pane()
+            out_cols, out_ts = self._concat_chunks(chunks)
+            return state, out_cols, out_ts
         # lengthBatch: need passing counts to place flush boundaries,
         # so probe the filter mask first (host-visible)
         L = int(self.window_param)
@@ -935,11 +1093,12 @@ class DeviceQueryEngine:
             j = i + int(pass_pos[remaining - 1]) + 1
             state, _ = self._acc_segment(state, cols, rel, grp,
                                          np.arange(i, j))
-            state, flushed = self._flush(state)
-            rows.extend(flushed)
+            state, fcols, nf = self._flush_cols(state)
+            chunks.append((fcols, self.base_ts + int(rel[j - 1]), nf))
             self._pane_fill = 0
             i = j
-        return state, rows
+        out_cols, out_ts = self._concat_chunks(chunks)
+        return state, out_cols, out_ts
 
     def _host_filter_mask(self, cols, rel, n) -> np.ndarray:
         env = {a: np.asarray(cols[a]) for a in self.all_attrs if a in cols}
@@ -949,6 +1108,27 @@ class DeviceQueryEngine:
         for f in self.filters:
             m = m & np.broadcast_to(np.asarray(f.fn(env)).astype(bool), (n,))
         return m
+
+    # -- snapshot of host-side bookkeeping (device state arrays are
+    # snapshotted by the product runtime that owns them) ---------------------
+
+    def host_snapshot(self) -> Dict:
+        return {
+            "base_ts": self.base_ts,
+            "group_ids": dict(self._group_ids),
+            "group_vals": list(self._group_vals),
+            "pane_end": self._pane_end,
+            "pane_fill": self._pane_fill,
+            "prev_pane_fill": self._prev_pane_fill,
+        }
+
+    def host_restore(self, s: Dict):
+        self.base_ts = s["base_ts"]
+        self._group_ids = dict(s["group_ids"])
+        self._group_vals = list(s["group_vals"])
+        self._pane_end = s["pane_end"]
+        self._pane_fill = s["pane_fill"]
+        self._prev_pane_fill = s["prev_pane_fill"]
 
     # -- introspection -------------------------------------------------------
 

@@ -473,6 +473,27 @@ class QueryPlanner:
     # -- single stream ------------------------------------------------------
 
     def _plan_single(self, query: Query, name: str, s: SingleInputStream) -> QueryRuntime:
+        # @app:execution('tpu'): attempt the jitted device query path
+        # first (reference analog: QueryParser wiring receiver ->
+        # filter -> window -> selector, QueryParser.java:90); host
+        # fallback below — same contract as the dense pattern gate
+        if (
+            self.app.app_context.execution_mode == "tpu"
+            and not getattr(self.app, "in_partition_instance", False)
+        ):
+            import logging
+
+            try:
+                qr = self._plan_device_single(query, name, s)
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': lowered to the jitted device query path",
+                    name)
+                return qr
+            except SiddhiAppCreationError as e:
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': device query path unavailable (%s); "
+                    "using host engine", name, e)
+
         definition = self.app.resolve_stream_definition(s)
         ref = s.unique_id
         scope = scope_for_definition(definition, ref)
@@ -495,6 +516,68 @@ class QueryPlanner:
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
         junction = self.app.junction_for_input(s)
         junction.subscribe(ProcessStreamReceiver(qr))
+        return qr
+
+    def _plan_device_single(
+        self, query: Query, name: str, s: SingleInputStream
+    ) -> QueryRuntime:
+        """Plan a single-stream query onto the jitted device engine;
+        raises SiddhiAppCreationError when the query is outside the
+        device subset (caller falls back to the host chain)."""
+        from siddhi_tpu.core.device_single import (
+            DeviceQueryRuntime,
+            _DeviceQueryReceiver,
+        )
+        from siddhi_tpu.ops.device_query import DeviceQueryEngine
+        from siddhi_tpu.query_api import SnapshotOutputRate
+
+        out = query.output_stream
+        if out is not None and getattr(out, "event_type", "current") != "current":
+            raise SiddhiAppCreationError(
+                "device path emits CURRENT events only")
+        if isinstance(query.output_rate, SnapshotOutputRate):
+            raise SiddhiAppCreationError(
+                "snapshot output rate needs the host selector")
+        if not (s.is_inner or s.is_fault):
+            if s.stream_id in self.app.named_windows:
+                raise SiddhiAppCreationError(
+                    "named-window inputs need CURRENT+EXPIRED semantics")
+            if s.stream_id in self.app.tables or s.stream_id in getattr(
+                    self.app, "aggregations", {}):
+                raise SiddhiAppCreationError(
+                    "table/aggregation inputs need the host planner")
+
+        definition = self.app.resolve_stream_definition(s)
+        engine = DeviceQueryEngine(
+            query, definition,
+            n_groups=self.app.app_context.tpu_partitions,
+        )
+        out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
+        out_attrs = [
+            Attribute(nm, t)
+            for nm, t in zip(engine.output_names, engine.out_types)
+        ]
+        selector = QuerySelector(
+            out_target, None, engine.output_names, [], [], None, [], None, None,
+        )
+        out_def = StreamDefinition(id=out_target, attributes=out_attrs)
+        output = self._plan_output(query, out_def)
+        rate_limiter = self._plan_rate_limiter(query)
+        qr = QueryRuntime(
+            name, [[]], selector, rate_limiter, output, self.app.app_context)
+
+        runtime = DeviceQueryRuntime(
+            engine, f"#device_{name}", emit=lambda b: qr.process(b, 0))
+        qr.device_runtime = runtime
+        junction = self.app.junction_for_input(s)
+        junction.subscribe(_DeviceQueryReceiver(runtime))
+        # registered LAST: nothing below may raise, so a fallback to the
+        # host path never leaks a live scheduler task
+        self.app.scheduler.register_task(runtime)
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+            task = _RateLimiterTask(qr, rate_limiter)
+            qr._rate_task = task
+            self.app.scheduler.register_task(task)
         return qr
 
     def _plan_rate_limiter(self, query: Query):

