@@ -244,6 +244,15 @@ class DensePatternRuntime:
         self._key_rows: Dict = {}
         self._next_row = 0
         self._free_rows: List[int] = []
+        # sorted-key index backing the vectorized intern: _key_arr is the
+        # sorted array of known keys (NATIVE dtype — int64/'<U' — so
+        # searchsorted compares in C, not via boxed python objects),
+        # _key_row_arr the row per sorted position.  _key_rows stays the
+        # source of truth for snapshots/purges; the index is a
+        # rebuildable cache.
+        self._key_arr = np.empty(0, dtype=np.int64)
+        self._key_row_arr = np.empty(0, dtype=np.int32)
+        self._vector_intern = True
         # host-side per-row activity clock driving idle-key reclamation
         # (@purge on dense partitions; the instance path purges whole
         # PartitionInstances instead)
@@ -258,7 +267,104 @@ class DensePatternRuntime:
 
     def intern_keys(self, keys) -> np.ndarray:
         """Partition-key values -> dense engine row ids (stable until the
-        key is purged; shared by all source streams)."""
+        key is purged; shared by all source streams).
+
+        Vectorized: the batch is factorized once (np.unique), existing
+        keys resolve with one searchsorted against the sorted key index,
+        and only NEVER-SEEN keys take the python allocation path — so a
+        131k-event batch over warm keys costs O(n log n) numpy, not 131k
+        dict probes.
+
+        The sorted index only works while every key batch shares one
+        dtype family (all-int, all-string, ...).  Mixing families — e.g.
+        ``partition with (k of A, sym of B)`` with an int key on one
+        stream and a string on the other — would corrupt searchsorted
+        ordering (and 7 vs 7.0 alias under python hashing but not under
+        dtype promotion), so the runtime then degrades permanently to
+        the exact per-event dict intern."""
+        arr = np.asarray(keys)
+        if self._vector_intern:
+            if arr.dtype.kind in ("O", "V"):
+                self._vector_intern = False
+            elif len(self._key_arr) == 0 and not self._key_rows:
+                pass  # first batch adopts its dtype below
+            elif arr.dtype != self._key_arr.dtype:
+                if np.can_cast(arr.dtype, self._key_arr.dtype, "safe"):
+                    arr = arr.astype(self._key_arr.dtype)
+                elif np.can_cast(self._key_arr.dtype, arr.dtype, "safe"):
+                    self._key_arr = self._key_arr.astype(arr.dtype)
+                else:
+                    log.warning(
+                        "dense pattern: partition keys mix dtypes (%s vs "
+                        "index %s); falling back to the exact dict intern",
+                        arr.dtype, self._key_arr.dtype)
+                    self._vector_intern = False
+        if not self._vector_intern:
+            return self._intern_keys_dict(arr)
+        uniq, inv = np.unique(arr, return_inverse=True)
+        nu = len(uniq)
+        urows = np.empty(nu, dtype=np.int32)
+        if len(self._key_arr):
+            pos = np.searchsorted(self._key_arr, uniq)
+            pos_c = np.minimum(pos, len(self._key_arr) - 1)
+            found = self._key_arr[pos_c] == uniq
+            urows[found] = self._key_row_arr[pos_c[found]]
+            new_idx = np.flatnonzero(~found)
+        else:
+            new_idx = np.arange(nu)
+        if len(new_idx):
+            cap = self.engine.n_partitions
+            n_new = len(new_idx)
+            # bulk row allocation: recycled rows first, then a fresh range
+            take_free = min(len(self._free_rows), n_new)
+            fresh = n_new - take_free
+            if self._next_row + fresh > cap:
+                raise SiddhiAppRuntimeError(
+                    f"dense pattern: partition-key cardinality exceeded "
+                    f"capacity {cap} (raise it via "
+                    f"@app:execution('tpu', partitions='N') or enable "
+                    "@purge on the partition)")
+            row_ids = np.empty(n_new, dtype=np.int32)
+            if take_free:
+                row_ids[:take_free] = self._free_rows[-take_free:][::-1]
+                del self._free_rows[-take_free:]
+            if fresh:
+                row_ids[take_free:] = np.arange(
+                    self._next_row, self._next_row + fresh, dtype=np.int32)
+                self._next_row += fresh
+            urows[new_idx] = row_ids
+            self._key_rows.update(
+                zip(uniq[new_idx].tolist(), row_ids.tolist()))
+            # merge the (sorted) new keys into the sorted index with an
+            # O(K+U) two-way merge (a full argsort of ~1M keys per batch
+            # would dominate the step); dtype promotes explicitly so
+            # widening string keys never truncate
+            new_keys = uniq[new_idx]
+            new_rows = urows[new_idx]
+            K, U = len(self._key_arr), len(new_keys)
+            if K == 0:
+                self._key_arr = new_keys.copy()
+                self._key_row_arr = new_rows.copy()
+            else:
+                ins = np.searchsorted(self._key_arr, new_keys)
+                new_pos = ins + np.arange(U)
+                old_mask = np.ones(K + U, dtype=bool)
+                old_mask[new_pos] = False
+                dt = np.promote_types(self._key_arr.dtype, new_keys.dtype)
+                merged_keys = np.empty(K + U, dtype=dt)
+                merged_keys[new_pos] = new_keys
+                merged_keys[old_mask] = self._key_arr
+                merged_rows = np.empty(K + U, dtype=np.int32)
+                merged_rows[new_pos] = new_rows
+                merged_rows[old_mask] = self._key_row_arr
+                self._key_arr = merged_keys
+                self._key_row_arr = merged_rows
+        return urows[inv].astype(np.int32, copy=False)
+
+    def _intern_keys_dict(self, keys) -> np.ndarray:
+        """Exact per-event intern (hash semantics): the fallback when
+        partition keys mix dtype families, and the behavior reference
+        for the vectorized path."""
         out = np.zeros(len(keys), dtype=np.int32)
         rows = self._key_rows
         cap = self.engine.n_partitions
@@ -279,6 +385,30 @@ class DensePatternRuntime:
                 rows[k] = row
             out[i] = row
         return out
+
+    def _rebuild_key_index(self):
+        """Rebuild the sorted intern index from _key_rows (after purge
+        or restore); degrades to dict mode when the stored keys do not
+        form one sortable dtype family."""
+        if self._key_rows:
+            try:
+                karr = np.array(list(self._key_rows.keys()))
+            except ValueError:  # inhomogeneous keys
+                karr = None
+            if karr is None or karr.dtype.kind in ("O", "V"):
+                self._vector_intern = False
+                self._key_arr = np.empty(0, dtype=np.int64)
+                self._key_row_arr = np.empty(0, dtype=np.int32)
+                return
+            rarr = np.fromiter(
+                (self._key_rows[k] for k in self._key_rows), np.int32,
+                len(karr))
+            order = np.argsort(karr, kind="stable")
+            self._key_arr = karr[order]
+            self._key_row_arr = rarr[order]
+        else:
+            self._key_arr = np.empty(0, dtype=np.int64)
+            self._key_row_arr = np.empty(0, dtype=np.int32)
 
     def purge_idle(self, now: int, idle_ms: int):
         """Reclaim rows of keys idle for >= idle_ms: reset their device
@@ -303,6 +433,7 @@ class DensePatternRuntime:
         for k, r in idle:
             del self._key_rows[k]
             self._free_rows.append(r)
+        self._rebuild_key_index()
 
     def _part_ids(self, batch: EventBatch) -> np.ndarray:
         if self.key_fn is None:
@@ -369,6 +500,7 @@ class DensePatternRuntime:
         rlu = state.get("row_last_used")
         if rlu is not None:
             self._row_last_used = np.asarray(rlu).copy()
+        self._rebuild_key_index()
 
     # -- scheduler-compatible no-ops (within expiry is event-driven on
     # the dense path, like StreamPreStateProcessor's on-arrival pruning)

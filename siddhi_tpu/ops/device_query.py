@@ -412,10 +412,13 @@ class DeviceQueryEngine:
     def _check_value_types(self, stream_def, s, sel):
         """Reject device-evaluated expressions (filters, computed select
         items incl. aggregate arguments, having) that read a LONG
-        attribute: it has no device lane — float32 would silently round
-        above 2^24 (the reference is per-type exact,
+        attribute — or a LONG constant outside int32 range: neither has
+        a 64-bit device lane, and int32/float32 would silently wrap or
+        round (the reference is per-type exact,
         executor/math/ & condition/compare/).  Group-by keys and bare
         select items stay host-side and may be any type."""
+        from siddhi_tpu.query_api import Constant
+
         names = set(stream_def.attribute_names)
         ids = (None, s.stream_id, s.alias)
 
@@ -431,6 +434,12 @@ class DeviceQueryEngine:
                             "host engine used (LONG is fine as a group-by "
                             "key or bare select item)")
                 return e
+            if (isinstance(e, Constant) and e.type == AttrType.LONG
+                    and e.value is not None
+                    and not -(2**31) <= int(e.value) < 2**31):
+                raise SiddhiAppCreationError(
+                    f"device query path: constant {e.value} exceeds the "
+                    "int32 device lane — host engine used")
             return _map_children(e, walk)
 
         for f in self.filter_exprs:
@@ -573,15 +582,25 @@ class DeviceQueryEngine:
         return jnp.stack(cols, axis=-1)
 
     def _emit(self, env_out, fmask, B):
-        """Evaluate select items / having -> (out_valid, out_vals[B, n_out])."""
+        """Evaluate select items / having -> (out_valid, {name: [B]}).
+
+        Each computed column keeps a dtype matching its declared type —
+        INT expressions stay int32 end-to-end (bit-exact), BOOL stays
+        bool; everything else is float32 — instead of rounding through
+        one shared float32 matrix."""
         jnp = self.jnp
-        n_out = max(len(self.out_spec), 1)
-        out = jnp.zeros((B, n_out), dtype=jnp.float32)
-        for oi, (kind, v, _name) in enumerate(self.out_spec):
+        out = {}
+        for oi, (kind, v, name) in enumerate(self.out_spec):
             if kind in ("group_key", "passthrough"):
                 continue  # materialized host-side
-            col = jnp.asarray(v.fn(env_out)).astype(jnp.float32)
-            out = out.at[:, oi].set(jnp.broadcast_to(col, (B,)))
+            col = jnp.asarray(v.fn(env_out))
+            if v.type == AttrType.INT:
+                col = col.astype(jnp.int32)
+            elif v.type == AttrType.BOOL:
+                col = col.astype(bool)
+            else:
+                col = col.astype(jnp.float32)
+            out[name] = jnp.broadcast_to(col, (B,))
         if self.having is not None:
             fmask = fmask & jnp.asarray(self.having.fn(env_out)).astype(bool)
         return fmask, out
@@ -866,21 +885,32 @@ class DeviceQueryEngine:
         env[N_KEY] = n
         key_cols = [np.broadcast_to(np.asarray(g.fn(env)), (n,))
                     for g in self.group_exprs]
+        if len(key_cols) == 1:
+            # vectorized: factorize the batch once; one dict probe per
+            # UNIQUE value instead of per event
+            uniq, inv = np.unique(key_cols[0], return_inverse=True)
+            out_u = np.empty(len(uniq), dtype=np.int32)
+            for i, k in enumerate(uniq.tolist()):
+                out_u[i] = self._alloc_group(k)
+            return out_u[inv].astype(np.int32, copy=False)
         out = np.empty(n, dtype=np.int32)
         for i in range(n):
-            k = tuple(c[i] for c in key_cols)
-            k = k[0] if len(k) == 1 else k
-            gid = self._group_ids.get(k)
-            if gid is None:
-                gid = len(self._group_ids)
-                if gid >= self.n_groups:
-                    raise SiddhiAppRuntimeError(
-                        f"device query: group cardinality exceeded "
-                        f"n_groups={self.n_groups}")
-                self._group_ids[k] = gid
-                self._group_vals.append(k)
-            out[i] = gid
+            k = tuple(c[i].item() if hasattr(c[i], "item") else c[i]
+                      for c in key_cols)
+            out[i] = self._alloc_group(k)
         return out
+
+    def _alloc_group(self, k) -> int:
+        gid = self._group_ids.get(k)
+        if gid is None:
+            gid = len(self._group_ids)
+            if gid >= self.n_groups:
+                raise SiddhiAppRuntimeError(
+                    f"device query: group cardinality exceeded "
+                    f"n_groups={self.n_groups}")
+            self._group_ids[k] = gid
+            self._group_vals.append(k)
+        return gid
 
     def _pad(self, cols, rel, grp, n):
         jnp = self.jnp
@@ -902,7 +932,7 @@ class DeviceQueryEngine:
 
     def _out_columns(self, vals, sel, gids, in_cols, in_sel) -> Dict[str, np.ndarray]:
         """Assemble output columns (declared dtypes) for the selected
-        rows.  ``vals``: [*, n_out] float32 device matrix; ``sel``: row
+        rows.  ``vals``: {name: [*]} device column dict; ``sel``: row
         indices into it; ``gids``: group id per output row;
         ``in_cols``/``in_sel``: input batch columns + row indices for
         passthrough items (None for flush outputs, which cannot have
@@ -920,7 +950,7 @@ class DeviceQueryEngine:
                 cols[name] = np.asarray(in_cols[v])[in_sel].astype(
                     t.np_dtype, copy=False)
             else:
-                cols[name] = vals[sel, oi].astype(t.np_dtype)
+                cols[name] = vals[name][sel].astype(t.np_dtype)
         return cols
 
     def _empty_cols(self) -> Dict[str, np.ndarray]:
@@ -962,8 +992,8 @@ class DeviceQueryEngine:
             c, t, g, valid, B = self._pad(cols, rel, grp, n)
             state, ov, out = step(state, c, t, g, valid)
             idx = np.flatnonzero(np.asarray(ov)[:n])
-            out_cols = self._out_columns(
-                np.asarray(out)[:n], idx, grp[idx], cols, idx)
+            out_np = {k: np.asarray(col)[:n] for k, col in out.items()}
+            out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
             return state, out_cols, ts[idx]
         state, out_cols, out_ts = self._process_tumbling(
             state, cols, rel, grp, n)
@@ -996,7 +1026,8 @@ class DeviceQueryEngine:
         flush = self.make_flush_step()
         state, ov, out = flush(state)
         gidx = np.flatnonzero(np.asarray(ov))
-        out_cols = self._out_columns(np.asarray(out), gidx, gidx, None, None)
+        out_np = {k: np.asarray(col) for k, col in out.items()}
+        out_cols = self._out_columns(out_np, gidx, gidx, None, None)
         return state, out_cols, len(gidx)
 
     def _advance_pane(self):

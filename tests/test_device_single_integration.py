@@ -246,6 +246,54 @@ class TestFallbacks:
         assert [r["v"] for r in dev] == [1.0, 3.0]
 
 
+class TestReviewRegressions:
+    def test_long_constant_falls_back_not_wraps(self):
+        """An out-of-int32 literal must NOT lower onto int32 lanes
+        (it would wrap modulo 2^32 and match the wrong rows)."""
+        app = ("define stream S (i int, v double); "
+               "from S[i == 2200000000] select v insert into OutputStream;")
+        sends = [([-2094967296, 1.0], 1000)]  # == 2200000000 mod 2^32
+        host = run_app(app, sends)
+        dev, runtime = run_app(app, sends, mode="tpu", want_runtime=True)
+        assert runtime is None  # fell back
+        assert host == dev == []
+
+    def test_int_expression_exact_above_2p24(self):
+        """INT computed select items stay int32 end-to-end — no float32
+        rounding through the output matrix."""
+        app = ("define stream S (i int, v double); "
+               "from S select i + 1 as x insert into OutputStream;")
+        sends = [([100_000_001, 0.0], 1000)]
+        dev, runtime = run_app(app, sends, mode="tpu", want_runtime=True)
+        assert isinstance(runtime, DeviceQueryRuntime)
+        assert dev == [{"x": 100_000_002}]
+
+    def test_mixed_dtype_partition_keys_fall_back_to_dict_intern(self):
+        """Int keys then string keys on one dense runtime: the sorted
+        index cannot order both, so the runtime must degrade to the
+        exact dict intern instead of resetting int-key rows."""
+        from siddhi_tpu.compiler import SiddhiCompiler
+        from siddhi_tpu.core.dense_pattern import (
+            DensePatternRuntime, build_dense_engine)
+
+        app = SiddhiCompiler.parse(
+            "define stream S (k long, v double); "
+            "from every e1=S[v > 5.0] -> e2=S[v > e1.v] within 10 sec "
+            "select e1.v as a, e2.v as b insert into Out;")
+        q = app.queries[0]
+        defs = app.stream_definitions
+        eng = build_dense_engine(
+            q, q.input_stream, lambda s: defs[s.stream_id], 64)
+        rt = DensePatternRuntime(eng, "#m", emit=lambda b: None)
+        r_int = rt.intern_keys(np.asarray([7, 8, 7]))
+        assert list(r_int) == [0, 1, 0]
+        r_str = rt.intern_keys(np.asarray(["seven", "eight"]))
+        assert not rt._vector_intern
+        assert list(r_str) == [2, 3]
+        # int keys keep their original rows after the degradation
+        assert list(rt.intern_keys(np.asarray([8, 7]))) == [1, 0]
+
+
 class TestTimerPaneFlush:
     def test_timebatch_flushes_on_watermark_without_new_pane_events(self):
         """A later event on ANOTHER stream advances the watermark and
