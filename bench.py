@@ -1,20 +1,38 @@
 """Benchmark: pattern-match events/sec on the dense TPU NFA.
 
 North-star config (BASELINE.json): 16-state fraud-style pattern over 1M
-key partitions.  The dense engine advances per-partition NFA state
-(bitmasks + capture registers in HBM) with one jitted step per event
-micro-batch; measured throughput is end-of-steady-state events/sec on
-the available accelerator (single chip under axon; CPU fallback).
+key partitions.  Three measurements, all on the SAME pattern:
 
-Baseline: the reference publishes no numbers (BASELINE.md).  The JVM
-pattern path (StreamPreStateProcessor chain with per-event locking) is
-estimated at 2M events/sec/core from the reference's own perf-harness
-methodology (SimpleFilterSingleQueryPerformance prints ~1-5M ev/s for a
-plain filter; the 16-state pattern path does strictly more work per
-event).  vs_baseline = measured / 2e6, so the >= 50x north-star target
-corresponds to vs_baseline >= 50.
+1. **kernel** — the jitted dense-NFA step driven directly with
+   pre-staged device arrays (the innermost hot loop; what previous
+   rounds reported).  Several async-dispatched windows; mean/stddev/all
+   window rates are reported so round-over-round deltas can be told
+   from chip contention (the r2->r3 swing was unexplained noise).
+2. **product** — the SAME partitioned app built via SiddhiManager with
+   @app:execution('tpu'), events pumped through the public
+   InputHandler.send_batch path: host->device transfer, key interning,
+   emit conversion and callbacks all included.
+3. **host baseline (measured)** — the SAME partitioned app on the host
+   engine (ops/nfa.py per-key instances), the measured stand-in for the
+   reference's JVM StreamPreStateProcessor chain (BASELINE.md protocol;
+   no JVM exists in this image).  Run on a 2,048-key miniature: a
+   million per-key python instances is exactly the infeasibility the
+   dense design removes.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = kernel events/sec / MEASURED host events/sec (the
+hardcoded 2M estimate of earlier rounds is gone).  product_vs_host is
+the end-to-end framework speedup on the public API.
+
+Known platform caveat (measured, round 4): on the tunneled single-chip
+axon platform, the FIRST device->host transfer of a jit output drops
+every later dispatch from ~0.04 ms to a sticky ~57 ms round trip — so
+the kernel number (no transfers inside the timed window) reflects the
+chip, while the product number (one emit transfer per batch, required
+to drive callbacks) is dominated by tunnel round trips, not by the
+engine.  The product path minimizes transfers (one per batch; output
+values fetched only when matches exist) but cannot avoid them.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
@@ -28,30 +46,41 @@ BATCH = 1 << 17  # 131072 events per step
 STEPS = 20
 WARMUP = 3
 N_STATES = 16
-JVM_BASELINE_EVENTS_PER_SEC = 2_000_000.0
+N_WINDOWS = 5
+
+HOST_KEYS = 2_048
+HOST_BATCH = 8_192
+HOST_MIN_SECONDS = 3.0
+HOST_MAX_SECONDS = 20.0
+
+PRODUCT_STEPS = 10
+PRODUCT_WINDOWS = 3
 
 
-def build_app() -> str:
-    """16-state escalation pattern: every e1=[v>θ1] -> e2=[v>θ2 and v>e1.v] -> ... within 10 min."""
-    defs = "define stream Txn (key long, v double); "
+def pattern_query() -> str:
+    """16-state escalation pattern: every e1=[v>θ1] -> e2=[v>θ2 and
+    v>e1.v] -> ... within 10 min."""
     states = ["every e1=Txn[v > 0.0]"]
     for i in range(2, N_STATES + 1):
         states.append(f"e{i}=Txn[v > {float(i - 1)} and v > e1.v]")
     pattern = " -> ".join(states)
-    select = "select e1.v as v1, e16.v as v16"
-    return (
-        defs
-        + f"@info(name='bench') from {pattern} within 10 min {select} insert into Alerts;"
-    )
+    return (f"@info(name='bench') from {pattern} within 10 min "
+            "select e1.v as v1, e16.v as v16 insert into Alerts;")
 
 
-def main():
-    import jax
+def flat_app() -> str:
+    return "define stream Txn (key long, v double); " + pattern_query()
 
+
+def partitioned_app() -> str:
+    return ("define stream Txn (key long, v double); "
+            "partition with (key of Txn) begin " + pattern_query() + " end;")
+
+
+def bench_kernel():
     from siddhi_tpu.ops.dense_nfa import compile_pattern
 
-    dev = jax.devices()[0]
-    eng = compile_pattern(build_app(), "bench", n_partitions=N_PARTITIONS)
+    eng = compile_pattern(flat_app(), "bench", n_partitions=N_PARTITIONS)
     state = eng.init_state()
     step = eng.make_step("Txn")
 
@@ -61,7 +90,8 @@ def main():
     def make_batch(i):
         # unique partitions within a batch (stride walk) -> no collision
         # rounds; values escalate so the chain actually advances
-        part = ((np.arange(BATCH, dtype=np.int64) * 524287 + i * BATCH) % N_PARTITIONS).astype(np.int32)
+        part = ((np.arange(BATCH, dtype=np.int64) * 524287 + i * BATCH)
+                % N_PARTITIONS).astype(np.int32)
         v = rng.uniform(0.0, float(N_STATES + 4), BATCH).astype(np.float32)
         ts = np.full(BATCH, 1_000 + i * 10, dtype=np.int32)
         return (
@@ -73,25 +103,21 @@ def main():
 
     batches = [make_batch(i) for i in range(STEPS + WARMUP)]
 
-    # warmup / compile
     for i in range(WARMUP):
         pi, cols, ts, valid = batches[i]
         state, emit, _ = step(state, pi, cols, ts, valid)
     emit.block_until_ready()
 
     # throughput: several async-dispatched windows (sync once per window
-    # so XLA pipelines steps); the median window resists transient
-    # contention on a shared/tunneled chip
-    N_WINDOWS = 5
+    # so XLA pipelines steps); median + spread reported
     window_rates = []
-    for w in range(N_WINDOWS):
+    for _w in range(N_WINDOWS):
         t_w = time.perf_counter()
         for i in range(WARMUP, WARMUP + STEPS):
             pi, cols, ts, valid = batches[i]
             state, emit, _ = step(state, pi, cols, ts, valid)
         emit.block_until_ready()
         window_rates.append(BATCH * STEPS / (time.perf_counter() - t_w))
-    events_per_sec = float(np.median(window_rates))
 
     # detection latency: separate synced pass (per-batch wall time incl.
     # host round trip — the north-star's p99 axis)
@@ -102,21 +128,156 @@ def main():
         state, emit, _ = step(state, pi, cols, ts, valid)
         emit.block_until_ready()
         per_step.append(time.perf_counter() - t0)
-    p99_batch_ms = float(np.percentile(np.asarray(per_step), 99) * 1e3)
-    print(
-        json.dumps(
-            {
-                "metric": "pattern_match_events_per_sec_per_chip",
-                "value": round(events_per_sec, 1),
-                "unit": "events/s",
-                "vs_baseline": round(events_per_sec / JVM_BASELINE_EVENTS_PER_SEC, 2),
-                "p99_batch_latency_ms": round(p99_batch_ms, 3),
-                "batch": BATCH,
-                "n_partitions": N_PARTITIONS,
-                "n_states": N_STATES,
-            }
-        )
-    )
+    return {
+        "events_per_sec": float(np.median(window_rates)),
+        "window_rates": [round(r, 1) for r in window_rates],
+        "rate_mean": float(np.mean(window_rates)),
+        "rate_stddev": float(np.std(window_rates)),
+        "p99_batch_ms": float(np.percentile(np.asarray(per_step), 99) * 1e3),
+    }
+
+
+def _product_batches(n_steps, n_keys, batch, seed=11):
+    from siddhi_tpu.core.event import EventBatch
+
+    rng = np.random.default_rng(seed)
+    out = []
+    t0 = 1_000
+    for i in range(n_steps):
+        keys = ((np.arange(batch, dtype=np.int64) * 524287 + i * batch)
+                % n_keys)
+        v = rng.uniform(0.0, float(N_STATES + 4), batch)
+        ts = np.full(batch, t0 + i * 10, dtype=np.int64)
+        out.append(EventBatch(
+            "Txn", ["key", "v"], {"key": keys, "v": v}, ts))
+    return out
+
+
+def bench_product():
+    """End-to-end SiddhiManager path: H2D, interning, emit included."""
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback "
+            f"@app:execution('tpu', partitions='{N_PARTITIONS}') "
+            + partitioned_app())
+        pr = rt.partitions["partition_0"]
+        assert pr.is_dense, "bench app failed to lower densely"
+        matches = [0]
+        rt.add_callback("Alerts", lambda evs: matches.__setitem__(
+            0, matches[0] + len(evs)))
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        batches = _product_batches(WARMUP + PRODUCT_STEPS, N_PARTITIONS, BATCH)
+        for b in batches[:WARMUP]:
+            h.send_batch(b)
+        window_rates = []
+        for _w in range(PRODUCT_WINDOWS):
+            t_w = time.perf_counter()
+            for b in batches[WARMUP:]:
+                h.send_batch(b)
+            window_rates.append(
+                BATCH * PRODUCT_STEPS / (time.perf_counter() - t_w))
+
+        # interning share of the product step (the round-3 hot-spot):
+        # hot-key intern time vs whole-batch product time (derived from
+        # the windows above — no extra send pass)
+        runtime = next(
+            iter(pr.dense_query_runtimes.values())).pattern_processor
+        keys = np.asarray(batches[WARMUP].columns["key"])
+        t0 = time.perf_counter()
+        for _ in range(5):
+            runtime.intern_keys(keys)
+        intern_s = (time.perf_counter() - t0) / 5
+        product_s_per_batch = BATCH / float(np.median(window_rates))
+        rt.shutdown()
+        return {
+            "events_per_sec": float(np.median(window_rates)),
+            "window_rates": [round(r, 1) for r in window_rates],
+            "intern_share": round(intern_s / max(product_s_per_batch, 1e-9), 3),
+            "matches": matches[0],
+        }
+    finally:
+        m.shutdown()
+
+
+def bench_host_baseline():
+    """Measured host-engine (ops/nfa.py) rate on the same partitioned
+    pattern — the CPU reference side of the comparison."""
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + partitioned_app())
+        matches = [0]
+        rt.add_callback("Alerts", lambda evs: matches.__setitem__(
+            0, matches[0] + len(evs)))
+        rt.start()
+        h = rt.get_input_handler("Txn")
+        batches = _product_batches(12, HOST_KEYS, HOST_BATCH, seed=13)
+        h.send_batch(batches[0])  # warm instance creation
+        # duration floor: cycle batches until >= HOST_MIN_SECONDS so a
+        # fast host engine still gets a noise-resistant sample; ceiling
+        # keeps a slow one from eating the bench budget.  Timestamps are
+        # re-offset each cycle to stay monotone for event-time windows.
+        sent = 0
+        cycle = 0
+        t0 = time.perf_counter()
+        while True:
+            for b in batches[1:]:
+                if cycle:
+                    b = type(b)(b.stream_id, b.attribute_names, b.columns,
+                                b.timestamps + cycle * 10_000_000, b.types)
+                h.send_batch(b)
+                sent += len(b)
+                if time.perf_counter() - t0 > HOST_MAX_SECONDS:
+                    break
+            el = time.perf_counter() - t0
+            if el >= HOST_MIN_SECONDS or el > HOST_MAX_SECONDS:
+                break
+            cycle += 1
+        dt = time.perf_counter() - t0
+        rt.shutdown()
+        return {
+            "events_per_sec": sent / dt,
+            "events_measured": sent,
+            "n_keys": HOST_KEYS,
+            "matches": matches[0],
+        }
+    finally:
+        m.shutdown()
+
+
+def main():
+    kernel = bench_kernel()
+    product = bench_product()
+    host = bench_host_baseline()
+    events_per_sec = kernel["events_per_sec"]
+    host_rate = host["events_per_sec"]
+    print(json.dumps({
+        "metric": "pattern_match_events_per_sec_per_chip",
+        "value": round(events_per_sec, 1),
+        "unit": "events/s",
+        "vs_baseline": round(events_per_sec / host_rate, 2),
+        "p99_batch_latency_ms": round(kernel["p99_batch_ms"], 3),
+        "kernel_window_rates": kernel["window_rates"],
+        "kernel_rate_stddev": round(kernel["rate_stddev"], 1),
+        "product_events_per_sec": round(product["events_per_sec"], 1),
+        "product_window_rates": product["window_rates"],
+        "product_vs_host": round(product["events_per_sec"] / host_rate, 2),
+        "intern_share_of_product_step": product["intern_share"],
+        "host_measured_events_per_sec": round(host_rate, 1),
+        "host_events_measured": host["events_measured"],
+        "host_n_keys": host["n_keys"],
+        "baseline_source": "measured: ops/nfa.py host engine, same app, "
+                           f"{HOST_KEYS}-key miniature (no JVM in image)",
+        "batch": BATCH,
+        "n_partitions": N_PARTITIONS,
+        "n_states": N_STATES,
+    }))
 
 
 if __name__ == "__main__":

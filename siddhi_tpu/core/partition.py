@@ -50,8 +50,13 @@ class ValuePartitionExecutor:
         self.compiled = compiled
 
     def keys(self, batch: EventBatch) -> List:
-        vals = np.broadcast_to(np.asarray(self.compiled.fn(_batch_env(batch))), (len(batch),))
-        return [v.item() if isinstance(v, np.generic) else v for v in vals]
+        return self.keys_array(batch).tolist()
+
+    def keys_array(self, batch: EventBatch) -> np.ndarray:
+        """Raw key column (native dtype, no per-element boxing) — the
+        dense path interns straight from this."""
+        return np.broadcast_to(
+            np.asarray(self.compiled.fn(_batch_env(batch))), (len(batch),))
 
 
 class RangePartitionExecutor:
@@ -62,14 +67,16 @@ class RangePartitionExecutor:
         self.ranges = ranges
 
     def keys(self, batch: EventBatch) -> List:
+        return self.keys_array(batch).tolist()
+
+    def keys_array(self, batch: EventBatch) -> np.ndarray:
         n = len(batch)
         env = _batch_env(batch)
-        out: List = [None] * n
+        out = np.full(n, None, dtype=object)
         assigned = np.zeros(n, dtype=bool)
         for cond, label in self.ranges:
             m = np.broadcast_to(np.asarray(cond.fn(env)), (n,)) & ~assigned
-            for i in np.flatnonzero(m):
-                out[i] = label
+            out[m] = label
             assigned |= m
         return out
 
@@ -274,13 +281,17 @@ class DensePartitionReceiver:
         cur = batch.only(ev.CURRENT)
         if len(cur) == 0:
             return
-        keys = self.executor.keys(cur)
-        if any(k is None for k in keys):  # range partitions drop unmatched
-            keep = np.asarray([k is not None for k in keys])
-            cur = cur.mask(keep)
-            keys = [k for k in keys if k is not None]
-            if len(cur) == 0:
-                return
+        keys = self.executor.keys_array(cur)
+        if keys.dtype == object:  # range partitions drop unmatched (None)
+            keep = np.not_equal(keys, None)
+            if not keep.all():
+                cur = cur.mask(keep)
+                if len(cur) == 0:
+                    return
+                keys = keys[keep]
+            # range labels are strings: re-infer a native '<U' dtype so
+            # the vectorized intern index applies
+            keys = np.asarray(keys.tolist())
         for rt in self.runtimes:
             part = rt.intern_keys(keys)
             rt.process_stream_batch(self.stream_id, cur, part=part)

@@ -643,8 +643,14 @@ class DensePatternEngine:
             state, emit, out_vals = step(
                 state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
             )
-            emit_all[ridx] = np.asarray(emit)[:b]
-            out_all[ridx] = np.asarray(out_vals)[:b]
+            # device->host: fetch the emit mask, then the output values
+            # only when something matched — matches are rare in CEP, so
+            # the common batch costs ONE transfer round trip, not two
+            # (transfers are expensive on tunneled/remote devices)
+            emit_np = np.asarray(emit)[:b]
+            emit_all[ridx] = emit_np
+            if emit_np.any():
+                out_all[ridx] = np.asarray(out_vals)[:b]
         return state, emit_all, out_all
 
     @property
