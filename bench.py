@@ -105,7 +105,7 @@ def bench_kernel():
 
     for i in range(WARMUP):
         pi, cols, ts, valid = batches[i]
-        state, emit, _ = step(state, pi, cols, ts, valid)
+        state, emit, _, _ = step(state, pi, cols, ts, valid)
     emit.block_until_ready()
 
     # throughput: several async-dispatched windows (sync once per window
@@ -115,7 +115,7 @@ def bench_kernel():
         t_w = time.perf_counter()
         for i in range(WARMUP, WARMUP + STEPS):
             pi, cols, ts, valid = batches[i]
-            state, emit, _ = step(state, pi, cols, ts, valid)
+            state, emit, _, _ = step(state, pi, cols, ts, valid)
         emit.block_until_ready()
         window_rates.append(BATCH * STEPS / (time.perf_counter() - t_w))
 
@@ -125,7 +125,7 @@ def bench_kernel():
     for i in range(WARMUP, WARMUP + STEPS):
         pi, cols, ts, valid = batches[i]
         t0 = time.perf_counter()
-        state, emit, _ = step(state, pi, cols, ts, valid)
+        state, emit, _, _ = step(state, pi, cols, ts, valid)
         emit.block_until_ready()
         per_step.append(time.perf_counter() - t0)
     return {

@@ -78,9 +78,11 @@ class SiddhiAppContext:
         # @app:execution('tpu' | 'host'): 'tpu' routes eligible queries
         # through the jitted device paths with host fallback (the
         # BASELINE.json north-star gate); tpu_partitions sizes the
-        # partition axis of dense pattern state
+        # partition axis of dense pattern state, tpu_instances its
+        # per-(partition, node) pending-instance capacity
         self.execution_mode = "host"
         self.tpu_partitions = 65536
+        self.tpu_instances = 4
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -13,11 +13,12 @@ BASELINE.json).  The planner attempts dense lowering for every
 pattern/sequence query and falls back to the host engine — logging the
 reason — when the query needs semantics outside the dense subset
 (absent states, optional min-0 nodes, >32 nodes, non-float captures/
-filters/selects, aggregating selectors, ...).  Known approximation of
-the dense subset (documented in ops/dense_nfa.py): at most one pending
-instance per (partition, node), so `every` arms that overlap BEFORE the
-first completes collapse to the newest — the instance axis planned for
-the dense engine lifts this.
+filters/selects, aggregating selectors, ...).  Overlapping `every` arms
+run independently on the engine's instance axis (up to
+``@app:execution('tpu', instances='N')`` per (partition, node), default
+4); instances dropped when every successor lane is full are counted in
+the engine's per-partition ``overflow`` state — explicit capacity where
+the reference grows unbounded pending lists.
 
 Partitioned form: ``partition with (key of S) begin <pattern query> end``
 lowers to ONE dense engine whose partition axis is the interned key —
@@ -43,7 +44,7 @@ log = logging.getLogger("siddhi_tpu")
 
 
 def build_dense_engine(query, st: StateInputStream, resolve_def,
-                       n_partitions: int):
+                       n_partitions: int, n_instances: int = 4):
     """Lower one pattern/sequence query to a DensePatternEngine or raise
     SiddhiAppCreationError with the reason it is not dense-eligible."""
     from siddhi_tpu.ops.dense_nfa import DensePatternEngine
@@ -90,6 +91,7 @@ def build_dense_engine(query, st: StateInputStream, resolve_def,
         # non-every stops the partition's automaton after its match
         reset_on_emit=not every_start,
         is_sequence=st.type == StateInputStream.SEQUENCE,
+        n_instances=n_instances,
     )
 
     # every capture register and output must be float-typed: registers
@@ -463,18 +465,18 @@ class DensePatternRuntime:
         ts = np.asarray(cur.timestamps, dtype=np.int64)
         if len(ts):
             np.maximum.at(self._row_last_used, part, ts)
-        self.state, emit, out = eng.process(self.state, stream_key, part, cols, ts)
+        self.state, ev_idx, out = eng.process(
+            self.state, stream_key, part, cols, ts)
         self.step_invocations += 1
-        if not emit.any():
+        if len(ev_idx) == 0:
             return
-        idx = np.flatnonzero(emit)
         out_cols: Dict[str, np.ndarray] = {}
         names = eng.output_names
         for oi, name in enumerate(names):
-            out_cols[name] = out[idx, oi].astype(self._out_dtypes[oi])
+            out_cols[name] = out[:, oi].astype(self._out_dtypes[oi])
         mb = EventBatch(
             self.out_stream_id, names, out_cols,
-            ts[idx], np.full(len(idx), ev.CURRENT, dtype=np.int8),
+            ts[ev_idx], np.full(len(ev_idx), ev.CURRENT, dtype=np.int8),
         )
         self.emit_cb(mb)
 

@@ -26,8 +26,22 @@ ops/nfa.py — the planner falls back to the host engine otherwise):
    no absent states, <= 32 nodes; patterns and strict-continuity
    sequences (non-matching events kill pending sequence instances
    pre-advance, start node stays armed);
- - at most one pending instance per (partition, node) — overlapping
-   `every` instances collapse to the newest arming;
+ - **instance axis**: up to ``n_instances`` simultaneous pending
+   instances per (partition, node) — overlapping `every` arms advance
+   independently, matching the reference's pendingStateEventList.
+   When every slot of a successor node is occupied, the advancing
+   instance is DROPPED (oldest-pending-wins) and the partition's
+   ``overflow`` counter increments — the explicit-capacity analog of
+   the reference's unbounded list (size the axis with
+   ``@app:execution('tpu', instances='N')``).  Sequences force one
+   instance (the reference keeps a single pending per state);
+ - count ({m:n}) nodes: exact counts move at min==max; open-ended
+   counts ({m:ANY} / min<max) stay dually pending, cloning per
+   successor-matching event through the via-path with clone-time
+   registers (exactly the reference's pre-capture _try_enter — [last]
+   refs see the captures BEFORE the cloning event, on both engines);
+   an open count's successor must be a plain stream node (fall back
+   otherwise);
  - capture references limited to first (``ref.attr``/``ref[0]``) and
    last (``ref[last]``) events of a count state;
  - numeric attributes only (string keys are interned to partition ids
@@ -129,6 +143,7 @@ class DensePatternEngine:
         mesh=None,
         partition_axis: str = "p",
         is_sequence: bool = False,
+        n_instances: int = 4,
     ):
         import jax
         import jax.numpy as jnp
@@ -144,6 +159,11 @@ class DensePatternEngine:
         self.mesh = mesh
         self.partition_axis = partition_axis
         self.S = len(nodes)
+        # sequences keep one pending per state (reference
+        # StreamPreStateProcessor.addState:217-223); non-every patterns
+        # arm exactly one chain — the instance axis only matters for
+        # overlapping `every` arms
+        self.I = 1 if (is_sequence or not every_start) else max(int(n_instances), 1)
         if self.S > 32:
             raise SiddhiAppCreationError("dense NFA supports at most 32 chain nodes")
         for n in nodes:
@@ -159,6 +179,24 @@ class DensePatternEngine:
         self._compile_filters(stream_to_ref)
         self._warn_integer_precision()
         self._compile_outputs(select_vars, stream_to_ref, select_names)
+        # open-ended counts stay dually pending: they capture more events
+        # after satisfaction and clone per successor-matching event (the
+        # via-path in the step, carrying clone-time registers exactly
+        # like the reference's _try_enter).  The via-path models one
+        # capture+advance, so an open count's successor must be a plain
+        # stream node.
+        for ni, n in enumerate(nodes):
+            is_count = not (n.min_count == 1 and n.max_count == 1)
+            open_count = is_count and (n.max_count == ANY or n.max_count > n.min_count)
+            if not open_count:
+                continue
+            if ni + 1 < len(nodes):
+                nxt = nodes[ni + 1]
+                if not (nxt.kind == "stream" and nxt.min_count == 1
+                        and nxt.max_count == 1):
+                    raise SiddhiAppCreationError(
+                        "dense NFA: open-ended count followed by a "
+                        "count/logical node needs the host engine")
         # capture slots each node writes — computed after BOTH filter and
         # output compilation so select-only slots get written too
         self.node_writes: List[List[RegSlot]] = []
@@ -242,34 +280,49 @@ class DensePatternEngine:
         is selected."""
         # one scratch row (index P) absorbs padded/invalid batch rows so
         # their scatter-back cannot collide with a real partition
-        P, S, R = self.n_partitions + 1, self.S, max(self.alloc.n, 1)
-        active0 = np.zeros(P, dtype=np.uint32)
+        P, S, I, R = (self.n_partitions + 1, self.S, self.I,
+                      max(self.alloc.n, 1))
+        active0 = np.zeros((P, S, I), dtype=bool)
         if not self.every_start:
-            # non-every: node 0 armed once per partition; after a match
-            # reset_on_emit clears it and the partition's automaton is done
-            active0 |= np.uint32(1)
+            # non-every: node 0 armed once per partition (lane 0); after
+            # a match reset_on_emit clears it and the automaton is done
+            active0[:, 0, 0] = True
         return {
             "active": active0,
             # relative ms since self.base_ts (int32: ~24 days of horizon),
             # 0 == unset
-            "first_ts": np.zeros((P, S), dtype=np.int32),
-            "counts": np.zeros((P, S), dtype=np.int32),
-            "regs": np.zeros((P, S, R), dtype=np.float32),
+            "first_ts": np.zeros((P, S, I), dtype=np.int32),
+            "counts": np.zeros((P, S, I), dtype=np.int32),
+            "regs": np.zeros((P, S, I, R), dtype=np.float32),
+            # per-partition dropped-instance count (successor slots full)
+            "overflow": np.zeros(P, dtype=np.int32),
+        }
+
+    def state_pspecs(self):
+        """Partition-axis sharding spec per state array (row-sharded;
+        trailing node/instance/register dims replicated)."""
+        from jax.sharding import PartitionSpec as Pspec
+
+        a = self.partition_axis
+        return {
+            "active": Pspec(a, None, None),
+            "first_ts": Pspec(a, None, None),
+            "counts": Pspec(a, None, None),
+            "regs": Pspec(a, None, None, None),
+            "overflow": Pspec(a),
         }
 
     def init_state(self):
         jnp = self.jnp
         state = {k: jnp.asarray(v) for k, v in self.init_state_host().items()}
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+            from jax.sharding import NamedSharding
 
-            shardings = {
-                "active": NamedSharding(self.mesh, Pspec(self.partition_axis)),
-                "first_ts": NamedSharding(self.mesh, Pspec(self.partition_axis, None)),
-                "counts": NamedSharding(self.mesh, Pspec(self.partition_axis, None)),
-                "regs": NamedSharding(self.mesh, Pspec(self.partition_axis, None, None)),
+            specs = self.state_pspecs()
+            state = {
+                k: self.jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+                for k, v in state.items()
             }
-            state = {k: self.jax.device_put(v, shardings[k]) for k, v in state.items()}
         return state
 
     # -- step ---------------------------------------------------------------
@@ -278,7 +331,19 @@ class DensePatternEngine:
         """Build the step for events of one source stream.
 
         step(state, part_idx[B] i32, cols {attr: [B] f32}, ts[B] i32
-             relative-ms, valid[B] bool) -> (state, emit[B], out_vals[B, n_out])
+             relative-ms, valid[B] bool)
+          -> (state, emit[B, I] bool, out_vals[B, I, n_out] f32,
+              emit_anchor[B, I] i32)
+
+        ``emit[b, i]``: a pending instance of event ``b``'s partition
+        completed the chain on this event.  The emit arrays carry 2*I
+        lanes: [0, I) for instances completing AT the last node, [I, 2I)
+        for via-path clones (a dually-pending count's clone passing
+        straight through the last node) — the two can fire on the same
+        event at the same lane index, so they must not share a bank.
+        ``emit_anchor`` carries each match's within-anchor (relative ms)
+        so the host wrapper can order same-event matches by arming age,
+        matching the reference's pendingStateEventList iteration order.
 
         ``jit=False`` returns the raw traceable function (for embedding in
         shard_map / outer jit).
@@ -288,43 +353,58 @@ class DensePatternEngine:
             return self._step_cache[cache_key]
         jnp = self.jnp
         S = self.S
+        I = self.I
         nodes = self.nodes
         node_filters = self.node_filters
         within = self.within_ms
         every_start = self.every_start
         reset_on_emit = self.reset_on_emit
         is_sequence = self.is_sequence
-        R = max(self.alloc.n, 1)
         out_spec = self.out_spec
+        O = max(len(out_spec), 1)
 
-        def env_for(node_idx, cols, ts, regs_b, spec_idx=0):
+        def env_for(node_idx, cols, ts, regs_b, spec_idx=0, regs_node=None):
+            """Filter env over [B, I] lanes: candidate columns broadcast
+            down the instance axis; registers are per-instance.
+            ``regs_node`` overrides which node's register lanes feed the
+            env (the via-path evaluates node t's filter against the
+            dually-pending source registers at t-1)."""
             env = {}
             spec = nodes[node_idx].specs[spec_idx]
+            rn = node_idx if regs_node is None else regs_node
             for a in spec.stream_def.attribute_names:
                 if a in cols:
-                    env["__cand." + a] = cols[a]
+                    env["__cand." + a] = cols[a][:, None]
             for slot in self.alloc.slots.values():
-                env[f"__reg.{slot.index}"] = regs_b[:, node_idx, slot.index]
-            env[TS_KEY] = ts
+                env[f"__reg.{slot.index}"] = regs_b[:, rn, :, slot.index]
+            env[TS_KEY] = ts[:, None]
             env[N_KEY] = ts.shape[0]
             return env
 
+        def eval_ok(s, si, cols, ts, regs, B):
+            f = node_filters[s][si]
+            if f is None:
+                return jnp.ones((B, I), dtype=bool)
+            return jnp.broadcast_to(
+                jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool),
+                (B, I))
+
         def step(state, part_idx, cols, ts, valid):
-            active_all = state["active"]
             B = part_idx.shape[0]
-            a = active_all[part_idx]  # [B] uint32
-            first = state["first_ts"][part_idx]  # [B, S]
-            counts = state["counts"][part_idx]  # [B, S]
-            regs = state["regs"][part_idx]  # [B, S, R]
-            emit = jnp.zeros(B, dtype=bool)
-            out_vals = jnp.zeros((B, max(len(out_spec), 1)), dtype=jnp.float32)
+            a = state["active"][part_idx]        # [B, S, I] bool
+            first = state["first_ts"][part_idx]  # [B, S, I]
+            counts = state["counts"][part_idx]   # [B, S, I]
+            regs = state["regs"][part_idx]       # [B, S, I, R]
+            ovf = state["overflow"][part_idx]    # [B]
+            emit = jnp.zeros((B, 2 * I), dtype=bool)
+            out_vals = jnp.zeros((B, 2 * I, O), dtype=jnp.float32)
+            emit_anchor = jnp.zeros((B, 2 * I), dtype=jnp.int32)
 
             # within-window expiry: clear expired instances (active bits,
             # in-progress counts and logical side masks alike)
             if within is not None:
-                expired = (first > 0) & (ts[:, None] - first > within)  # [B,S]
-                for s in range(S):
-                    a = jnp.where(expired[:, s], a & ~jnp.uint32(1 << s), a)
+                expired = (first > 0) & (ts[:, None, None] - first > within)
+                a = a & ~expired
                 counts = jnp.where(expired, 0, counts)
                 first = jnp.where(expired, 0, first)
 
@@ -339,23 +419,13 @@ class DensePatternEngine:
                     for si, sp in enumerate(node.specs):
                         if sp.stream_key != stream_key:
                             oks.append(None)
-                            continue
-                        f = node_filters[s][si]
-                        oks.append(
-                            jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool)
-                            if f is not None
-                            else jnp.ones(B, dtype=bool)
-                        )
+                        else:
+                            oks.append(eval_ok(s, si, cols, ts, regs, B))
                     ok_pre.append(oks)
                 elif node.specs[0].stream_key != stream_key:
                     ok_pre.append(None)
                 else:
-                    f = node_filters[s][0]
-                    ok_pre.append(
-                        jnp.asarray(f.fn(env_for(s, cols, ts, regs))).astype(bool)
-                        if f is not None
-                        else jnp.ones(B, dtype=bool)
-                    )
+                    ok_pre.append(eval_ok(s, 0, cols, ts, regs, B))
 
             if is_sequence:
                 # strict continuity (reference: SEQUENCE keeps one pending
@@ -366,175 +436,344 @@ class DensePatternEngine:
                 for s in range(1, S):
                     ok_s = ok_pre[s]
                     if isinstance(ok_s, list):
-                        m = jnp.zeros(B, dtype=bool)
+                        m = jnp.zeros((B, I), dtype=bool)
                         for o in ok_s:
                             if o is not None:
                                 m = m | o
                     elif ok_s is None:
-                        m = jnp.zeros(B, dtype=bool)
+                        m = jnp.zeros((B, I), dtype=bool)
                     else:
                         m = ok_s
-                    had = ((a >> s) & 1).astype(bool)
-                    kill = had & ~m & valid
-                    a = jnp.where(kill, a & ~jnp.uint32(1 << s), a)
-                    counts = counts.at[:, s].set(jnp.where(kill, 0, counts[:, s]))
-                    first = first.at[:, s].set(jnp.where(kill, 0, first[:, s]))
+                    kill = a[:, s, :] & ~m & valid[:, None]
+                    a = a.at[:, s, :].set(a[:, s, :] & ~kill)
+                    counts = counts.at[:, s, :].set(
+                        jnp.where(kill, 0, counts[:, s, :]))
+                    first = first.at[:, s, :].set(
+                        jnp.where(kill, 0, first[:, s, :]))
 
-            for s in reversed(range(S)):
-                node = nodes[s]
-                spec = node.specs[0]
-                if node.kind == "logical":
-                    sides = [i for i, sp in enumerate(node.specs) if sp.stream_key == stream_key]
-                    if not sides:
-                        continue
-                    pending = ((a >> s) & 1).astype(bool)
-                    if s == 0 and every_start:
-                        pending = jnp.ones_like(pending)
-                    for si in sides:
-                        ok = ok_pre[s][si]
-                        fire = pending & ok & valid
-                        # record side in counts bitfield
-                        counts = counts.at[:, s].set(
-                            jnp.where(fire, counts[:, s] | (1 << si), counts[:, s])
-                        )
-                        # capture this side's slots
-                        for slot in self.node_writes[s]:
-                            if slot.ref == node.specs[si].ref and slot.attr in cols:
-                                regs = regs.at[:, s, slot.index].set(
-                                    jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
-                                )
-                        if s == 0 and every_start:
-                            # fresh arming each event: the within anchor
-                            # must be this event's ts, not a stale one
-                            first = first.at[:, s].set(
-                                jnp.where(fire & (counts[:, s] == (1 << si)), ts, first[:, s])
-                            )
-                        else:
-                            first = first.at[:, s].set(
-                                jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
-                            )
-                    need = (
-                        (counts[:, s] & ((1 << len(node.specs)) - 1))
-                        if node.logical_op == "and"
-                        else counts[:, s]
-                    )
-                    complete = (
-                        (need == (1 << len(node.specs)) - 1)
-                        if node.logical_op == "and"
-                        else (need > 0)
-                    ) & pending & valid
-                    a, first, counts, regs, emit, out_vals = _advance(
-                        s, complete, a, first, counts, regs, emit, out_vals, cols, ts
-                    )
-                    continue
-                if spec.stream_key != stream_key:
-                    continue
-                pending = ((a >> s) & 1).astype(bool)
-                if s == 0 and every_start:
-                    pending = jnp.ones_like(pending)
-                fire = pending & ok_pre[s] & valid
-                is_count = not (node.min_count == 1 and node.max_count == 1)
-                if is_count:
-                    below_max = (node.max_count == ANY) | (counts[:, s] < node.max_count)
-                    cap = fire & below_max
-                    first_cap = cap & (counts[:, s] == 0)
-                    counts = counts.at[:, s].set(jnp.where(cap, counts[:, s] + 1, counts[:, s]))
-                    for slot in self.node_writes[s]:
-                        if slot.ref != spec.ref or slot.attr not in cols:
-                            continue
-                        upd = cap if slot.last else first_cap
-                        regs = regs.at[:, s, slot.index].set(
-                            jnp.where(upd, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
-                        )
-                    if s == 0 and every_start:
-                        first = first.at[:, s].set(
-                            jnp.where(first_cap, ts, first[:, s])
-                        )
-                    else:
-                        first = first.at[:, s].set(
-                            jnp.where(first_cap & (first[:, s] == 0), ts, first[:, s])
-                        )
-                    advance = cap & (counts[:, s] == max(node.min_count, 1))
-                    a, first, counts, regs, emit, out_vals = _advance(
-                        s, advance, a, first, counts, regs, emit, out_vals, cols, ts
-                    )
-                else:
-                    # capture the node's slots where firing
-                    for slot in self.node_writes[s]:
-                        if slot.ref != spec.ref or slot.attr not in cols:
-                            continue
-                        regs = regs.at[:, s, slot.index].set(
-                            jnp.where(fire, cols[slot.attr].astype(jnp.float32), regs[:, s, slot.index])
-                        )
-                    if s == 0 and every_start:
-                        first = first.at[:, s].set(jnp.where(fire, ts, first[:, s]))
-                    else:
-                        first = first.at[:, s].set(
-                            jnp.where(fire & (first[:, s] == 0), ts, first[:, s])
-                        )
-                    # sequences keep the start node armed (host semantics:
-                    # "the start node is kept armed"); reset_on_emit still
-                    # stops non-every sequences after their first match
-                    keep_armed = s == 0 and (every_start or is_sequence)
-                    if not keep_armed:
-                        a = jnp.where(fire, a & ~jnp.uint32(1 << s), a)
-                    a, first, counts, regs, emit, out_vals = _advance(
-                        s, fire, a, first, counts, regs, emit, out_vals, cols, ts
-                    )
-
-            # emission restart
-            if reset_on_emit:
-                a = jnp.where(emit, jnp.uint32(0), a)
-                counts = jnp.where(emit[:, None], 0, counts)
-                first = jnp.where(emit[:, None], 0, first)
-
-            # scatter back (valid rows only)
-            state = {
-                "active": state["active"].at[part_idx].set(
-                    jnp.where(valid, a, state["active"][part_idx])
-                ),
-                "first_ts": state["first_ts"].at[part_idx].set(
-                    jnp.where(valid[:, None], first, state["first_ts"][part_idx])
-                ),
-                "counts": state["counts"].at[part_idx].set(
-                    jnp.where(valid[:, None], counts, state["counts"][part_idx])
-                ),
-                "regs": state["regs"].at[part_idx].set(
-                    jnp.where(valid[:, None, None], regs, state["regs"][part_idx])
-                ),
-            }
-            return state, emit, out_vals
-
-        def _advance(s, mask, a, first, counts, regs, emit, out_vals, cols, ts):
-            """Completing node s: set next bit (copy instance rows) or emit.
-
-            An occupied successor blocks the advance (oldest instance wins;
-            the host engine tracks overlapping instances instead — this is
-            the documented dense-mode approximation)."""
-            if s == S - 1:
-                emit = emit | mask
+            def _emit_rows(mask, anchor, src_regs, carry, bank=0):
+                """Instances in ``mask`` (with ``src_regs`` [B, I, R])
+                complete the chain on this event.  ``bank`` selects the
+                emit lane block (0: last-node completions, 1: via-path
+                clones) so same-lane fires from both never collide."""
+                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                lo = bank * I
+                sl = slice(lo, lo + I)
+                emit = emit.at[:, sl].set(emit[:, sl] | mask)
+                emit_anchor = emit_anchor.at[:, sl].set(
+                    jnp.where(mask, anchor, emit_anchor[:, sl]))
                 for oi, (_name, src) in enumerate(out_spec):
                     if isinstance(src, tuple):  # ('cand', attr)
                         val = cols.get(src[1])
                         if val is None:
                             continue
-                        out_vals = out_vals.at[:, oi].set(
-                            jnp.where(mask, val.astype(jnp.float32), out_vals[:, oi])
-                        )
+                        out_vals = out_vals.at[:, sl, oi].set(
+                            jnp.where(mask, val.astype(jnp.float32)[:, None],
+                                      out_vals[:, sl, oi]))
                     else:
-                        out_vals = out_vals.at[:, oi].set(
-                            jnp.where(mask, regs[:, s, src.index], out_vals[:, oi])
+                        out_vals = out_vals.at[:, sl, oi].set(
+                            jnp.where(mask, src_regs[:, :, src.index],
+                                      out_vals[:, sl, oi]))
+                return (a, first, counts, regs, emit, out_vals, emit_anchor,
+                        ovf)
+
+            def _place(mask, anchor, src_regs, t, carry):
+                """Move instances in ``mask`` into free lanes of node
+                ``t``.  Slot allocation is rank-matched (k-th advancing
+                instance takes the k-th free lane); advancers beyond the
+                free-lane count are dropped and counted in ``overflow`` —
+                explicit capacity where the reference grows an unbounded
+                list."""
+                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                free = ~a[:, t, :] & (counts[:, t, :] == 0)  # [B, I]
+                src_rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
+                free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+                n_free = jnp.sum(free.astype(jnp.int32), axis=1)  # [B]
+                placed = mask & (src_rank < n_free[:, None])
+                ovf = ovf + jnp.sum((mask & ~placed).astype(jnp.int32), axis=1)
+                # [B, Isrc, Itgt] one-hot assignment
+                assign = (placed[:, :, None] & free[:, None, :]
+                          & (src_rank[:, :, None] == free_rank[:, None, :]))
+                got = jnp.any(assign, axis=1)  # [B, I] target lanes filled
+                moved_regs = jnp.sum(
+                    jnp.where(assign[:, :, :, None], src_regs[:, :, None, :], 0.0),
+                    axis=1)  # [B, I, R]
+                moved_anchor = jnp.sum(
+                    jnp.where(assign, anchor[:, :, None], 0), axis=1)  # [B, I]
+                a = a.at[:, t, :].set(a[:, t, :] | got)
+                regs = regs.at[:, t, :, :].set(
+                    jnp.where(got[:, :, None], moved_regs, regs[:, t, :, :]))
+                first = first.at[:, t, :].set(
+                    jnp.where(got, moved_anchor.astype(jnp.int32),
+                              first[:, t, :]))
+                counts = counts.at[:, t, :].set(
+                    jnp.where(got, 0, counts[:, t, :]))
+                return (a, first, counts, regs, emit, out_vals, emit_anchor,
+                        ovf)
+
+            def _advance(s, mask, carry):
+                """Instances (lanes of node s) in ``mask`` complete node s:
+                emit (last node) or move into free lanes of node s+1."""
+                a, first, counts, regs = carry[0], carry[1], carry[2], carry[3]
+                anchor = jnp.where(first[:, s, :] > 0, first[:, s, :],
+                                   ts[:, None])  # [B, I]
+                if s == S - 1:
+                    return _emit_rows(mask, anchor, regs[:, s, :, :], carry)
+                return _place(mask, anchor, regs[:, s, :, :], s + 1, carry)
+
+            lane0 = jnp.zeros((B, I), dtype=bool).at[:, 0].set(True)
+            carry = (a, first, counts, regs, emit, out_vals, emit_anchor, ovf)
+            for s in reversed(range(S)):
+                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                node = nodes[s]
+                spec = node.specs[0]
+                if node.kind == "logical":
+                    sides = [i for i, sp in enumerate(node.specs)
+                             if sp.stream_key == stream_key]
+                    if not sides:
+                        carry = (a, first, counts, regs, emit, out_vals,
+                                 emit_anchor, ovf)
+                        continue
+                    pending = a[:, s, :]
+                    if s == 0 and every_start:
+                        # the standing virgin lives in lane 0
+                        pending = pending | lane0
+                    for si in sides:
+                        ok = ok_pre[s][si]
+                        # an already-matched side ignores further events
+                        # (the reference skips si in matched_sides —
+                        # neither registers nor the anchor may refresh)
+                        unmatched = (counts[:, s, :] & (1 << si)) == 0
+                        fire = pending & ok & valid[:, None] & unmatched
+                        counts = counts.at[:, s, :].set(
+                            jnp.where(fire, counts[:, s, :] | (1 << si),
+                                      counts[:, s, :]))
+                        for slot in self.node_writes[s]:
+                            if slot.ref == node.specs[si].ref and slot.attr in cols:
+                                regs = regs.at[:, s, :, slot.index].set(
+                                    jnp.where(
+                                        fire,
+                                        cols[slot.attr].astype(jnp.float32)[:, None],
+                                        regs[:, s, :, slot.index]))
+                        first = first.at[:, s, :].set(
+                            jnp.where(fire & (first[:, s, :] == 0), ts[:, None],
+                                      first[:, s, :]))
+                    need = (
+                        (counts[:, s, :] & ((1 << len(node.specs)) - 1))
+                        if node.logical_op == "and"
+                        else counts[:, s, :]
+                    )
+                    complete = (
+                        (need == (1 << len(node.specs)) - 1)
+                        if node.logical_op == "and"
+                        else (need > 0)
+                    ) & pending & valid[:, None]
+                    carry = _advance(s, complete,
+                                     (a, first, counts, regs, emit, out_vals,
+                                      emit_anchor, ovf))
+                    a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                    # a completed logical node releases its lane (the host
+                    # instance moves on); the lane-0 virgin re-arms fresh
+                    a = a.at[:, s, :].set(a[:, s, :] & ~complete)
+                    counts = counts.at[:, s, :].set(
+                        jnp.where(complete, 0, counts[:, s, :]))
+                    first = first.at[:, s, :].set(
+                        jnp.where(complete, 0, first[:, s, :]))
+                    carry = (a, first, counts, regs, emit, out_vals,
+                             emit_anchor, ovf)
+                    continue
+                if spec.stream_key != stream_key:
+                    carry = (a, first, counts, regs, emit, out_vals,
+                             emit_anchor, ovf)
+                    continue
+                is_count = not (node.min_count == 1 and node.max_count == 1)
+                pending = a[:, s, :]
+                if s == 0 and every_start:
+                    if is_count:
+                        # a fresh virgin arms only while no unsatisfied
+                        # counting instance exists (the host rearms at
+                        # satisfaction — StreamPostStateProcessor
+                        # addEveryState), taking the first free lane
+                        unsat = (a[:, 0, :] & (counts[:, 0, :] > 0)
+                                 & (counts[:, 0, :] < max(node.min_count, 1)))
+                        has_unsat = jnp.any(unsat, axis=1)  # [B]
+                        free0 = ~a[:, 0, :] & (counts[:, 0, :] == 0)
+                        vrank = jnp.cumsum(free0.astype(jnp.int32), axis=1) - 1
+                        virgin = free0 & (vrank == 0) & ~has_unsat[:, None]
+                        pending = pending | virgin
+                        # a virgin that SHOULD arm (no unsatisfied arm, the
+                        # event passes the start filter) but finds no free
+                        # lane is a dropped instance — count it (node-0
+                        # filters read candidate columns only, so lane 0
+                        # of ok is lane-uniform)
+                        no_lane = (~has_unsat & ~jnp.any(free0, axis=1)
+                                   & ok_pre[s][:, 0] & valid)
+                        ovf = ovf + no_lane.astype(jnp.int32)
+                    else:
+                        # simple start never rests: the standing virgin
+                        # fires straight through lane 0 on every event
+                        pending = pending | lane0
+                fire = pending & ok_pre[s] & valid[:, None]
+                if is_count:
+                    below_max = (node.max_count == ANY) | (counts[:, s, :] < node.max_count)
+                    cap = fire & below_max
+                    first_cap = cap & (counts[:, s, :] == 0)
+                    counts = counts.at[:, s, :].set(
+                        jnp.where(cap, counts[:, s, :] + 1, counts[:, s, :]))
+                    # a counting lane is occupied from its first capture
+                    a = a.at[:, s, :].set(a[:, s, :] | first_cap)
+                    for slot in self.node_writes[s]:
+                        if slot.ref != spec.ref or slot.attr not in cols:
+                            continue
+                        upd = cap if slot.last else first_cap
+                        regs = regs.at[:, s, :, slot.index].set(
+                            jnp.where(upd,
+                                      cols[slot.attr].astype(jnp.float32)[:, None],
+                                      regs[:, s, :, slot.index]))
+                    first = first.at[:, s, :].set(
+                        jnp.where(first_cap & (first[:, s, :] == 0), ts[:, None],
+                                  first[:, s, :]))
+                    open_count = (node.max_count == ANY
+                                  or node.max_count > node.min_count)
+                    advance = cap & (counts[:, s, :] == max(node.min_count, 1))
+                    if not open_count or s == S - 1:
+                        # exact counts ({n}) move at min==max; a count
+                        # LAST node emits once at satisfaction
+                        # (emitted_at_node semantics — later captures
+                        # don't re-emit because advance fires at == min)
+                        carry = _advance(s, advance,
+                                         (a, first, counts, regs, emit,
+                                          out_vals, emit_anchor, ovf))
+                        a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                    # lane lifecycle at max: exact counts are spent (their
+                    # advance already placed the instance); open counts
+                    # MOVE the still-pending instance to s+1 at max
+                    # (reference _try_capture: count >= max ->
+                    # _enter_node(pos+1)); its clones already advanced via
+                    # the via-path at earlier successor events
+                    if node.max_count != ANY:
+                        at_max = cap & (counts[:, s, :] >= node.max_count)
+                        if open_count and s < S - 1:
+                            anchor_s = jnp.where(
+                                first[:, s, :] > 0, first[:, s, :], ts[:, None])
+                            carry = _place(at_max, anchor_s, regs[:, s, :, :],
+                                           s + 1,
+                                           (a, first, counts, regs, emit,
+                                            out_vals, emit_anchor, ovf))
+                            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                        a = a.at[:, s, :].set(a[:, s, :] & ~at_max)
+                        counts = counts.at[:, s, :].set(
+                            jnp.where(at_max, 0, counts[:, s, :]))
+                        first = first.at[:, s, :].set(
+                            jnp.where(at_max, 0, first[:, s, :]))
+                    carry = (a, first, counts, regs, emit, out_vals,
+                             emit_anchor, ovf)
+                else:
+                    # capture the node's slots for real pending lanes
+                    for slot in self.node_writes[s]:
+                        if slot.ref != spec.ref or slot.attr not in cols:
+                            continue
+                        regs = regs.at[:, s, :, slot.index].set(
+                            jnp.where(fire,
+                                      cols[slot.attr].astype(jnp.float32)[:, None],
+                                      regs[:, s, :, slot.index]))
+                    if s == 0 and every_start:
+                        # fresh arming each event: the within anchor must
+                        # be this event's ts, not a stale one
+                        first = first.at[:, s, :].set(
+                            jnp.where(fire, ts[:, None], first[:, s, :]))
+                    else:
+                        first = first.at[:, s, :].set(
+                            jnp.where(fire & (first[:, s, :] == 0), ts[:, None],
+                                      first[:, s, :]))
+                    # sequences keep the start node armed (host semantics:
+                    # "the start node is kept armed"); reset_on_emit still
+                    # stops non-every sequences after their first match
+                    keep_armed = s == 0 and (every_start or is_sequence)
+                    if not keep_armed:
+                        a = a.at[:, s, :].set(a[:, s, :] & ~fire)
+                    carry = _advance(s, fire,
+                                     (a, first, counts, regs, emit, out_vals,
+                                      emit_anchor, ovf))
+                    # via-path: a dually-pending open count at s-1 clones
+                    # straight through this node on the same event
+                    # (reference: _try_enter from a satisfied count
+                    # instance; StreamPreStateProcessor dual pending)
+                    if s >= 1:
+                        prev = nodes[s - 1]
+                        prev_open = (
+                            prev.kind == "stream"
+                            and not (prev.min_count == 1 and prev.max_count == 1)
+                            and (prev.max_count == ANY
+                                 or prev.max_count > prev.min_count)
                         )
-                return a, first, counts, regs, emit, out_vals
-            occupied = (((a >> (s + 1)) & 1) > 0) | (counts[:, s + 1] > 0)
-            mask = mask & ~occupied
-            a = jnp.where(mask, a | jnp.uint32(1 << (s + 1)), a)
-            regs = regs.at[:, s + 1, :].set(
-                jnp.where(mask[:, None], regs[:, s, :], regs[:, s + 1, :])
-            )
-            first = first.at[:, s + 1].set(jnp.where(mask, jnp.where(first[:, s] > 0, first[:, s], ts), first[:, s + 1]))
-            counts = counts.at[:, s + 1].set(jnp.where(mask, 0, counts[:, s + 1]))
-            return a, first, counts, regs, emit, out_vals
+                        if prev_open:
+                            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                            sat = (a[:, s - 1, :]
+                                   & (counts[:, s - 1, :] >= max(prev.min_count, 1)))
+                            if prev.max_count != ANY:
+                                sat = sat & (counts[:, s - 1, :] < prev.max_count)
+                            ok_via = (
+                                jnp.broadcast_to(jnp.asarray(
+                                    node_filters[s][0].fn(
+                                        env_for(s, cols, ts, regs,
+                                                regs_node=s - 1))).astype(bool),
+                                    (B, I))
+                                if node_filters[s][0] is not None
+                                else jnp.ones((B, I), dtype=bool)
+                            )
+                            fire_via = sat & ok_via & valid[:, None]
+                            via_regs = regs[:, s - 1, :, :]
+                            for slot in self.node_writes[s]:
+                                if slot.ref != spec.ref or slot.attr not in cols:
+                                    continue
+                                via_regs = via_regs.at[:, :, slot.index].set(
+                                    jnp.where(
+                                        fire_via,
+                                        cols[slot.attr].astype(jnp.float32)[:, None],
+                                        via_regs[:, :, slot.index]))
+                            via_anchor = jnp.where(
+                                first[:, s - 1, :] > 0, first[:, s - 1, :],
+                                ts[:, None])
+                            carry = (a, first, counts, regs, emit, out_vals,
+                                     emit_anchor, ovf)
+                            if s == S - 1:
+                                carry = _emit_rows(fire_via, via_anchor,
+                                                   via_regs, carry, bank=1)
+                            else:
+                                carry = _place(fire_via, via_anchor, via_regs,
+                                               s + 1, carry)
+
+            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+
+            # emission restart
+            if reset_on_emit:
+                any_emit = jnp.any(emit, axis=1)
+                a = jnp.where(any_emit[:, None, None], False, a)
+                counts = jnp.where(any_emit[:, None, None], 0, counts)
+                first = jnp.where(any_emit[:, None, None], 0, first)
+
+            # scatter back (valid rows only)
+            v1 = valid[:, None, None]
+            state = {
+                "active": state["active"].at[part_idx].set(
+                    jnp.where(v1, a, state["active"][part_idx])
+                ),
+                "first_ts": state["first_ts"].at[part_idx].set(
+                    jnp.where(v1, first, state["first_ts"][part_idx])
+                ),
+                "counts": state["counts"].at[part_idx].set(
+                    jnp.where(v1, counts, state["counts"][part_idx])
+                ),
+                "regs": state["regs"].at[part_idx].set(
+                    jnp.where(valid[:, None, None, None], regs,
+                              state["regs"][part_idx])
+                ),
+                "overflow": state["overflow"].at[part_idx].set(
+                    jnp.where(valid, ovf, state["overflow"][part_idx])
+                ),
+            }
+            return state, emit, out_vals, emit_anchor
 
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[cache_key] = fn
@@ -572,7 +811,7 @@ class DensePatternEngine:
                 "horizon exceeds the int32 relative-time range")
         self.base_ts += delta
         rel64 = rel64 - delta
-        first = np.asarray(state["first_ts"]).astype(np.int64)
+        first = np.asarray(state["first_ts"]).astype(np.int64)  # [P, S, I]
         shifted = np.where(first > 0, first - delta, 0)
         if self.within_ms is not None:
             # anchors at/below the new zero were expired before the shift
@@ -580,8 +819,7 @@ class DensePatternEngine:
             active = np.asarray(state["active"]).copy()
             counts = np.asarray(state["counts"]).copy()
             if dead.any():
-                for s in range(self.S):
-                    active[dead[:, s]] &= ~np.uint32(1 << s)
+                active[dead] = False
                 counts[dead] = 0
                 shifted = np.where(dead, 0, shifted)
         else:
@@ -596,13 +834,9 @@ class DensePatternEngine:
             # keep the partition-axis sharding init_state applied — a
             # plain jnp.asarray would silently collapse state onto the
             # default device after a re-anchor
-            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+            from jax.sharding import NamedSharding
 
-            specs = {
-                "active": Pspec(self.partition_axis),
-                "first_ts": Pspec(self.partition_axis, None),
-                "counts": Pspec(self.partition_axis, None),
-            }
+            specs = self.state_pspecs()
             conv = lambda k, v: self.jax.device_put(
                 v, NamedSharding(self.mesh, specs[k]))
         else:
@@ -616,19 +850,25 @@ class DensePatternEngine:
     def process(self, state, stream_key: str, part_idx: np.ndarray, cols: Dict[str, np.ndarray], ts: np.ndarray):
         """Process a batch, splitting rounds so each partition appears at
         most once per step (scatter collisions would race).  Rounds are
-        padded to powers of two to bound jit recompilation."""
+        padded to powers of two to bound jit recompilation.
+
+        Returns ``(state, match_ev_idx, match_out)``: one row per match,
+        ``match_ev_idx[m]`` the batch-row index of the completing event
+        (ascending; same-event matches ordered by arming age, mirroring
+        the reference's pendingStateEventList iteration order) and
+        ``match_out[m, n_out]`` its output values."""
         jnp = self.jnp
         step = self.make_step(stream_key)
         rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
         state, rel64 = self.maybe_re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
         n = len(part_idx)
-        emit_all = np.zeros(n, dtype=bool)
-        out_all = np.zeros((n, max(len(self.out_spec), 1)), dtype=np.float32)
+        ev_parts: List[np.ndarray] = []
+        out_parts: List[np.ndarray] = []
+        key_parts: List[np.ndarray] = []  # (ev, anchor, lane) sort keys
         for ridx in _collision_rounds(part_idx):
             b = len(ridx)
             bp = max(1 << (b - 1).bit_length(), 16)  # pad to pow2, min 16
-            pad = bp - b
             pi = np.full(bp, self.n_partitions, dtype=np.int32)  # scratch row
             pi[:b] = part_idx[ridx]
             tb = np.zeros(bp, dtype=np.int32)
@@ -640,18 +880,25 @@ class DensePatternEngine:
                 col = np.zeros(bp, dtype=np.float32)
                 col[:b] = v[ridx].astype(np.float32)
                 cb[k] = jnp.asarray(col)
-            state, emit, out_vals = step(
+            state, emit, out_vals, emit_anchor = step(
                 state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
             )
             # device->host: fetch the emit mask, then the output values
             # only when something matched — matches are rare in CEP, so
             # the common batch costs ONE transfer round trip, not two
             # (transfers are expensive on tunneled/remote devices)
-            emit_np = np.asarray(emit)[:b]
-            emit_all[ridx] = emit_np
+            emit_np = np.asarray(emit)[:b]  # [b, I]
             if emit_np.any():
-                out_all[ridx] = np.asarray(out_vals)[:b]
-        return state, emit_all, out_all
+                out_np = np.asarray(out_vals)[:b]
+                anchor_np = np.asarray(emit_anchor)[:b]
+                rows, lanes = np.nonzero(emit_np)
+                ev_parts.append(ridx[rows])
+                out_parts.append(out_np[rows, lanes])
+                key_parts.append(np.stack(
+                    [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
+        ev, out = flatten_match_parts(
+            ev_parts, out_parts, key_parts, max(len(self.out_spec), 1))
+        return state, ev, out
 
     @property
     def output_names(self) -> List[str]:
@@ -685,6 +932,22 @@ class DensePatternEngine:
         raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
 
 
+def flatten_match_parts(ev_parts, out_parts, key_parts, n_out: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Concatenate per-round match fragments and order them by
+    (event index, arming anchor, lane) — the single definition of the
+    match-ordering contract, shared by the unsharded and sharded
+    wrappers."""
+    if not ev_parts:
+        return (np.empty(0, dtype=np.int64),
+                np.empty((0, n_out), dtype=np.float32))
+    ev = np.concatenate(ev_parts)
+    out = np.concatenate(out_parts)
+    keys = np.concatenate(key_parts)
+    order = np.lexsort((keys[:, 2], keys[:, 1], keys[:, 0]))
+    return ev[order].astype(np.int64), out[order]
+
+
 def _collision_rounds(part_idx: np.ndarray) -> List[np.ndarray]:
     """Split indices into rounds where each partition appears at most once,
     preserving per-partition order."""
@@ -712,6 +975,7 @@ def compile_pattern(
     n_partitions: int = 1024,
     mesh=None,
     every_start: Optional[bool] = None,
+    n_instances: int = 4,
 ):
     """Compile a SiddhiQL pattern query into a DensePatternEngine.
 
@@ -770,4 +1034,5 @@ def compile_pattern(
         every_start=every_start,
         mesh=mesh,
         is_sequence=is_sequence,
+        n_instances=n_instances,
     )

@@ -183,19 +183,21 @@ class ShardedPatternEngine:
         jnp = engine.jnp
         a = axis_name
 
+        # row-sharded on this wrapper's axis name; trailing
+        # node/instance/register dims replicated.  Ranks come from the
+        # engine's own pspecs (len == ndim) — no throwaway host
+        # allocation of the full state just to read shapes.
         self.state_specs = {
-            "active": P(a),
-            "first_ts": P(a, None),
-            "counts": P(a, None),
-            "regs": P(a, None, None),
+            k: P(a, *([None] * (len(spec) - 1)))
+            for k, spec in engine.state_pspecs().items()
         }
         specs = self.state_specs
 
         def sharded_step(state, part, cols, ts, valid):
-            new_state, emit, out_vals = step(state, part, cols, ts, valid)
+            new_state, emit, out_vals, anchor = step(state, part, cols, ts, valid)
             local = jnp.sum(emit.astype(jnp.int32))
             total = jax.lax.psum(local, axis_name=a)
-            return new_state, emit, out_vals, total
+            return new_state, emit, out_vals, anchor, total
 
         # donate the state pytree: at 1M+ partitions the rows dominate
         # HBM and double-buffering them would halve capacity
@@ -204,7 +206,7 @@ class ShardedPatternEngine:
             mesh=mesh,
             in_specs=(specs, P(a), {k: P(a) for k in self.col_keys},
                       P(a), P(a)),
-            out_specs=(specs, P(a), P(a, None), P()),
+            out_specs=(specs, P(a, None), P(a, None, None), P(a, None), P()),
         ), donate_argnums=(0,))
         self._P = P
         self._NamedSharding = NamedSharding
@@ -255,7 +257,8 @@ class ShardedPatternEngine:
         ), pos
 
     def step(self, state, part, cols, ts, valid):
-        """One sharded step: ``(state', emit_mask, out_vals, global_matches)``.
+        """One sharded step: ``(state', emit[B, I], out_vals[B, I, O],
+        emit_anchor[B, I], global_matches)``.
 
         The input ``state`` is DONATED (its device buffers are consumed
         on real hardware — snapshot it before stepping if needed; always
@@ -267,9 +270,9 @@ class ShardedPatternEngine:
                 ts: np.ndarray):
         """Safe batch entry point mirroring DensePatternEngine.process:
         splits rounds so each partition appears at most once per step,
-        normalizes timestamps, and maps per-event emit/out rows back to
-        input order.  Returns ``(state, emit[n] bool, out[n, n_out],
-        total_matches)``."""
+        normalizes timestamps, and flattens per-instance matches back to
+        input order.  Returns ``(state, match_ev_idx[m], out[m, n_out],
+        total_matches)`` with same-event matches ordered by arming age."""
         from siddhi_tpu.ops.dense_nfa import _collision_rounds
 
         part = np.asarray(part)
@@ -278,9 +281,9 @@ class ShardedPatternEngine:
             state, rel64,
             to_device=lambda k, v: self._put(v, self.state_specs[k]))
         rel = rel64.astype(np.int32)
-        n = len(part)
-        emit_all = np.zeros(n, dtype=bool)
-        out_all = np.zeros((n, max(len(self.engine.out_spec), 1)), dtype=np.float32)
+        ev_parts: List[np.ndarray] = []
+        out_parts: List[np.ndarray] = []
+        key_parts: List[np.ndarray] = []
         total = 0
         for ridx in _collision_rounds(part):
             args, pos = self.route(
@@ -288,10 +291,20 @@ class ShardedPatternEngine:
                 {k: np.asarray(v)[ridx] for k, v in cols.items()},
                 rel[ridx],
             )
-            state, emit, out_vals, round_total = self.step(state, *args)
-            emit_np = np.asarray(emit)
-            out_np = np.asarray(out_vals)
-            emit_all[ridx] = emit_np[pos]
-            out_all[ridx] = out_np[pos]
+            state, emit, out_vals, anchor, round_total = self.step(state, *args)
             total += int(round_total)
-        return state, emit_all, out_all, total
+            emit_np = np.asarray(emit)[pos]  # [b, I]
+            if emit_np.any():
+                out_np = np.asarray(out_vals)[pos]
+                anchor_np = np.asarray(anchor)[pos]
+                rows, lanes = np.nonzero(emit_np)
+                ev_parts.append(ridx[rows])
+                out_parts.append(out_np[rows, lanes])
+                key_parts.append(np.stack(
+                    [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
+        from siddhi_tpu.ops.dense_nfa import flatten_match_parts
+
+        ev, out = flatten_match_parts(
+            ev_parts, out_parts, key_parts,
+            max(len(self.engine.out_spec), 1))
+        return state, ev, out, total

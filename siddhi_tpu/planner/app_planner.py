@@ -80,6 +80,17 @@ class AppPlanner:
                         f"@app:execution: partitions='{parts}' must be a "
                         "positive integer")
                 self.app_context.tpu_partitions = n
+            insts = exec_ann.element("instances")
+            if insts:
+                try:
+                    ni = int(insts)
+                except ValueError:
+                    ni = -1
+                if ni < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: instances='{insts}' must be a "
+                        "positive integer")
+                self.app_context.tpu_instances = ni
 
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 

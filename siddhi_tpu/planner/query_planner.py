@@ -423,7 +423,8 @@ class QueryPlanner:
         if n_partitions is None:
             n_partitions = 1 if key_fn is None else self.app.app_context.tpu_partitions
         engine = build_dense_engine(
-            query, st, self.app.resolve_stream_definition, n_partitions)
+            query, st, self.app.resolve_stream_definition, n_partitions,
+            n_instances=self.app.app_context.tpu_instances)
 
         sel = query.selector
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"

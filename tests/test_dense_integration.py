@@ -171,12 +171,14 @@ class TestDensePartition:
             (["c1", 150.0], 1000),
             (["c2", 500.0], 1100),
             (["c1", 200.0], 2000),   # completes c1
-            (["c2", 400.0], 2100),   # not > 500
-            (["c2", 600.0], 2200),   # completes c2
+            (["c2", 400.0], 2100),   # not b for 500; arms its own 'every'
+            (["c2", 600.0], 2200),   # completes BOTH c2 arms (500 and 400)
         ])
         pr = rt.partitions["partition_0"]
         assert pr.is_dense
-        assert got == [[150.0, 200.0], [500.0, 600.0]]
+        # host-exact since the instance axis: overlapping every arms both
+        # match (arming-age order), where the old engine dropped [400, 600]
+        assert got == [[150.0, 200.0], [500.0, 600.0], [400.0, 600.0]]
         runtime = next(iter(pr.dense_query_runtimes.values())).pattern_processor
         assert runtime.step_invocations == 5
         assert len(runtime._key_rows) == 2

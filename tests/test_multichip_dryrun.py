@@ -22,8 +22,8 @@ def test_entry_compiles_and_steps():
     from __graft_entry__ import entry
 
     fn, args = entry()
-    out_state, emit, out_vals = jax.jit(fn)(*args)
-    assert set(out_state) == {"active", "first_ts", "counts", "regs"}
+    out_state, emit, out_vals, emit_anchor = jax.jit(fn)(*args)
+    assert set(out_state) == {"active", "first_ts", "counts", "regs", "overflow"}
     assert np.asarray(emit).dtype == bool
 
 
