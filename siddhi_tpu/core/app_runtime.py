@@ -139,6 +139,17 @@ class SiddhiAppRuntime:
         self.scheduler.stop()
         for j in self.junctions.values():
             j.stop()
+        # final dense-overflow check so short-lived apps still surface
+        # dropped-instance warnings
+        for qr in self.query_runtimes.values():
+            pp = getattr(qr, "pattern_processor", None)
+            if pp is not None and hasattr(pp, "close"):
+                pp.close()
+        for pr in self.partitions.values():
+            for qr in getattr(pr, "dense_query_runtimes", {}).values():
+                pp = getattr(qr, "pattern_processor", None)
+                if pp is not None and hasattr(pp, "close"):
+                    pp.close()
         for t in self.tables.values():
             if hasattr(t, "shutdown"):
                 t.shutdown()
@@ -229,6 +240,30 @@ class SiddhiAppRuntime:
     def statistics(self) -> Dict[str, float]:
         sm = self.app_context.statistics_manager
         return sm.stats() if sm is not None else {}
+
+    def pattern_state(self) -> Dict[str, Dict]:
+        """Ops introspection of every pattern/sequence query's engine
+        state (dense: partition/instance occupancy + overflow; host:
+        live instance count) — parity for the TPU path with the
+        reference's runtime inspection surface
+        (reference: core/query/OnDemandQueryRuntime.java for the pull
+        model; the dense counters have no Java analog).
+
+        Takes the app lock: dense state buffers are DONATED to the
+        jitted step mid-batch, so an unlocked read from another thread
+        (the REST server) could touch deleted device buffers."""
+        with self.app_context.process_lock:
+            out: Dict[str, Dict] = {}
+            for name, qr in self.query_runtimes.items():
+                pp = getattr(qr, "pattern_processor", None)
+                if pp is not None and hasattr(pp, "stats"):
+                    out[name] = pp.stats()
+            for pr in self.partitions.values():
+                for qname, qr in getattr(pr, "dense_query_runtimes", {}).items():
+                    pp = getattr(qr, "pattern_processor", None)
+                    if pp is not None and hasattr(pp, "stats"):
+                        out[qname] = pp.stats()
+            return out
 
     # -- on-demand (pull) queries -------------------------------------------
 

@@ -243,6 +243,11 @@ class DensePatternRuntime:
         self.key_fn = key_fn
         self.state = engine.init_state()
         self.step_invocations = 0  # proof the jitted path ran (tests)
+        # instance-capacity overflow surfacing: dropped pending instances
+        # are counted on device; poll cheaply (one D2H per _OVF_POLL
+        # steps) and warn when the count grows — a dense-mode match set
+        # is bit-exact exactly while this stays zero
+        self._ovf_warned = 0
         self._key_rows: Dict = {}
         self._next_row = 0
         self._free_rows: List[int] = []
@@ -468,6 +473,8 @@ class DensePatternRuntime:
         self.state, ev_idx, out = eng.process(
             self.state, stream_key, part, cols, ts)
         self.step_invocations += 1
+        if self.step_invocations % self._OVF_POLL == 0:
+            self._check_overflow()
         if len(ev_idx) == 0:
             return
         out_cols: Dict[str, np.ndarray] = {}
@@ -480,9 +487,64 @@ class DensePatternRuntime:
         )
         self.emit_cb(mb)
 
+    # -- instance-capacity overflow ------------------------------------------
+
+    _OVF_POLL = 256  # steps between device overflow polls (one D2H each)
+
+    def overflow_total(self) -> int:
+        """Total pending instances dropped because every successor lane
+        was occupied (0 == the dense match set is bit-exact vs host).
+        Reduced ON DEVICE — only a scalar crosses to host (transfers are
+        expensive on tunneled/remote devices)."""
+        return int(self.engine.jnp.sum(self.state["overflow"]))
+
+    def stats(self) -> Dict:
+        """Ops introspection (runtime.pattern_state() / the REST
+        service): partition/instance occupancy of the dense engine.
+        ``active_instances`` counts pending lanes of rows actually IN
+        USE (interned keys; row 0 when unpartitioned) — the scratch row
+        and never-touched pre-armed rows of non-every engines don't
+        inflate it."""
+        active = np.asarray(self.state["active"])
+        if self.key_fn is None:
+            act = int(active[0].sum())
+        elif self._key_rows:
+            rows = np.fromiter(self._key_rows.values(), dtype=np.int64,
+                               count=len(self._key_rows))
+            act = int(active[rows].sum())
+        else:
+            act = 0
+        return {
+            "engine": "dense",
+            "partitions_in_use": (
+                len(self._key_rows) if self.key_fn is not None else 1),
+            "partition_capacity": self.engine.n_partitions,
+            "instance_lanes": self.engine.I,
+            "active_instances": act,
+            "dropped_instances": self.overflow_total(),
+            "step_invocations": self.step_invocations,
+        }
+
+    def _check_overflow(self):
+        total = self.overflow_total()
+        if total > self._ovf_warned:
+            log.warning(
+                "dense pattern '%s': %d pending instance(s) dropped — "
+                "instance lanes full; matches may be missing vs the host "
+                "engine.  Raise @app:execution('tpu', instances='N') "
+                "(current %d per partition/node).",
+                self.out_stream_id, total, self.engine.I)
+            self._ovf_warned = total
+
+    def close(self):
+        """Final overflow check at app shutdown: short-lived apps (< one
+        poll interval of batches) still get the dropped-instance warning."""
+        self._check_overflow()
+
     # -- snapshot contract ---------------------------------------------------
 
     def snapshot(self) -> Dict:
+        self._check_overflow()
         return {
             "dense_state": {k: np.asarray(v) for k, v in self.state.items()},
             "base_ts": self.engine.base_ts,

@@ -125,8 +125,8 @@ class DensePatternEngine:
         eng = DensePatternEngine(nodes, ref_defs, stream_to_ref,
                                  within_ms, n_partitions, select_vars)
         state = eng.init_state()
-        state, n_matches, out = eng.process(state, stream_key, part_idx,
-                                            cols, ts)
+        state, match_ev_idx, out = eng.process(state, stream_key,
+                                               part_idx, cols, ts)
     """
 
     def __init__(
@@ -332,8 +332,8 @@ class DensePatternEngine:
 
         step(state, part_idx[B] i32, cols {attr: [B] f32}, ts[B] i32
              relative-ms, valid[B] bool)
-          -> (state, emit[B, I] bool, out_vals[B, I, n_out] f32,
-              emit_anchor[B, I] i32)
+          -> (state, emit[B, 2*I] bool, out_vals[B, 2*I, n_out] f32,
+              emit_anchor[B, 2*I] i32)
 
         ``emit[b, i]``: a pending instance of event ``b``'s partition
         completed the chain on this event.  The emit arrays carry 2*I

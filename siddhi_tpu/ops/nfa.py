@@ -805,6 +805,15 @@ class PatternProcessor:
         deadlines = [i.deadline for i in self.instances if i.alive and i.deadline is not None]
         return min(deadlines) if deadlines else None
 
+    def stats(self) -> Dict:
+        """Ops introspection — same shape as the dense runtime's so the
+        REST/on-demand surface is engine-agnostic."""
+        return {
+            "engine": "host",
+            "active_instances": sum(1 for i in self.instances if i.alive),
+            "matched_once": self.matched_once,
+        }
+
     def fire(self, now: int):
         self.on_time(now)
 

@@ -257,8 +257,8 @@ class ShardedPatternEngine:
         ), pos
 
     def step(self, state, part, cols, ts, valid):
-        """One sharded step: ``(state', emit[B, I], out_vals[B, I, O],
-        emit_anchor[B, I], global_matches)``.
+        """One sharded step: ``(state', emit[B, 2I], out_vals[B, 2I, O],
+        emit_anchor[B, 2I], global_matches)``.
 
         The input ``state`` is DONATED (its device buffers are consumed
         on real hardware — snapshot it before stepping if needed; always

@@ -57,6 +57,9 @@ class SiddhiService:
                 if len(parts) == 3 and parts[1] == "siddhi-artifact-undeploy":
                     code, payload = service.undeploy(parts[2])
                     self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-pattern-state":
+                    code, payload = service.pattern_state(parts[2])
+                    self._send(code, payload)
                 elif self.path.rstrip("/") == "/siddhi-apps":
                     self._send(200, {"status": "OK", "apps": service.app_names()})
                 else:
@@ -113,6 +116,18 @@ class SiddhiService:
             }
         runtime.shutdown()
         return 200, {"status": "OK", "message": f"Siddhi app '{name}' undeployed"}
+
+    def pattern_state(self, name: str):
+        """Per-query pattern-engine occupancy of a deployed app (dense:
+        partitions/instances/overflow; host: live instances)."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        return 200, {"status": "OK", "queries": runtime.pattern_state()}
 
     def app_names(self):
         with self._lock:

@@ -204,6 +204,64 @@ class TestOverlappingEvery:
         ])
 
 
+class TestPatternStateIntrospection:
+    def test_runtime_pattern_state_dense_and_host(self):
+        app = (
+            "define stream S (v double); "
+            "@info(name='qd') from every a=S[v > 100.0] -> b=S[v > a.v] "
+            "within 10 min select a.v as av, b.v as bv insert into Alerts; "
+            "@info(name='qh') from every a=S[v > 100.0] -> "
+            "not S[v > 1000.0] for 1 sec "
+            "select a.v as av insert into Alerts2;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') " + app)
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([500.0], timestamp=1000)
+            h.send([400.0], timestamp=1100)
+            st = rt.pattern_state()
+            assert st["qd"]["engine"] == "dense"
+            assert st["qd"]["active_instances"] == 2
+            assert st["qd"]["dropped_instances"] == 0
+            assert st["qd"]["instance_lanes"] == 4
+            assert st["qh"]["engine"] == "host"  # absent -> host fallback
+            assert st["qh"]["active_instances"] >= 1
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_rest_pattern_state_endpoint(self):
+        import json
+        from urllib.request import urlopen
+
+        from siddhi_tpu.service import SiddhiService
+
+        svc = SiddhiService()
+        svc.start()
+        try:
+            code, payload = svc.deploy(
+                "@app:name('psapp') @app:playback @app:execution('tpu') "
+                "define stream S (v double); "
+                "@info(name='q') from every a=S[v > 100.0] -> b=S[v > a.v] "
+                "within 10 min select a.v as av, b.v as bv "
+                "insert into Alerts;")
+            assert code == 200, payload
+            svc.get_runtime("psapp").get_input_handler("S").send(
+                [500.0], timestamp=1000)
+            with urlopen(
+                    f"http://127.0.0.1:{svc.port}/siddhi-pattern-state/psapp"
+            ) as r:
+                body = json.loads(r.read())
+            assert body["status"] == "OK"
+            assert body["queries"]["q"]["engine"] == "dense"
+            assert body["queries"]["q"]["active_instances"] == 1
+        finally:
+            svc.stop()
+            svc.manager.shutdown()
+
+
 class TestInstanceCapacity:
     APP = DEFINE + (
         "@info(name='q') from every a=S[v > 100.0] -> b=S[v > a.v] "
@@ -236,6 +294,17 @@ class TestInstanceCapacity:
         # two lanes: 500- and 400-arms kept; the 300-arm dropped
         assert got == [[500.0, 600.0], [400.0, 600.0]]
         assert overflow == 1
+
+    def test_overflow_warns_at_shutdown(self, caplog):
+        """Short-lived apps (fewer batches than the poll interval) still
+        surface the dropped-instance warning via the shutdown check."""
+        import logging
+
+        sends = [([0.0, 500.0], 1000), ([0.0, 400.0], 1100),
+                 ([0.0, 300.0], 1200), ([0.0, 600.0], 1300)]
+        with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+            self.overflow_run(2, sends)
+        assert any("dropped" in r.message for r in caplog.records)
 
     def test_enough_lanes_no_overflow(self):
         sends = [([0.0, 500.0], 1000), ([0.0, 400.0], 1100),
