@@ -83,6 +83,9 @@ class SiddhiAppContext:
         self.execution_mode = "host"
         self.tpu_partitions = 65536
         self.tpu_instances = 4
+        # @app:execution('tpu', devices='N'): shard the dense partition
+        # axis over an N-device jax.sharding.Mesh (None = single device)
+        self.tpu_devices = None
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -186,14 +186,9 @@ def output_attr_types(eng) -> List[AttrType]:
 
 
 def _numeric_attrs(eng, stream_key: str) -> List[str]:
-    for node in eng.nodes:
-        for spec in node.specs:
-            if spec.stream_key == stream_key:
-                return [
-                    a.name for a in spec.stream_def.attributes
-                    if a.type.is_numeric
-                ]
-    raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
+    """Delegates to the engine so the runtime's col dict and the sharded
+    step's fixed in_specs structure can never diverge."""
+    return eng.numeric_stream_attrs(stream_key)
 
 
 def _trace_check(eng):
@@ -232,16 +227,41 @@ class DensePatternRuntime:
 
     ``key_fn(batch) -> list`` supplies partition keys (a partitioned
     pattern); plain queries run as one partition (row 0).
+
+    ``mesh``: shard the partition axis over a jax.sharding.Mesh
+    (@app:execution('tpu', devices='N')) — state rows live shard-major
+    behind a ShardedPatternEngine per source stream, interned keys route
+    to their owning shard host-side, and emitted matches come back
+    globally (the all-gather is the host fetch of the sharded output
+    arrays).  Interned rows are dealt round-robin across shards so load
+    spreads from the first key on.
     """
 
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None],
-                 key_fn: Optional[Callable] = None):
+                 key_fn: Optional[Callable] = None,
+                 mesh=None):
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.key_fn = key_fn
-        self.state = engine.init_state()
+        self.mesh = mesh
+        self._sharded: Optional[Dict[str, object]] = None
+        if mesh is not None:
+            from siddhi_tpu.parallel.mesh import ShardedPatternEngine
+
+            # one sharded wrapper per source stream (the jitted step is
+            # per-stream); all share one shard-major state layout
+            self._sharded = {
+                sk: ShardedPatternEngine(engine, mesh, stream_key=sk)
+                for sk in engine.stream_keys
+            }
+            first = next(iter(self._sharded.values()))
+            self.n_shards = first.n_shards
+            self.parts_per_shard = first.parts_per_shard
+            self.state = first.init_state()
+        else:
+            self.state = engine.init_state()
         self.step_invocations = 0  # proof the jitted path ran (tests)
         # instance-capacity overflow surfacing: dropped pending instances
         # are counted on device; poll cheaply (one D2H per _OVF_POLL
@@ -271,6 +291,23 @@ class DensePatternRuntime:
         ]
 
     # -- partition interning -------------------------------------------------
+
+    def _deal_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Allocation-counter ids -> logical partition ids.  Sharded
+        runtimes deal ids round-robin across shards (key #k lives on
+        shard k % n_shards) so load spreads from the first key on."""
+        if self._sharded is None:
+            return ids
+        return ((ids % self.n_shards) * self.parts_per_shard
+                + (ids // self.n_shards))
+
+    def _phys_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Logical partition ids -> physical state-array rows (the
+        shard-major layout inserts one scratch row per shard)."""
+        if self._sharded is None:
+            return rows
+        pps = self.parts_per_shard
+        return (rows // pps) * (pps + 1) + (rows % pps)
 
     def intern_keys(self, keys) -> np.ndarray:
         """Partition-key values -> dense engine row ids (stable until the
@@ -336,8 +373,9 @@ class DensePatternRuntime:
                 row_ids[:take_free] = self._free_rows[-take_free:][::-1]
                 del self._free_rows[-take_free:]
             if fresh:
-                row_ids[take_free:] = np.arange(
-                    self._next_row, self._next_row + fresh, dtype=np.int32)
+                row_ids[take_free:] = self._deal_rows(np.arange(
+                    self._next_row, self._next_row + fresh, dtype=np.int64)
+                ).astype(np.int32)
                 self._next_row += fresh
             urows[new_idx] = row_ids
             self._key_rows.update(
@@ -381,7 +419,7 @@ class DensePatternRuntime:
                 if self._free_rows:
                     row = self._free_rows.pop()
                 elif self._next_row < cap:
-                    row = self._next_row
+                    row = int(self._deal_rows(np.asarray(self._next_row)))
                     self._next_row += 1
                 else:
                     raise SiddhiAppRuntimeError(
@@ -429,7 +467,8 @@ class DensePatternRuntime:
         ]
         if not idle:
             return
-        rows = np.asarray([r for _k, r in idle], dtype=np.int32)
+        rows = self._phys_rows(np.asarray([r for _k, r in idle],
+                                          dtype=np.int32))
         init = self.engine.init_state_host()
         jnp = self.engine.jnp
         state = dict(self.state)
@@ -470,8 +509,12 @@ class DensePatternRuntime:
         ts = np.asarray(cur.timestamps, dtype=np.int64)
         if len(ts):
             np.maximum.at(self._row_last_used, part, ts)
-        self.state, ev_idx, out = eng.process(
-            self.state, stream_key, part, cols, ts)
+        if self._sharded is not None:
+            self.state, ev_idx, out, _total = self._sharded[
+                stream_key].process(self.state, part, cols, ts)
+        else:
+            self.state, ev_idx, out = eng.process(
+                self.state, stream_key, part, cols, ts)
         self.step_invocations += 1
         if self.step_invocations % self._OVF_POLL == 0:
             self._check_overflow()
@@ -506,18 +549,21 @@ class DensePatternRuntime:
         and never-touched pre-armed rows of non-every engines don't
         inflate it."""
         active = np.asarray(self.state["active"])
-        if self.key_fn is None:
-            act = int(active[0].sum())
-        elif self._key_rows:
-            rows = np.fromiter(self._key_rows.values(), dtype=np.int64,
-                               count=len(self._key_rows))
+        partitioned = self.engine.n_partitions > 1
+        if self._key_rows:
+            rows = self._phys_rows(np.fromiter(
+                self._key_rows.values(), dtype=np.int64,
+                count=len(self._key_rows)))
             act = int(active[rows].sum())
+        elif not partitioned:
+            # unpartitioned: the single automaton lives in row 0
+            act = int(active[0].sum())
         else:
             act = 0
         return {
             "engine": "dense",
             "partitions_in_use": (
-                len(self._key_rows) if self.key_fn is not None else 1),
+                len(self._key_rows) if partitioned else 1),
             "partition_capacity": self.engine.n_partitions,
             "instance_lanes": self.engine.I,
             "active_instances": act,
@@ -556,7 +602,29 @@ class DensePatternRuntime:
 
     def restore(self, state: Dict):
         jnp = self.engine.jnp
-        self.state = {k: jnp.asarray(v) for k, v in state["dense_state"].items()}
+        rows = len(next(iter(state["dense_state"].values())))
+        if self._sharded is not None:
+            first = next(iter(self._sharded.values()))
+            want = self.n_shards * (self.parts_per_shard + 1)
+            if rows != want:
+                raise SiddhiAppRuntimeError(
+                    f"cannot restore: snapshot has {rows} state rows but "
+                    f"this app's sharded layout needs {want} "
+                    "(snapshot taken under a different "
+                    "@app:execution devices/partitions setting)")
+            self.state = {
+                k: first._put(np.asarray(v), first.state_specs[k])
+                for k, v in state["dense_state"].items()
+            }
+        else:
+            want = self.engine.n_partitions + 1
+            if rows != want:
+                raise SiddhiAppRuntimeError(
+                    f"cannot restore: snapshot has {rows} state rows but "
+                    f"this app needs {want} (snapshot taken under a "
+                    "different @app:execution devices/partitions setting)")
+            self.state = {
+                k: jnp.asarray(v) for k, v in state["dense_state"].items()}
         self.engine.base_ts = state["base_ts"]
         self._key_rows = dict(state["key_rows"])
         self._next_row = state.get("next_row", len(self._key_rows))

@@ -931,6 +931,17 @@ class DensePatternEngine:
                     return list(spec.stream_def.attribute_names)
         raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
 
+    def numeric_stream_attrs(self, stream_key: str) -> List[str]:
+        """Device-lane column keys (numeric attrs only — strings stay
+        host-side as interned partition keys); the fixed col-dict
+        structure of shard_map in_specs."""
+        for node in self.nodes:
+            for spec in node.specs:
+                if spec.stream_key == stream_key:
+                    return [a.name for a in spec.stream_def.attributes
+                            if a.type.is_numeric]
+        raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
+
 
 def flatten_match_parts(ev_parts, out_parts, key_parts, n_out: int
                         ) -> Tuple[np.ndarray, np.ndarray]:

@@ -178,7 +178,7 @@ class ShardedPatternEngine:
         self.rows_per_shard = self.parts_per_shard + 1
 
         self.stream_key = stream_key or engine.default_stream
-        self.col_keys = engine.stream_attrs(self.stream_key)
+        self.col_keys = engine.numeric_stream_attrs(self.stream_key)
         step = engine.make_step(self.stream_key, jit=False)
         jnp = engine.jnp
         a = axis_name

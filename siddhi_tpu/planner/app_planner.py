@@ -91,6 +91,22 @@ class AppPlanner:
                         f"@app:execution: instances='{insts}' must be a "
                         "positive integer")
                 self.app_context.tpu_instances = ni
+            devs = exec_ann.element("devices")
+            if devs:
+                try:
+                    nd = int(devs)
+                except ValueError:
+                    nd = -1
+                if nd < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: devices='{devs}' must be a "
+                        "positive integer")
+                self.app_context.tpu_devices = nd
+                if self.app_context.tpu_partitions % nd:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: partitions="
+                        f"{self.app_context.tpu_partitions} must be "
+                        f"divisible by devices={nd}")
 
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 

@@ -450,9 +450,21 @@ class QueryPlanner:
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
 
+        # @app:execution('tpu', devices='N'): shard the partition axis
+        # over an N-device mesh (BASELINE config 5's scale-out form);
+        # pointless for single-partition queries
+        mesh = None
+        nd = self.app.app_context.tpu_devices
+        if nd and n_partitions > 1:
+            from siddhi_tpu.parallel import make_mesh
+
+            mesh = getattr(self.app, "_tpu_mesh", None)
+            if mesh is None:
+                mesh = make_mesh(nd)
+                self.app._tpu_mesh = mesh
         runtime = DensePatternRuntime(
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
-            key_fn=key_fn,
+            key_fn=key_fn, mesh=mesh,
         )
         qr.pattern_processor = runtime
         if subscribe:

@@ -1,0 +1,115 @@
+"""Sharded execution of the PRODUCT dense pattern path.
+
+The round-3 verdict's missing item 2: ShardedPatternEngine worked but no
+SiddhiManager-created app could shard.  @app:execution('tpu',
+devices='N') now routes a partitioned pattern app's dense runtime
+through the sharded engine over an N-device mesh (8 virtual CPU devices
+under tests, exactly as the driver's dryrun).  BASELINE config 5's
+shape: key-partitioned pattern, sharded partition axis, global emit.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+APP = (
+    "define stream Txn (card string, amount double); "
+    "partition with (card of Txn) begin "
+    "@info(name='q') "
+    "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+    "within 10 min "
+    "select a.amount as base, b.amount as bv insert into Alerts; "
+    "end;"
+)
+
+HDR_SHARDED = "@app:playback @app:execution('tpu', partitions='64', devices='8') "
+HDR_HOST = "@app:playback "
+
+
+def run(header, sends, restore_blob=None, snapshot_at=None):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(header + APP)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        if restore_blob is not None:
+            rt.restore(restore_blob)
+        h = rt.get_input_handler("Txn")
+        blob = None
+        for i, (row, ts) in enumerate(sends):
+            h.send(row, timestamp=ts)
+            if snapshot_at is not None and i == snapshot_at:
+                blob = rt.snapshot()
+        pr = rt.partitions.get("partition_0")
+        runtime = (next(iter(pr.dense_query_runtimes.values()))
+                   .pattern_processor if pr is not None and pr.is_dense
+                   else None)
+        state = runtime.state if runtime is not None else None
+        rt.shutdown()
+        return got, runtime, state, blob
+    finally:
+        m.shutdown()
+
+
+def sends_over_keys(n_keys=20, seed=3):
+    rng = np.random.default_rng(seed)
+    sends = []
+    t = 1000
+    for r in range(6):
+        for k in range(n_keys):
+            t += int(rng.integers(1, 50))
+            sends.append(([f"c{k}", float(rng.integers(50, 400))], t))
+    return sends
+
+
+class TestShardedProduct:
+    def test_sharded_app_matches_host(self):
+        sends = sends_over_keys()
+        host, _, _, _ = run(HDR_HOST, sends)
+        dense, runtime, state, _ = run(HDR_SHARDED, sends)
+        assert isinstance(runtime, DensePatternRuntime)
+        assert runtime._sharded is not None and runtime.n_shards == 8
+        assert runtime.step_invocations > 0
+        assert dense == host
+
+    def test_state_actually_sharded_over_8_devices(self):
+        sends = sends_over_keys(n_keys=16)
+        _got, runtime, state, _ = run(HDR_SHARDED, sends)
+        devices = {d for arr in state.values() for d in arr.devices()}
+        assert len(devices) == 8, f"state spans {len(devices)} devices"
+        # keys dealt round-robin: 16 keys over 8 shards = 2 rows/shard
+        rows = np.fromiter(runtime._key_rows.values(), dtype=np.int64)
+        shard_of = rows // runtime.parts_per_shard
+        assert np.bincount(shard_of, minlength=8).tolist() == [2] * 8
+
+    def test_snapshot_restore_roundtrip_sharded(self):
+        sends = sends_over_keys(n_keys=12, seed=7)
+        mid = len(sends) // 2
+        full, _, _, _ = run(HDR_SHARDED, sends)
+        # snapshot mid-stream, then restore into a FRESH app and replay
+        # only the tail
+        got_head, _, _, blob = run(HDR_SHARDED, sends[:mid],
+                                   snapshot_at=mid - 1)
+        assert blob is not None
+        got_tail, runtime2, state2, _ = run(HDR_SHARDED, sends[mid:],
+                                            restore_blob=blob)
+        assert runtime2._sharded is not None
+        assert got_head + got_tail == full
+        devices = {d for arr in state2.values() for d in arr.devices()}
+        assert len(devices) == 8  # restore keeps the mesh sharding
+
+    def test_dryrun_layout_matches(self):
+        # partitions not divisible by devices fails loudly at parse time
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        m = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError):
+                m.create_siddhi_app_runtime(
+                    "@app:execution('tpu', partitions='63', devices='8') "
+                    + APP)
+        finally:
+            m.shutdown()
