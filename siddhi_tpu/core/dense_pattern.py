@@ -94,50 +94,32 @@ def build_dense_engine(query, st: StateInputStream, resolve_def,
         n_instances=n_instances,
     )
 
-    # every capture register and output must be float-typed: registers
-    # are a float32 bank, so INT/LONG captures (card numbers, ids) would
-    # silently round above 2^24 — those queries keep the exact host
-    # engine until the integer register bank lands.  String keys belong
+    # INT/LONG captures, filters (plain comparisons) and selects ride
+    # the engine's bit-exact hi/lo int32 pair bank; integer usage the
+    # pair compiler cannot express (arithmetic, functions) raises inside
+    # _trace_check below and falls back to the host engine.  Non-numeric
+    # captures/selects (STRING/BOOL/OBJECT) have no device lane at all —
+    # they must fall back, not silently emit zeros.  String keys belong
     # on the partition axis.
-    _FLOAT_OK = (AttrType.FLOAT, AttrType.DOUBLE)
-
-    def _check_lane(ref_def, attr, what):
+    def _check_numeric(ref_def, attr, what):
         if ref_def is None or attr not in ref_def.attribute_names:
-            raise SiddhiAppCreationError(
-                f"dense path: cannot type {what}")
+            raise SiddhiAppCreationError(f"dense path: cannot type {what}")
         t = ref_def.attribute_type(attr)
-        if t not in _FLOAT_OK:
+        if not t.is_numeric:
             raise SiddhiAppCreationError(
-                f"dense path: {what} has type {t.value}; float32 lanes "
-                "would lose integer precision — host engine used")
+                f"dense path: {what} has type {t.value}; only numeric "
+                "attributes have device lanes — host engine used")
 
     for (ref, attr, _last) in eng.alloc.slots:
-        _check_lane(builder.ref_defs.get(ref), attr, f"capture '{ref}.{attr}'")
+        _check_numeric(builder.ref_defs.get(ref), attr,
+                       f"capture '{ref}.{attr}'")
     for _name, src in eng.out_spec:
         if isinstance(src, tuple):
             ref_def = None
             for spec in nodes[-1].specs:
                 if src[1] in spec.stream_def.attribute_names:
                     ref_def = spec.stream_def
-            _check_lane(ref_def, src[1], f"select attribute '{src[1]}'")
-    # filter operands too: candidate columns are cast to float32 before
-    # the step, so an INT/LONG comparison (card == 16777217) would
-    # collide above 2^24 — captured-ref operands are already covered by
-    # the register check above
-    for node in nodes:
-        for spec in node.specs:
-            if spec.raw_filter is None:
-                continue
-            for var in _walk_variables(spec.raw_filter):
-                sid = var.stream_id
-                if sid is None:
-                    if var.attribute in spec.stream_def.attribute_names:
-                        _check_lane(spec.stream_def, var.attribute,
-                                    f"filter attribute '{var.attribute}'")
-                elif sid == spec.ref or sid == spec.stream_key.lstrip("#!"):
-                    if var.attribute in spec.stream_def.attribute_names:
-                        _check_lane(spec.stream_def, var.attribute,
-                                    f"filter attribute '{sid}.{var.attribute}'")
+            _check_numeric(ref_def, src[1], f"select attribute '{src[1]}'")
 
     _trace_check(eng)
     return eng
@@ -208,8 +190,9 @@ def _trace_check(eng):
     try:
         for sk in eng.stream_keys:
             cols = {
-                a: jax.ShapeDtypeStruct((B,), np.float32)
-                for a in _numeric_attrs(eng, sk)
+                k: jax.ShapeDtypeStruct(
+                    (B,), np.int32 if "|" in k else np.float32)
+                for k in eng.device_col_keys(sk)
             }
             step = eng.make_step(sk, jit=False)
             jax.eval_shape(step, state_shapes, i32, cols, i32, b1)
@@ -503,7 +486,9 @@ class DensePatternRuntime:
             col = cur.columns.get(a)
             if col is None:
                 continue
-            cols[a] = np.asarray(col, dtype=np.float32)
+            # native dtype: the engine splits integer columns into
+            # bit-exact hi/lo pairs itself (prepare_cols)
+            cols[a] = np.asarray(col)
         if part is None:
             part = self._part_ids(cur)
         ts = np.asarray(cur.timestamps, dtype=np.int64)

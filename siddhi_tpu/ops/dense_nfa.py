@@ -77,6 +77,25 @@ class RegSlot:
     attr: str
     last: bool  # False: first captured event; True: last captured event
     index: int
+    integer: bool = False  # True: hi/lo int32 pair in the iregs bank
+
+
+# integer (INT/LONG) values ride hi/lo int32 pairs: hi = v >> 32 (signed),
+# lo = (v & 0xffffffff) - 2^31 (bias-signed, so SIGNED int32 comparison of
+# lo equals UNSIGNED comparison of the raw low word) — (hi, lo)
+# lexicographic signed order == int64 signed order, bit-exact at any
+# magnitude, no 64-bit device lanes needed (TPUs have none)
+_INT_TYPES = (AttrType.INT, AttrType.LONG)
+
+
+def _i64_split_const(v: int) -> Tuple[np.int32, np.int32]:
+    v = int(v)
+    return (np.int32(v >> 32), np.int32((v & 0xFFFFFFFF) - 2**31))
+
+
+def _i64_join(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return ((hi.astype(np.int64) << 32)
+            | (lo.astype(np.int64) + 2**31).astype(np.uint32))
 
 
 class DenseScope(PatternScope):
@@ -92,30 +111,115 @@ class DenseScope(PatternScope):
             return key, t
         # captured reference -> register slot key
         ref, idx, attr, _t = self.used_captures[key]
+        integer = t in _INT_TYPES
         if idx in (None, 0):
-            slot = self.alloc.slot(ref, attr, last=False)
+            slot = self.alloc.slot(ref, attr, last=False, integer=integer)
         elif idx == -1:
-            slot = self.alloc.slot(ref, attr, last=True)
+            slot = self.alloc.slot(ref, attr, last=True, integer=integer)
         else:
             raise SiddhiAppCreationError(
                 f"dense NFA supports only first/[0]/[last] capture refs, got index {idx}"
             )
-        return f"__reg.{slot.index}", t
+        prefix = "__ireg" if integer else "__reg"
+        return f"{prefix}.{slot.index}", t
 
 
 class RegAllocator:
+    """Two banks: float32 value slots (``regs``) and integer hi/lo pair
+    slots (``iregs``) — indexed independently."""
+
     def __init__(self):
         self.slots: Dict[Tuple[str, str, bool], RegSlot] = {}
+        self._n_float = 0
+        self._n_int = 0
 
-    def slot(self, ref: str, attr: str, last: bool) -> RegSlot:
+    def slot(self, ref: str, attr: str, last: bool,
+             integer: bool = False) -> RegSlot:
         k = (ref, attr, last)
         if k not in self.slots:
-            self.slots[k] = RegSlot(ref, attr, last, len(self.slots))
+            idx = self._n_int if integer else self._n_float
+            self.slots[k] = RegSlot(ref, attr, last, idx, integer)
+            if integer:
+                self._n_int += 1
+            else:
+                self._n_float += 1
         return self.slots[k]
 
     @property
     def n(self) -> int:
-        return len(self.slots)
+        return self._n_float
+
+    @property
+    def n_int(self) -> int:
+        return self._n_int
+
+
+class DenseExprCompiler(ExpressionCompiler):
+    """Dense-filter compiler: integer (INT/LONG) leaves ride hi/lo int32
+    pairs (``<key>|hi`` / ``<key>|lo`` env lanes); comparisons between
+    integer leaves compile to bit-exact paired compares at ANY
+    magnitude.  Every other integer use (arithmetic, function args)
+    raises, sending the query to the host engine — the reference is
+    per-type exact and so are we, just along a narrower surface."""
+
+    def _i64_parts(self, e, var_only=False):
+        """Integer leaf -> (hi_fn, lo_fn) env readers, else None.
+        ``var_only`` skips constants (used to decide whether the pair
+        path applies at all: an integer LITERAL against a float lane —
+        ``[v > 100]`` — stays on the ordinary float compare)."""
+        from siddhi_tpu.query_api import Constant
+
+        if (not var_only and isinstance(e, Constant)
+                and e.type in _INT_TYPES and e.value is not None):
+            hi, lo = _i64_split_const(e.value)
+            return (lambda env: hi), (lambda env: lo)
+        if isinstance(e, Variable):
+            key, t = self.scope.resolve(e)
+            if t in _INT_TYPES:
+                return ((lambda env: env[key + "|hi"]),
+                        (lambda env: env[key + "|lo"]))
+        return None
+
+    def _c_CompareOp(self, e):
+        # pair compares engage only when an integer VARIABLE lane is
+        # involved; integer constants alone coerce fine on float lanes
+        if (self._i64_parts(e.left, var_only=True) is None
+                and self._i64_parts(e.right, var_only=True) is None):
+            return super()._c_CompareOp(e)
+        lp, rp = self._i64_parts(e.left), self._i64_parts(e.right)
+        if lp is None or rp is None:
+            raise SiddhiAppCreationError(
+                "dense NFA: comparison mixes a 64-bit integer lane with a "
+                "non-integer operand — host engine used")
+        lhi, llo = lp
+        rhi, rlo = rp
+        op = e.op
+
+        def fn(env):
+            a_hi, a_lo = lhi(env), llo(env)
+            b_hi, b_lo = rhi(env), rlo(env)
+            if op == "==":
+                return (a_hi == b_hi) & (a_lo == b_lo)
+            if op == "!=":
+                return (a_hi != b_hi) | (a_lo != b_lo)
+            if op == ">":
+                return (a_hi > b_hi) | ((a_hi == b_hi) & (a_lo > b_lo))
+            if op == ">=":
+                return (a_hi > b_hi) | ((a_hi == b_hi) & (a_lo >= b_lo))
+            if op == "<":
+                return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo))
+            return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+        return CompiledExpression(fn, AttrType.BOOL)
+
+    def _c_Variable(self, e):
+        key, t = self.scope.resolve(e)
+        if t in _INT_TYPES:
+            raise SiddhiAppCreationError(
+                "dense NFA: integer attribute used outside a plain "
+                "comparison (arithmetic/functions on 64-bit lanes need "
+                "the host engine)")
+        return super()._c_Variable(e)
 
 
 class DensePatternEngine:
@@ -212,19 +316,9 @@ class DensePatternEngine:
     # -- compilation --------------------------------------------------------
 
     def _warn_integer_precision(self):
-        import logging
-
-        for (ref, attr, _last) in self.alloc.slots:
-            d = self.ref_defs.get(ref)
-            if d is not None and attr in d.attribute_names and d.attribute_type(attr) in (
-                AttrType.LONG, AttrType.INT,
-            ):
-                logging.getLogger("siddhi_tpu").warning(
-                    "dense NFA stores capture '%s.%s' (%s) in float32 registers; "
-                    "values above 2^24 lose precision — prefer partitioning on "
-                    "identifier attributes instead of filtering on them",
-                    ref, attr, d.attribute_type(attr).value,
-                )
+        # integer captures now ride the bit-exact hi/lo int32 pair bank
+        # (iregs) — nothing to warn about anymore
+        pass
 
     def _compile_filters(self, stream_to_ref):
         """Per-node filters compiled against candidate columns + registers."""
@@ -237,7 +331,7 @@ class DensePatternEngine:
                     continue
                 # recompile the raw filter against the dense scope
                 scope = DenseScope(self.ref_defs, stream_to_ref, spec.stream_def, self.alloc)
-                compiler = ExpressionCompiler(scope)
+                compiler = DenseExprCompiler(scope)
                 fs.append(compiler.compile(spec.raw_filter))
             self.node_filters.append(fs)
 
@@ -246,6 +340,7 @@ class DensePatternEngine:
 
         Output names use the query's `as` aliases when provided."""
         self.out_spec: List[Tuple[str, object]] = []  # (name, slot|('cand', attr))
+        self.out_int: List[bool] = []  # integer (hi/lo pair) output lane?
         last_node = self.nodes[-1]
         last_refs = {s.ref for s in last_node.specs}
         for vi, var in enumerate(select_vars):
@@ -260,17 +355,24 @@ class DensePatternEngine:
                 if select_names and vi < len(select_names)
                 else f"{ref}.{var.attribute}"
             )
+            d = self.ref_defs[ref]
+            if var.attribute not in d.attribute_names:
+                raise SiddhiAppCreationError(
+                    f"select ref '{ref}.{var.attribute}': no such attribute")
+            integer = d.attribute_type(var.attribute) in _INT_TYPES
             if ref in last_refs and last_node.kind == "stream" and last_node.max_count == 1:
                 # final event: values come from the candidate columns
                 self.out_spec.append((name, ("cand", var.attribute)))
+                self.out_int.append(integer)
                 continue
             last = idx == -1
             if idx not in (None, 0, -1):
                 raise SiddhiAppCreationError(
                     f"dense NFA supports only first/[0]/[last] select refs, got {idx}"
                 )
-            slot = self.alloc.slot(ref, var.attribute, last)
+            slot = self.alloc.slot(ref, var.attribute, last, integer=integer)
             self.out_spec.append((name, slot))
+            self.out_int.append(integer)
 
     # -- state --------------------------------------------------------------
 
@@ -287,7 +389,7 @@ class DensePatternEngine:
             # non-every: node 0 armed once per partition (lane 0); after
             # a match reset_on_emit clears it and the automaton is done
             active0[:, 0, 0] = True
-        return {
+        state = {
             "active": active0,
             # relative ms since self.base_ts (int32: ~24 days of horizon),
             # 0 == unset
@@ -297,6 +399,11 @@ class DensePatternEngine:
             # per-partition dropped-instance count (successor slots full)
             "overflow": np.zeros(P, dtype=np.int32),
         }
+        if self.alloc.n_int:
+            # integer capture bank: hi/lo int32 pair per slot
+            state["iregs"] = np.zeros((P, S, I, 2 * self.alloc.n_int),
+                                      dtype=np.int32)
+        return state
 
     def state_pspecs(self):
         """Partition-axis sharding spec per state array (row-sharded;
@@ -304,13 +411,16 @@ class DensePatternEngine:
         from jax.sharding import PartitionSpec as Pspec
 
         a = self.partition_axis
-        return {
+        specs = {
             "active": Pspec(a, None, None),
             "first_ts": Pspec(a, None, None),
             "counts": Pspec(a, None, None),
             "regs": Pspec(a, None, None, None),
             "overflow": Pspec(a),
         }
+        if self.alloc.n_int:
+            specs["iregs"] = Pspec(a, None, None, None)
+        return specs
 
     def init_state(self):
         jnp = self.jnp
@@ -363,31 +473,47 @@ class DensePatternEngine:
         out_spec = self.out_spec
         O = max(len(out_spec), 1)
 
-        def env_for(node_idx, cols, ts, regs_b, spec_idx=0, regs_node=None):
+        def env_for(node_idx, cols, ts, regs_b, iregs_b, spec_idx=0,
+                    regs_node=None):
             """Filter env over [B, I] lanes: candidate columns broadcast
-            down the instance axis; registers are per-instance.
-            ``regs_node`` overrides which node's register lanes feed the
-            env (the via-path evaluates node t's filter against the
-            dually-pending source registers at t-1)."""
+            down the instance axis; registers are per-instance (float
+            bank + hi/lo integer pair bank).  ``regs_node`` overrides
+            which node's register lanes feed the env (the via-path
+            evaluates node t's filter against the dually-pending source
+            registers at t-1)."""
             env = {}
             spec = nodes[node_idx].specs[spec_idx]
             rn = node_idx if regs_node is None else regs_node
-            for a in spec.stream_def.attribute_names:
-                if a in cols:
-                    env["__cand." + a] = cols[a][:, None]
+            for a in spec.stream_def.attributes:
+                if a.type in _INT_TYPES:
+                    hk, lk = f"{a.name}|hi", f"{a.name}|lo"
+                    if hk in cols:
+                        env[f"__cand.{a.name}|hi"] = cols[hk][:, None]
+                        env[f"__cand.{a.name}|lo"] = cols[lk][:, None]
+                elif a.name in cols:
+                    env["__cand." + a.name] = cols[a.name][:, None]
             for slot in self.alloc.slots.values():
-                env[f"__reg.{slot.index}"] = regs_b[:, rn, :, slot.index]
+                if slot.integer:
+                    env[f"__ireg.{slot.index}|hi"] = (
+                        iregs_b[:, rn, :, 2 * slot.index])
+                    env[f"__ireg.{slot.index}|lo"] = (
+                        iregs_b[:, rn, :, 2 * slot.index + 1])
+                else:
+                    env[f"__reg.{slot.index}"] = regs_b[:, rn, :, slot.index]
             env[TS_KEY] = ts[:, None]
             env[N_KEY] = ts.shape[0]
             return env
 
-        def eval_ok(s, si, cols, ts, regs, B):
+        def eval_ok(s, si, cols, ts, regs, iregs, B):
             f = node_filters[s][si]
             if f is None:
                 return jnp.ones((B, I), dtype=bool)
             return jnp.broadcast_to(
-                jnp.asarray(f.fn(env_for(s, cols, ts, regs, si))).astype(bool),
+                jnp.asarray(f.fn(
+                    env_for(s, cols, ts, regs, iregs, si))).astype(bool),
                 (B, I))
+
+        n_iout = sum(self.out_int)
 
         def step(state, part_idx, cols, ts, valid):
             B = part_idx.shape[0]
@@ -395,9 +521,12 @@ class DensePatternEngine:
             first = state["first_ts"][part_idx]  # [B, S, I]
             counts = state["counts"][part_idx]   # [B, S, I]
             regs = state["regs"][part_idx]       # [B, S, I, R]
+            iregs = (state["iregs"][part_idx] if "iregs" in state
+                     else jnp.zeros((B, S, I, 0), dtype=jnp.int32))
             ovf = state["overflow"][part_idx]    # [B]
             emit = jnp.zeros((B, 2 * I), dtype=bool)
             out_vals = jnp.zeros((B, 2 * I, O), dtype=jnp.float32)
+            out_ivals = jnp.zeros((B, 2 * I, 2 * n_iout), dtype=jnp.int32)
             emit_anchor = jnp.zeros((B, 2 * I), dtype=jnp.int32)
 
             # within-window expiry: clear expired instances (active bits,
@@ -420,12 +549,12 @@ class DensePatternEngine:
                         if sp.stream_key != stream_key:
                             oks.append(None)
                         else:
-                            oks.append(eval_ok(s, si, cols, ts, regs, B))
+                            oks.append(eval_ok(s, si, cols, ts, regs, iregs, B))
                     ok_pre.append(oks)
                 elif node.specs[0].stream_key != stream_key:
                     ok_pre.append(None)
                 else:
-                    ok_pre.append(eval_ok(s, 0, cols, ts, regs, B))
+                    ok_pre.append(eval_ok(s, 0, cols, ts, regs, iregs, B))
 
             if is_sequence:
                 # strict continuity (reference: SEQUENCE keeps one pending
@@ -451,40 +580,69 @@ class DensePatternEngine:
                     first = first.at[:, s, :].set(
                         jnp.where(kill, 0, first[:, s, :]))
 
-            def _emit_rows(mask, anchor, src_regs, carry, bank=0):
-                """Instances in ``mask`` (with ``src_regs`` [B, I, R])
-                complete the chain on this event.  ``bank`` selects the
-                emit lane block (0: last-node completions, 1: via-path
-                clones) so same-lane fires from both never collide."""
-                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+            # out-spec position -> index into the integer output pairs
+            int_out_idx = {}
+            for _oi, _isint in enumerate(self.out_int):
+                if _isint:
+                    int_out_idx[_oi] = len(int_out_idx)
+
+            def _emit_rows(mask, anchor, src_regs, carry, bank=0,
+                           src_iregs=None):
+                """Instances in ``mask`` (with ``src_regs`` [B, I, R] and
+                ``src_iregs`` [B, I, 2*RI]) complete the chain on this
+                event.  ``bank`` selects the emit lane block (0:
+                last-node completions, 1: via-path clones) so same-lane
+                fires from both never collide."""
+                a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
+                if src_iregs is None:
+                    src_iregs = iregs[:, S - 1, :, :]
                 lo = bank * I
                 sl = slice(lo, lo + I)
                 emit = emit.at[:, sl].set(emit[:, sl] | mask)
                 emit_anchor = emit_anchor.at[:, sl].set(
                     jnp.where(mask, anchor, emit_anchor[:, sl]))
                 for oi, (_name, src) in enumerate(out_spec):
+                    ii = int_out_idx.get(oi)
                     if isinstance(src, tuple):  # ('cand', attr)
+                        if ii is not None:
+                            hk, lk = f"{src[1]}|hi", f"{src[1]}|lo"
+                            if hk not in cols:
+                                continue
+                            out_ivals = out_ivals.at[:, sl, 2 * ii].set(
+                                jnp.where(mask, cols[hk][:, None],
+                                          out_ivals[:, sl, 2 * ii]))
+                            out_ivals = out_ivals.at[:, sl, 2 * ii + 1].set(
+                                jnp.where(mask, cols[lk][:, None],
+                                          out_ivals[:, sl, 2 * ii + 1]))
+                            continue
                         val = cols.get(src[1])
                         if val is None:
                             continue
                         out_vals = out_vals.at[:, sl, oi].set(
                             jnp.where(mask, val.astype(jnp.float32)[:, None],
                                       out_vals[:, sl, oi]))
+                    elif ii is not None:
+                        out_ivals = out_ivals.at[:, sl, 2 * ii].set(
+                            jnp.where(mask, src_iregs[:, :, 2 * src.index],
+                                      out_ivals[:, sl, 2 * ii]))
+                        out_ivals = out_ivals.at[:, sl, 2 * ii + 1].set(
+                            jnp.where(mask, src_iregs[:, :, 2 * src.index + 1],
+                                      out_ivals[:, sl, 2 * ii + 1]))
                     else:
                         out_vals = out_vals.at[:, sl, oi].set(
                             jnp.where(mask, src_regs[:, :, src.index],
                                       out_vals[:, sl, oi]))
-                return (a, first, counts, regs, emit, out_vals, emit_anchor,
-                        ovf)
+                return (a, first, counts, regs, iregs, emit, out_vals, out_ivals,
+                        emit_anchor, ovf)
 
-            def _place(mask, anchor, src_regs, t, carry):
+            def _place(mask, anchor, src_regs, t, carry, src_iregs=None):
                 """Move instances in ``mask`` into free lanes of node
                 ``t``.  Slot allocation is rank-matched (k-th advancing
                 instance takes the k-th free lane); advancers beyond the
                 free-lane count are dropped and counted in ``overflow`` —
                 explicit capacity where the reference grows an unbounded
                 list."""
-                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                 free = ~a[:, t, :] & (counts[:, t, :] == 0)  # [B, I]
                 src_rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
                 free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
@@ -503,36 +661,67 @@ class DensePatternEngine:
                 a = a.at[:, t, :].set(a[:, t, :] | got)
                 regs = regs.at[:, t, :, :].set(
                     jnp.where(got[:, :, None], moved_regs, regs[:, t, :, :]))
+                if iregs.shape[-1]:
+                    si = iregs[:, t - 1, :, :] if src_iregs is None else src_iregs
+                    moved_iregs = jnp.sum(
+                        jnp.where(assign[:, :, :, None], si[:, :, None, :], 0),
+                        axis=1)
+                    iregs = iregs.at[:, t, :, :].set(
+                        jnp.where(got[:, :, None], moved_iregs,
+                                  iregs[:, t, :, :]))
                 first = first.at[:, t, :].set(
                     jnp.where(got, moved_anchor.astype(jnp.int32),
                               first[:, t, :]))
                 counts = counts.at[:, t, :].set(
                     jnp.where(got, 0, counts[:, t, :]))
-                return (a, first, counts, regs, emit, out_vals, emit_anchor,
-                        ovf)
+                return (a, first, counts, regs, iregs, emit, out_vals, out_ivals,
+                        emit_anchor, ovf)
 
             def _advance(s, mask, carry):
                 """Instances (lanes of node s) in ``mask`` complete node s:
                 emit (last node) or move into free lanes of node s+1."""
-                a, first, counts, regs = carry[0], carry[1], carry[2], carry[3]
+                a, first, counts, regs, iregs = (
+                    carry[0], carry[1], carry[2], carry[3], carry[4])
                 anchor = jnp.where(first[:, s, :] > 0, first[:, s, :],
                                    ts[:, None])  # [B, I]
                 if s == S - 1:
-                    return _emit_rows(mask, anchor, regs[:, s, :, :], carry)
-                return _place(mask, anchor, regs[:, s, :, :], s + 1, carry)
+                    return _emit_rows(mask, anchor, regs[:, s, :, :], carry,
+                                      src_iregs=iregs[:, s, :, :])
+                return _place(mask, anchor, regs[:, s, :, :], s + 1, carry,
+                              src_iregs=iregs[:, s, :, :])
+
+            def write_slot(regs, iregs, s, slot, upd):
+                """Capture the current event into one register slot of
+                node ``s`` for lanes in ``upd`` (float bank or hi/lo
+                integer pair bank by slot kind)."""
+                if slot.integer:
+                    hk, lk = f"{slot.attr}|hi", f"{slot.attr}|lo"
+                    if hk in cols:
+                        iregs = iregs.at[:, s, :, 2 * slot.index].set(
+                            jnp.where(upd, cols[hk][:, None],
+                                      iregs[:, s, :, 2 * slot.index]))
+                        iregs = iregs.at[:, s, :, 2 * slot.index + 1].set(
+                            jnp.where(upd, cols[lk][:, None],
+                                      iregs[:, s, :, 2 * slot.index + 1]))
+                elif slot.attr in cols:
+                    regs = regs.at[:, s, :, slot.index].set(
+                        jnp.where(upd,
+                                  cols[slot.attr].astype(jnp.float32)[:, None],
+                                  regs[:, s, :, slot.index]))
+                return regs, iregs
 
             lane0 = jnp.zeros((B, I), dtype=bool).at[:, 0].set(True)
-            carry = (a, first, counts, regs, emit, out_vals, emit_anchor, ovf)
+            carry = (a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf)
             for s in reversed(range(S)):
-                a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                 node = nodes[s]
                 spec = node.specs[0]
                 if node.kind == "logical":
                     sides = [i for i, sp in enumerate(node.specs)
                              if sp.stream_key == stream_key]
                     if not sides:
-                        carry = (a, first, counts, regs, emit, out_vals,
-                                 emit_anchor, ovf)
+                        carry = (a, first, counts, regs, iregs, emit, out_vals,
+                                 out_ivals, emit_anchor, ovf)
                         continue
                     pending = a[:, s, :]
                     if s == 0 and every_start:
@@ -549,12 +738,8 @@ class DensePatternEngine:
                             jnp.where(fire, counts[:, s, :] | (1 << si),
                                       counts[:, s, :]))
                         for slot in self.node_writes[s]:
-                            if slot.ref == node.specs[si].ref and slot.attr in cols:
-                                regs = regs.at[:, s, :, slot.index].set(
-                                    jnp.where(
-                                        fire,
-                                        cols[slot.attr].astype(jnp.float32)[:, None],
-                                        regs[:, s, :, slot.index]))
+                            if slot.ref == node.specs[si].ref:
+                                regs, iregs = write_slot(regs, iregs, s, slot, fire)
                         first = first.at[:, s, :].set(
                             jnp.where(fire & (first[:, s, :] == 0), ts[:, None],
                                       first[:, s, :]))
@@ -569,9 +754,9 @@ class DensePatternEngine:
                         else (need > 0)
                     ) & pending & valid[:, None]
                     carry = _advance(s, complete,
-                                     (a, first, counts, regs, emit, out_vals,
-                                      emit_anchor, ovf))
-                    a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                                     (a, first, counts, regs, iregs, emit, out_vals,
+                                      out_ivals, emit_anchor, ovf))
+                    a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                     # a completed logical node releases its lane (the host
                     # instance moves on); the lane-0 virgin re-arms fresh
                     a = a.at[:, s, :].set(a[:, s, :] & ~complete)
@@ -579,12 +764,12 @@ class DensePatternEngine:
                         jnp.where(complete, 0, counts[:, s, :]))
                     first = first.at[:, s, :].set(
                         jnp.where(complete, 0, first[:, s, :]))
-                    carry = (a, first, counts, regs, emit, out_vals,
-                             emit_anchor, ovf)
+                    carry = (a, first, counts, regs, iregs, emit, out_vals,
+                             out_ivals, emit_anchor, ovf)
                     continue
                 if spec.stream_key != stream_key:
-                    carry = (a, first, counts, regs, emit, out_vals,
-                             emit_anchor, ovf)
+                    carry = (a, first, counts, regs, iregs, emit, out_vals,
+                             out_ivals, emit_anchor, ovf)
                     continue
                 is_count = not (node.min_count == 1 and node.max_count == 1)
                 pending = a[:, s, :]
@@ -623,13 +808,10 @@ class DensePatternEngine:
                     # a counting lane is occupied from its first capture
                     a = a.at[:, s, :].set(a[:, s, :] | first_cap)
                     for slot in self.node_writes[s]:
-                        if slot.ref != spec.ref or slot.attr not in cols:
+                        if slot.ref != spec.ref:
                             continue
                         upd = cap if slot.last else first_cap
-                        regs = regs.at[:, s, :, slot.index].set(
-                            jnp.where(upd,
-                                      cols[slot.attr].astype(jnp.float32)[:, None],
-                                      regs[:, s, :, slot.index]))
+                        regs, iregs = write_slot(regs, iregs, s, slot, upd)
                     first = first.at[:, s, :].set(
                         jnp.where(first_cap & (first[:, s, :] == 0), ts[:, None],
                                   first[:, s, :]))
@@ -642,9 +824,9 @@ class DensePatternEngine:
                         # (emitted_at_node semantics — later captures
                         # don't re-emit because advance fires at == min)
                         carry = _advance(s, advance,
-                                         (a, first, counts, regs, emit,
-                                          out_vals, emit_anchor, ovf))
-                        a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                                         (a, first, counts, regs, iregs, emit,
+                                          out_vals, out_ivals, emit_anchor, ovf))
+                        a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                     # lane lifecycle at max: exact counts are spent (their
                     # advance already placed the instance); open counts
                     # MOVE the still-pending instance to s+1 at max
@@ -658,25 +840,24 @@ class DensePatternEngine:
                                 first[:, s, :] > 0, first[:, s, :], ts[:, None])
                             carry = _place(at_max, anchor_s, regs[:, s, :, :],
                                            s + 1,
-                                           (a, first, counts, regs, emit,
-                                            out_vals, emit_anchor, ovf))
-                            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                                           (a, first, counts, regs, iregs,
+                                            emit, out_vals, out_ivals,
+                                            emit_anchor, ovf),
+                                           src_iregs=iregs[:, s, :, :])
+                            a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                         a = a.at[:, s, :].set(a[:, s, :] & ~at_max)
                         counts = counts.at[:, s, :].set(
                             jnp.where(at_max, 0, counts[:, s, :]))
                         first = first.at[:, s, :].set(
                             jnp.where(at_max, 0, first[:, s, :]))
-                    carry = (a, first, counts, regs, emit, out_vals,
-                             emit_anchor, ovf)
+                    carry = (a, first, counts, regs, iregs, emit, out_vals,
+                             out_ivals, emit_anchor, ovf)
                 else:
                     # capture the node's slots for real pending lanes
                     for slot in self.node_writes[s]:
-                        if slot.ref != spec.ref or slot.attr not in cols:
+                        if slot.ref != spec.ref:
                             continue
-                        regs = regs.at[:, s, :, slot.index].set(
-                            jnp.where(fire,
-                                      cols[slot.attr].astype(jnp.float32)[:, None],
-                                      regs[:, s, :, slot.index]))
+                        regs, iregs = write_slot(regs, iregs, s, slot, fire)
                     if s == 0 and every_start:
                         # fresh arming each event: the within anchor must
                         # be this event's ts, not a stale one
@@ -693,8 +874,8 @@ class DensePatternEngine:
                     if not keep_armed:
                         a = a.at[:, s, :].set(a[:, s, :] & ~fire)
                     carry = _advance(s, fire,
-                                     (a, first, counts, regs, emit, out_vals,
-                                      emit_anchor, ovf))
+                                     (a, first, counts, regs, iregs, emit, out_vals,
+                                      out_ivals, emit_anchor, ovf))
                     # via-path: a dually-pending open count at s-1 clones
                     # straight through this node on the same event
                     # (reference: _try_enter from a satisfied count
@@ -708,7 +889,7 @@ class DensePatternEngine:
                                  or prev.max_count > prev.min_count)
                         )
                         if prev_open:
-                            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+                            a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                             sat = (a[:, s - 1, :]
                                    & (counts[:, s - 1, :] >= max(prev.min_count, 1)))
                             if prev.max_count != ANY:
@@ -716,7 +897,7 @@ class DensePatternEngine:
                             ok_via = (
                                 jnp.broadcast_to(jnp.asarray(
                                     node_filters[s][0].fn(
-                                        env_for(s, cols, ts, regs,
+                                        env_for(s, cols, ts, regs, iregs,
                                                 regs_node=s - 1))).astype(bool),
                                     (B, I))
                                 if node_filters[s][0] is not None
@@ -724,27 +905,44 @@ class DensePatternEngine:
                             )
                             fire_via = sat & ok_via & valid[:, None]
                             via_regs = regs[:, s - 1, :, :]
+                            via_iregs = iregs[:, s - 1, :, :]
                             for slot in self.node_writes[s]:
-                                if slot.ref != spec.ref or slot.attr not in cols:
+                                if slot.ref != spec.ref:
                                     continue
-                                via_regs = via_regs.at[:, :, slot.index].set(
-                                    jnp.where(
-                                        fire_via,
-                                        cols[slot.attr].astype(jnp.float32)[:, None],
-                                        via_regs[:, :, slot.index]))
+                                if slot.integer:
+                                    hk, lk = (f"{slot.attr}|hi",
+                                              f"{slot.attr}|lo")
+                                    if hk not in cols:
+                                        continue
+                                    via_iregs = via_iregs.at[
+                                        :, :, 2 * slot.index].set(jnp.where(
+                                            fire_via, cols[hk][:, None],
+                                            via_iregs[:, :, 2 * slot.index]))
+                                    via_iregs = via_iregs.at[
+                                        :, :, 2 * slot.index + 1].set(jnp.where(
+                                            fire_via, cols[lk][:, None],
+                                            via_iregs[:, :, 2 * slot.index + 1]))
+                                elif slot.attr in cols:
+                                    via_regs = via_regs.at[:, :, slot.index].set(
+                                        jnp.where(
+                                            fire_via,
+                                            cols[slot.attr].astype(jnp.float32)[:, None],
+                                            via_regs[:, :, slot.index]))
                             via_anchor = jnp.where(
                                 first[:, s - 1, :] > 0, first[:, s - 1, :],
                                 ts[:, None])
-                            carry = (a, first, counts, regs, emit, out_vals,
-                                     emit_anchor, ovf)
+                            carry = (a, first, counts, regs, iregs, emit,
+                                     out_vals, out_ivals, emit_anchor, ovf)
                             if s == S - 1:
                                 carry = _emit_rows(fire_via, via_anchor,
-                                                   via_regs, carry, bank=1)
+                                                   via_regs, carry, bank=1,
+                                                   src_iregs=via_iregs)
                             else:
                                 carry = _place(fire_via, via_anchor, via_regs,
-                                               s + 1, carry)
+                                               s + 1, carry,
+                                               src_iregs=via_iregs)
 
-            a, first, counts, regs, emit, out_vals, emit_anchor, ovf = carry
+            a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
 
             # emission restart
             if reset_on_emit:
@@ -755,7 +953,7 @@ class DensePatternEngine:
 
             # scatter back (valid rows only)
             v1 = valid[:, None, None]
-            state = {
+            new_state = {
                 "active": state["active"].at[part_idx].set(
                     jnp.where(v1, a, state["active"][part_idx])
                 ),
@@ -773,7 +971,12 @@ class DensePatternEngine:
                     jnp.where(valid, ovf, state["overflow"][part_idx])
                 ),
             }
-            return state, emit, out_vals, emit_anchor
+            if "iregs" in state:
+                new_state["iregs"] = state["iregs"].at[part_idx].set(
+                    jnp.where(valid[:, None, None, None], iregs,
+                              state["iregs"][part_idx]))
+            # outs is a pytree: float lanes + integer hi/lo pair lanes
+            return new_state, emit, {"f": out_vals, "i": out_ivals}, emit_anchor
 
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[cache_key] = fn
@@ -862,7 +1065,7 @@ class DensePatternEngine:
         rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
         state, rel64 = self.maybe_re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
-        n = len(part_idx)
+        prepared = self.prepare_cols(stream_key, cols)
         ev_parts: List[np.ndarray] = []
         out_parts: List[np.ndarray] = []
         key_parts: List[np.ndarray] = []  # (ev, anchor, lane) sort keys
@@ -876,29 +1079,52 @@ class DensePatternEngine:
             valid = np.zeros(bp, dtype=bool)
             valid[:b] = True
             cb = {}
-            for k, v in cols.items():
-                col = np.zeros(bp, dtype=np.float32)
-                col[:b] = v[ridx].astype(np.float32)
+            for k, v in prepared.items():
+                col = np.zeros(bp, dtype=v.dtype)
+                col[:b] = v[ridx]
                 cb[k] = jnp.asarray(col)
-            state, emit, out_vals, emit_anchor = step(
+            state, emit, outs, emit_anchor = step(
                 state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
             )
             # device->host: fetch the emit mask, then the output values
             # only when something matched — matches are rare in CEP, so
             # the common batch costs ONE transfer round trip, not two
             # (transfers are expensive on tunneled/remote devices)
-            emit_np = np.asarray(emit)[:b]  # [b, I]
+            emit_np = np.asarray(emit)[:b]  # [b, 2I]
             if emit_np.any():
-                out_np = np.asarray(out_vals)[:b]
+                out_f = np.asarray(outs["f"])[:b]
+                out_i = np.asarray(outs["i"])[:b]
                 anchor_np = np.asarray(emit_anchor)[:b]
                 rows, lanes = np.nonzero(emit_np)
                 ev_parts.append(ridx[rows])
-                out_parts.append(out_np[rows, lanes])
+                out_parts.append(self.assemble_out(out_f, out_i, rows, lanes))
                 key_parts.append(np.stack(
                     [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
         ev, out = flatten_match_parts(
             ev_parts, out_parts, key_parts, max(len(self.out_spec), 1))
         return state, ev, out
+
+    def assemble_out(self, out_f: np.ndarray, out_i: np.ndarray,
+                     rows: np.ndarray, lanes: np.ndarray) -> np.ndarray:
+        """Match output rows from the device banks: float lanes stay
+        float32; integer lanes re-join their hi/lo pair into exact
+        int64.  All-float engines return a float32 [m, O] matrix (the
+        historical shape); engines with integer outputs return an
+        object-dtype matrix carrying exact per-column values."""
+        if not any(self.out_int):
+            return out_f[rows, lanes]
+        m = len(rows)
+        res = np.empty((m, len(self.out_spec)), dtype=object)
+        ii = 0
+        for oi, is_int in enumerate(self.out_int):
+            if is_int:
+                hi = out_i[rows, lanes, 2 * ii]
+                lo = out_i[rows, lanes, 2 * ii + 1]
+                res[:, oi] = _i64_join(hi, lo)
+                ii += 1
+            else:
+                res[:, oi] = out_f[rows, lanes, oi].astype(np.float64)
+        return res
 
     @property
     def output_names(self) -> List[str]:
@@ -932,15 +1158,51 @@ class DensePatternEngine:
         raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
 
     def numeric_stream_attrs(self, stream_key: str) -> List[str]:
-        """Device-lane column keys (numeric attrs only — strings stay
-        host-side as interned partition keys); the fixed col-dict
-        structure of shard_map in_specs."""
+        """Numeric attribute names of one stream (strings stay host-side
+        as interned partition keys)."""
+        return [a.name for a in self._stream_def(stream_key).attributes
+                if a.type.is_numeric]
+
+    def _stream_def(self, stream_key: str):
         for node in self.nodes:
             for spec in node.specs:
                 if spec.stream_key == stream_key:
-                    return [a.name for a in spec.stream_def.attributes
-                            if a.type.is_numeric]
+                    return spec.stream_def
         raise SiddhiAppCreationError(f"stream '{stream_key}' not in pattern")
+
+    def device_col_keys(self, stream_key: str) -> List[str]:
+        """Exact device col-dict keys the step expects: float attrs ride
+        one float32 lane, integer attrs ride an ``|hi``/``|lo`` int32
+        pair — the fixed pytree structure of shard_map in_specs."""
+        keys: List[str] = []
+        for a in self._stream_def(stream_key).attributes:
+            if not a.type.is_numeric:
+                continue
+            if a.type in _INT_TYPES:
+                keys.extend((f"{a.name}|hi", f"{a.name}|lo"))
+            else:
+                keys.append(a.name)
+        return keys
+
+    def prepare_cols(self, stream_key: str,
+                     cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Host numpy columns (native dtypes) -> device lane columns:
+        float attrs cast to float32, integer attrs split into the
+        bias-signed hi/lo int32 pair (bit-exact at any magnitude)."""
+        out: Dict[str, np.ndarray] = {}
+        for a in self._stream_def(stream_key).attributes:
+            v = cols.get(a.name)
+            if v is None:
+                continue
+            v = np.asarray(v)
+            if a.type in _INT_TYPES:
+                v64 = v.astype(np.int64)
+                out[f"{a.name}|hi"] = (v64 >> 32).astype(np.int32)
+                out[f"{a.name}|lo"] = (
+                    (v64 & 0xFFFFFFFF) - 2**31).astype(np.int32)
+            elif a.type.is_numeric:
+                out[a.name] = v.astype(np.float32)
+        return out
 
 
 def flatten_match_parts(ev_parts, out_parts, key_parts, n_out: int

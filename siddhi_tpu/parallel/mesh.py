@@ -178,7 +178,7 @@ class ShardedPatternEngine:
         self.rows_per_shard = self.parts_per_shard + 1
 
         self.stream_key = stream_key or engine.default_stream
-        self.col_keys = engine.numeric_stream_attrs(self.stream_key)
+        self.col_keys = engine.device_col_keys(self.stream_key)
         step = engine.make_step(self.stream_key, jit=False)
         jnp = engine.jnp
         a = axis_name
@@ -194,10 +194,10 @@ class ShardedPatternEngine:
         specs = self.state_specs
 
         def sharded_step(state, part, cols, ts, valid):
-            new_state, emit, out_vals, anchor = step(state, part, cols, ts, valid)
+            new_state, emit, outs, anchor = step(state, part, cols, ts, valid)
             local = jnp.sum(emit.astype(jnp.int32))
             total = jax.lax.psum(local, axis_name=a)
-            return new_state, emit, out_vals, anchor, total
+            return new_state, emit, outs, anchor, total
 
         # donate the state pytree: at 1M+ partitions the rows dominate
         # HBM and double-buffering them would halve capacity
@@ -206,7 +206,9 @@ class ShardedPatternEngine:
             mesh=mesh,
             in_specs=(specs, P(a), {k: P(a) for k in self.col_keys},
                       P(a), P(a)),
-            out_specs=(specs, P(a, None), P(a, None, None), P(a, None), P()),
+            out_specs=(specs, P(a, None),
+                       {"f": P(a, None, None), "i": P(a, None, None)},
+                       P(a, None), P()),
         ), donate_argnums=(0,))
         self._P = P
         self._NamedSharding = NamedSharding
@@ -243,7 +245,9 @@ class ShardedPatternEngine:
     def route(self, part, cols, ts, batch_per_shard=None):
         """Host arrays -> device arrays routed/padded per shard; also
         returns the input->slot map.  Caller contract: at most one event
-        per partition per call, timestamps already relative int32."""
+        per partition per call, timestamps already relative int32, cols
+        already device-lane columns (engine.prepare_cols: float32 floats
+        + int32 hi/lo pairs)."""
         P = self._P
         a = self.axis_name
         lp, rc, rts, valid, pos = route_to_shards(
@@ -251,7 +255,7 @@ class ShardedPatternEngine:
             batch_per_shard)
         return (
             self._put(lp, P(a)),
-            {k: self._put(np.asarray(v, dtype=np.float32), P(a)) for k, v in rc.items()},
+            {k: self._put(np.asarray(v), P(a)) for k, v in rc.items()},
             self._put(np.asarray(rts, dtype=np.int32), P(a)),
             self._put(valid, P(a)),
         ), pos
@@ -281,6 +285,7 @@ class ShardedPatternEngine:
             state, rel64,
             to_device=lambda k, v: self._put(v, self.state_specs[k]))
         rel = rel64.astype(np.int32)
+        prepared = self.engine.prepare_cols(self.stream_key, cols)
         ev_parts: List[np.ndarray] = []
         out_parts: List[np.ndarray] = []
         key_parts: List[np.ndarray] = []
@@ -288,18 +293,20 @@ class ShardedPatternEngine:
         for ridx in _collision_rounds(part):
             args, pos = self.route(
                 part[ridx],
-                {k: np.asarray(v)[ridx] for k, v in cols.items()},
+                {k: v[ridx] for k, v in prepared.items()},
                 rel[ridx],
             )
-            state, emit, out_vals, anchor, round_total = self.step(state, *args)
+            state, emit, outs, anchor, round_total = self.step(state, *args)
             total += int(round_total)
-            emit_np = np.asarray(emit)[pos]  # [b, I]
+            emit_np = np.asarray(emit)[pos]  # [b, 2I]
             if emit_np.any():
-                out_np = np.asarray(out_vals)[pos]
+                out_f = np.asarray(outs["f"])[pos]
+                out_i = np.asarray(outs["i"])[pos]
                 anchor_np = np.asarray(anchor)[pos]
                 rows, lanes = np.nonzero(emit_np)
                 ev_parts.append(ridx[rows])
-                out_parts.append(out_np[rows, lanes])
+                out_parts.append(
+                    self.engine.assemble_out(out_f, out_i, rows, lanes))
                 key_parts.append(np.stack(
                     [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
         from siddhi_tpu.ops.dense_nfa import flatten_match_parts

@@ -82,8 +82,9 @@ class TestDensePath:
         assert dense == host == [[150.0, 200.0], [200.0, 300.0]]
 
     def test_fallback_on_long_filter_operand(self, manager):
-        # filters comparing LONG attributes would collide above 2^24 in
-        # float32 columns — host engine keeps exact semantics
+        # LONG filter comparisons ride the bit-exact hi/lo int32 pair
+        # bank — values one apart above 2^24 (where float32 would
+        # collide) still distinguish, ON the dense path
         app = TPU + (
             "define stream Txn (card long, amount double); "
             "@info(name='q') "
@@ -95,9 +96,9 @@ class TestDensePath:
             ([16777217, 140.0], 1500),
             ([16777217, 200.0], 2000),
         ])
-        assert not isinstance(
+        assert isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
-        assert got == [[140.0, 200.0]]  # exact host comparison
+        assert got == [[140.0, 200.0]]  # exact dense comparison
 
     def test_host_mode_untouched(self, manager):
         rt, _ = run_app(manager, PATTERN_APP, SENDS)
@@ -249,9 +250,10 @@ class TestDensePartition:
 
 
 class TestReviewRegressions:
-    def test_fallback_on_long_capture(self, manager):
-        """INT/LONG captures fall back to the exact host engine (float32
-        register lanes would round card numbers above 2^24)."""
+    def test_long_capture_lowers_dense_and_exact(self, manager):
+        """LONG captures/selects ride the hi/lo int32 pair bank: the
+        card-number query lowers densely and round-trips bit-exact far
+        above 2^24 (round-3 verdict item 6's done-criterion)."""
         app = TPU + (
             "define stream Txn (card long, amount double); "
             "@info(name='q') "
@@ -262,9 +264,9 @@ class TestReviewRegressions:
             ([4111111111111111, 150.0], 1000),
             ([4111111111111111, 200.0], 2000),
         ])
-        assert not isinstance(
+        assert isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
-        assert got == [[4111111111111111, 200.0]]  # exact on host path
+        assert got == [[4111111111111111, 200.0]]  # exact on the dense path
 
     def test_partitions_element_validated(self, manager):
         import pytest as _pytest

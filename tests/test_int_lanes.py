@@ -1,0 +1,212 @@
+"""Integer (INT/LONG) lanes in the dense NFA: bit-exact at any magnitude.
+
+Round-3 verdict item 6: INT/LONG attributes forced host fallback because
+the register bank was float32.  They now ride hi/lo int32 pairs —
+captures, selects, and plain comparisons (==, !=, <, <=, >, >=) are
+bit-exact far above 2^24 and 2^53, matching the reference's per-type
+executors (executor/math/, condition/compare/); integer arithmetic
+still falls back.  Every case here runs host vs @app:execution('tpu')
+through the public API and requires identical output.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+TPU = "@app:playback @app:execution('tpu') "
+
+
+def run(app, sends, mode_tpu, stream="S", out="Alerts"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            (TPU if mode_tpu else "@app:playback ") + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler(stream)
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
+        qr = next(iter(rt.query_runtimes.values()))
+        runtime = getattr(qr, "pattern_processor", None)
+        rt.shutdown()
+        return got, runtime
+    finally:
+        m.shutdown()
+
+
+def differential(app, sends, **kw):
+    host, _ = run(app, sends, mode_tpu=False, **kw)
+    dense, runtime = run(app, sends, mode_tpu=True, **kw)
+    assert isinstance(runtime, DensePatternRuntime), "did not lower densely"
+    assert dense == host, f"dense {dense} != host {host}"
+    return host
+
+
+BIG = 4_111_111_111_111_111          # 16-digit card number, > 2^32
+HUGE = 9_220_000_000_000_000_123     # near int64 max, > 2^53
+NEG = -9_220_000_000_000_000_123
+
+
+class TestIntCaptureSelect:
+    def test_long_capture_roundtrip_above_2p53(self):
+        app = ("define stream S (card long, v double); "
+               "@info(name='q') from a=S[v > 1.0] -> b=S[v > a.v] "
+               "select a.card as c, b.v as bv insert into Alerts;")
+        host = differential(app, [
+            ([HUGE, 2.0], 1000),
+            ([HUGE - 1, 3.0], 1100),
+        ])
+        assert host == [[HUGE, 3.0]]
+
+    def test_negative_long_roundtrip(self):
+        app = ("define stream S (card long, v double); "
+               "@info(name='q') from a=S[v > 1.0] -> b=S[v > a.v] "
+               "select a.card as c insert into Alerts;")
+        host = differential(app, [([NEG, 2.0], 1000), ([0, 3.0], 1100)])
+        assert host == [[NEG]]
+
+    def test_int_candidate_select_from_last_node(self):
+        app = ("define stream S (n int, v double); "
+               "@info(name='q') from a=S[v > 1.0] -> b=S[v > a.v] "
+               "select a.n as an, b.n as bn insert into Alerts;")
+        host = differential(app, [
+            ([2_000_000_001, 2.0], 1000),
+            ([2_000_000_002, 3.0], 1100),
+        ])
+        assert host == [[2_000_000_001, 2_000_000_002]]
+
+
+class TestIntCompares:
+    def test_equality_join_on_long_id(self):
+        # the canonical CEP id-join: b[card == a.card]
+        app = ("define stream S (card long, v double); "
+               "@info(name='q') from every a=S[v > 100.0] "
+               "-> b=S[card == a.card] within 10 min "
+               "select a.v as av, b.v as bv insert into Alerts;")
+        host = differential(app, [
+            ([BIG, 150.0], 1000),
+            ([BIG + 1, 50.0], 1100),   # adjacent id must NOT join
+            ([BIG, 60.0], 1200),       # joins
+        ])
+        assert host == [[150.0, 60.0]]
+
+    def test_ordering_compares_cross_word_boundary(self):
+        # hi words equal, lo words differ across the 2^31 bias point —
+        # the (hi, lo-biased) lexicographic order must hold
+        base = (7 << 32)
+        lo_small = base + 5
+        lo_big = base + 0x8000_0005  # low word crosses the sign bit
+        app = ("define stream S (seq long, v double); "
+               "@info(name='q') from every a=S[v > 0.0] "
+               "-> b=S[seq > a.seq] within 10 min "
+               "select a.seq as sa, b.seq as sb insert into Alerts;")
+        host = differential(app, [
+            ([lo_big, 1.0], 1000),
+            ([lo_small, 1.0], 1100),  # smaller: not b for first arm
+            ([lo_big + 1, 1.0], 1200),
+        ])
+        assert [r[:2] for r in host] == [
+            [lo_big, lo_big + 1], [lo_small, lo_big + 1]]
+
+    def test_long_constant_compare(self):
+        app = ("define stream S (card long, v double); "
+               f"@info(name='q') from a=S[card == {BIG}] -> b=S[v > a.v] "
+               "select a.card as c, b.v as bv insert into Alerts;")
+        host = differential(app, [
+            ([BIG + 1, 1.0], 1000),   # adjacent id: must not arm
+            ([BIG, 1.0], 1100),
+            ([0, 2.0], 1200),
+        ])
+        assert host == [[BIG, 2.0]]
+
+    def test_negative_vs_positive_ordering(self):
+        app = ("define stream S (x long, v double); "
+               "@info(name='q') from every a=S[v > 0.0] -> b=S[x < a.x] "
+               "within 10 min select a.x as ax, b.x as bx "
+               "insert into Alerts;")
+        host = differential(app, [
+            ([5, 1.0], 1000),
+            ([-3, 1.0], 1100),       # -3 < 5: completes first arm
+        ])
+        assert host[0] == [5, -3]
+
+
+class TestIntFallbacks:
+    def test_int_literal_on_float_lane_stays_dense(self):
+        """An unsuffixed integer literal against a double attribute —
+        [v > 100] — is the commonest filter shape; it must stay ON the
+        dense path (review regression)."""
+        app = ("define stream S (v double); "
+               "@info(name='q') from every a=S[v > 100] -> b=S[v > a.v] "
+               "within 10 min select a.v as av, b.v as bv "
+               "insert into Alerts;")
+        host = differential(app, [([150.0], 1000), ([200.0], 1100)])
+        assert host == [[150.0, 200.0]]
+
+    def test_string_select_falls_back_not_zero(self):
+        """A STRING select item has no device lane: the query must fall
+        back to the host engine, not emit 0.0 (review regression)."""
+        app = ("define stream S (name string, v double); "
+               "@info(name='q') from every a=S[v > 1.0] -> b=S[v > a.v] "
+               "within 10 min select a.name as nm, b.v as bv "
+               "insert into Alerts;")
+        got, runtime = run(app, [(["alice", 2.0], 1000),
+                                 (["bob", 3.0], 1100)], mode_tpu=True)
+        assert not isinstance(runtime, DensePatternRuntime)
+        assert got == [["alice", 3.0]]
+
+    def test_integer_arithmetic_falls_back(self):
+        app = ("define stream S (n long, v double); "
+               "@info(name='q') from every a=S[v > 0.0] "
+               "-> b=S[n == a.n + 1] within 10 min "
+               "select a.v as av insert into Alerts;")
+        _got, runtime = run(app, [([1, 1.0], 1000)], mode_tpu=True)
+        assert not isinstance(runtime, DensePatternRuntime)
+
+    def test_int_float_mixed_compare_falls_back(self):
+        app = ("define stream S (n long, v double); "
+               "@info(name='q') from every a=S[v > 0.0] -> b=S[v > a.n] "
+               "within 10 min select a.v as av insert into Alerts;")
+        _got, runtime = run(app, [([1, 1.0], 1000)], mode_tpu=True)
+        assert not isinstance(runtime, DensePatternRuntime)
+
+
+class TestIntPartitionedSharded:
+    def test_long_id_join_partitioned_and_sharded(self):
+        app = (
+            "define stream S (user string, sess long, v double); "
+            "partition with (user of S) begin "
+            "@info(name='q') from every a=S[v > 10.0] "
+            "-> b=S[sess == a.sess] within 10 min "
+            "select a.sess as sa, b.v as bv insert into Alerts; end;")
+        sends = [(["u1", HUGE, 20.0], 1000),
+                 (["u2", BIG, 30.0], 1100),
+                 (["u1", HUGE, 5.0], 1200),     # joins u1's arm
+                 (["u2", BIG + 1, 5.0], 1300),  # wrong session: no join
+                 (["u2", BIG, 6.0], 1400)]      # joins u2's arm
+
+        def run_p(header):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(header + app)
+                got = []
+                rt.add_callback("Alerts",
+                                lambda evs: got.extend(e.data for e in evs))
+                rt.start()
+                h = rt.get_input_handler("S")
+                for row, ts in sends:
+                    h.send(row, timestamp=ts)
+                rt.shutdown()
+                return got
+            finally:
+                m.shutdown()
+
+        host = run_p("@app:playback ")
+        dense = run_p("@app:playback @app:execution('tpu', partitions='64') ")
+        sharded = run_p("@app:playback @app:execution('tpu', "
+                        "partitions='64', devices='8') ")
+        assert dense == host == [[HUGE, 5.0], [BIG, 6.0]]
+        assert sharded == host
