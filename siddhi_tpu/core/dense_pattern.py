@@ -125,29 +125,6 @@ def build_dense_engine(query, st: StateInputStream, resolve_def,
     return eng
 
 
-def _walk_variables(expr):
-    """Yield every Variable node of an expression tree (read-only walk)."""
-    from siddhi_tpu.query_api import (
-        AndOp, ArithmeticOp, CompareOp, FunctionCall, InOp, IsNull, NotOp,
-        OrOp,
-    )
-
-    if isinstance(expr, Variable):
-        yield expr
-    elif isinstance(expr, (AndOp, OrOp, ArithmeticOp, CompareOp)):
-        yield from _walk_variables(expr.left)
-        yield from _walk_variables(expr.right)
-    elif isinstance(expr, NotOp):
-        yield from _walk_variables(expr.expr)
-    elif isinstance(expr, IsNull):
-        yield from _walk_variables(expr.expr)
-    elif isinstance(expr, InOp):
-        yield from _walk_variables(expr.expr)
-    elif isinstance(expr, FunctionCall):
-        for a in expr.args:
-            yield from _walk_variables(a)
-
-
 def output_attr_types(eng) -> List[AttrType]:
     """Declared attribute type of each engine output lane (the engine
     computes in float32; callbacks/definitions keep the source types)."""

@@ -362,6 +362,77 @@ class EventRateLimiter(OutputRateLimiter):
         self._count, self._held = state["count"], state["held"]
 
 
+class GroupByEventRateLimiter(OutputRateLimiter):
+    """`output <first|last> every N events` on a GROUPED query: first/last
+    PER GROUP within each N-event window (reference:
+    ratelimit/event/FirstGroupByPerEventOutputRateLimiter.java,
+    LastGroupByPerEventOutputRateLimiter.java)."""
+
+    def __init__(self, n: int, mode: str):
+        self.n = n
+        self.mode = mode  # first | last
+        self._count = 0
+        self._seen: set = set()          # first: groups emitted this window
+        # last: group -> held single-row batch (previous batches) or a
+        # row index into the CURRENT batch; dict order == first arrival
+        # of the group in the window (python dicts keep a key's position
+        # on overwrite, matching the reference's LinkedHashMap)
+        self._last: Dict = {}
+
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        nrows = len(batch)
+        if nrows == 0:
+            return None
+        keys = batch.aux.get("group_keys")
+        outs: List[EventBatch] = []
+        first_rows: List[int] = []
+
+        def _flush_last():
+            if not self._last:
+                return
+            pieces = [
+                v if isinstance(v, EventBatch) else batch.take(np.asarray([v]))
+                for v in self._last.values()
+            ]
+            outs.append(EventBatch.concat(pieces))
+            self._last.clear()
+
+        for i in range(nrows):
+            k = keys[i] if keys is not None and i < len(keys) else None
+            if self.mode == "first":
+                if k not in self._seen:
+                    self._seen.add(k)
+                    first_rows.append(i)
+            else:
+                self._last[k] = i  # local index; materialized lazily
+            self._count += 1
+            if self._count % self.n == 0:  # window closes
+                if self.mode == "first":
+                    self._seen.clear()
+                else:
+                    _flush_last()
+        if self.mode == "last":
+            # batch ends with the window open: pin surviving local rows
+            # (one take per GROUP, not per row)
+            for k, v in list(self._last.items()):
+                if not isinstance(v, EventBatch):
+                    self._last[k] = batch.take(np.asarray([v]))
+        if self.mode == "first" and first_rows:
+            outs.insert(0, batch.take(np.asarray(first_rows)))
+        if not outs:
+            return None
+        return outs[0] if len(outs) == 1 else EventBatch.concat(outs)
+
+    def snapshot(self):
+        return {"count": self._count, "seen": set(self._seen),
+                "last": dict(self._last)}
+
+    def restore(self, state):
+        self._count = state["count"]
+        self._seen = set(state["seen"])
+        self._last = dict(state["last"])
+
+
 class TimeRateLimiter(OutputRateLimiter):
     """`output <all|first|last> every <t>` (reference:
     ratelimit/time/*TimeOutputRateLimiter)."""

@@ -281,7 +281,6 @@ class DensePatternEngine:
 
         self.alloc = RegAllocator()
         self._compile_filters(stream_to_ref)
-        self._warn_integer_precision()
         self._compile_outputs(select_vars, stream_to_ref, select_names)
         # open-ended counts stay dually pending: they capture more events
         # after satisfaction and clone per successor-matching event (the
@@ -314,11 +313,6 @@ class DensePatternEngine:
         self._step_cache: Dict[str, Callable] = {}
 
     # -- compilation --------------------------------------------------------
-
-    def _warn_integer_precision(self):
-        # integer captures now ride the bit-exact hi/lo int32 pair bank
-        # (iregs) — nothing to warn about anymore
-        pass
 
     def _compile_filters(self, stream_to_ref):
         """Per-node filters compiled against candidate columns + registers."""

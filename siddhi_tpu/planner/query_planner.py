@@ -17,6 +17,7 @@ from siddhi_tpu.core.exceptions import (
 from siddhi_tpu.core.query import (
     AggBinding,
     EventRateLimiter,
+    GroupByEventRateLimiter,
     FilterProcessor,
     InsertIntoStreamCallback,
     PassThroughRateLimiter,
@@ -301,7 +302,7 @@ class QueryPlanner:
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
 
         jr = JoinRuntime(
@@ -360,7 +361,7 @@ class QueryPlanner:
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
 
         # presence keys used anywhere in the selector expressions
@@ -477,7 +478,7 @@ class QueryPlanner:
         # fallback to the host path never leaks a live scheduler task;
         # the task handle is kept so multi-query callers (partition
         # lowering) can unregister if a LATER query fails eligibility
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
             task = _RateLimiterTask(qr, rate_limiter)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
@@ -525,7 +526,7 @@ class QueryPlanner:
         for w in windows:
             if w.needs_scheduler:
                 self.app.scheduler.register_window(qr, w)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
         junction = self.app.junction_for_input(s)
         junction.subscribe(ProcessStreamReceiver(qr))
@@ -551,6 +552,14 @@ class QueryPlanner:
         if isinstance(query.output_rate, SnapshotOutputRate):
             raise SiddhiAppCreationError(
                 "snapshot output rate needs the host selector")
+        from siddhi_tpu.query_api import EventOutputRate as _EOR
+
+        if (isinstance(query.output_rate, _EOR)
+                and query.output_rate.type in ("first", "last")
+                and query.selector.group_by):
+            raise SiddhiAppCreationError(
+                "per-group first/last rate limiting needs the host "
+                "selector's group-key side channel")
         if not (s.is_inner or s.is_fault):
             if s.stream_id in self.app.named_windows:
                 raise SiddhiAppCreationError(
@@ -587,7 +596,7 @@ class QueryPlanner:
         # registered LAST: nothing below may raise, so a fallback to the
         # host path never leaks a live scheduler task
         self.app.scheduler.register_task(runtime)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter)):
+        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
             task = _RateLimiterTask(qr, rate_limiter)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
@@ -604,6 +613,10 @@ class QueryPlanner:
         if r is None:
             return PassThroughRateLimiter()
         if isinstance(r, EventOutputRate):
+            if r.type in ("first", "last") and query.selector.group_by:
+                from siddhi_tpu.core.query import GroupByEventRateLimiter
+
+                return GroupByEventRateLimiter(r.events, r.type)
             return EventRateLimiter(r.events, r.type)
         if isinstance(r, TimeOutputRate):
             return TimeRateLimiter(r.value_ms, r.type)
@@ -789,10 +802,15 @@ class QueryPlanner:
                     f"'{out.target}' is not a defined table (delete/update "
                     "targets must be tables)"
                 )
-            # condition + set expressions see the query's *output* attrs
+            # condition + set expressions see the query's *output* attrs,
+            # bare and qualified by the source stream's name (reference
+            # allows `on T.k == S.k` in update/delete conditions)
             out_scope = Scope()
+            src_id = getattr(query.input_stream, "stream_id", None)
             for a in out_def.attributes:
                 out_scope.add_bare(a.name, a.type)
+                if src_id:
+                    out_scope.add(src_id, a.name, a.name, a.type)
             condition = compile_table_condition(
                 table, out.on_condition, out_scope, table_resolver=self.app.table_resolver
             )

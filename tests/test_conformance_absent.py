@@ -1,0 +1,194 @@
+"""Absent-pattern conformance: `not X for t` timing edges.
+
+Ported behavior families from the reference's absent suites
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/pattern/absent/
+AbsentPatternTestCase.java, EveryAbsentPatternTestCase.java,
+LogicalAbsentPatternTestCase.java).  Event-time playback replaces the
+reference's Thread.sleep: a Tick stream advances the watermark so absent
+deadlines fire deterministically.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STREAMS = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+    "define stream Tick (x int); "
+)
+# the Tick consumer keeps the junction alive so ticks always advance the
+# watermark even when no other query reads Tick
+TICK_SINK = "from Tick select x insert into IgnoredTicks; "
+
+
+def run(query, sends, out="OutputStream"):
+    """sends: (stream, row, ts) — rows sent in playback event time."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + STREAMS + TICK_SINK + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestTrailingAbsent:
+    """e1 -> not e2 for T (reference AbsentPatternTestCase 1-8)."""
+
+    Q = ("@info(name='q') from e1=Stream1[price>20] -> "
+         "not Stream2[price>e1.price] for 1 sec "
+         "select e1.symbol as symbol1 insert into OutputStream;")
+
+    def test_emits_when_nothing_arrives(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2500),  # watermark passes the 2000 deadline
+        ])
+        assert got == [["WSO2"]]
+
+    def test_e2_after_deadline_still_emits(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 2100),  # too late to cancel
+        ])
+        assert got == [["WSO2"]]
+
+    def test_e2_within_window_cancels(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 1500),  # cancels the absence
+            ("Tick", [1], 3000),
+        ])
+        assert got == []
+
+    def test_non_matching_e2_does_not_cancel(self):
+        # e2 filter is price > e1.price: a lower price is not "presence"
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 10.0, 100], 1500),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_deadline_boundary_exact(self):
+        # watermark exactly AT the deadline fires it (>=)
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2000),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_without_matching_e1_nothing_fires(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 5.0, 100], 1000),  # fails price>20
+            ("Tick", [1], 5000),
+        ])
+        assert got == []
+
+
+class TestEveryTrailingAbsent:
+    """every e1 -> not e2 for T (reference EveryAbsentPatternTestCase)."""
+
+    Q = ("@info(name='q') from every e1=Stream1[price>20] -> "
+         "not Stream2[price>e1.price] for 1 sec "
+         "select e1.symbol as symbol1 insert into OutputStream;")
+
+    def test_every_arm_fires_independently(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 40.0, 100], 1400),
+            ("Tick", [1], 3000),  # both deadlines (2000, 2400) pass
+        ])
+        assert sorted(g[0] for g in got) == ["GOOG", "WSO2"]
+
+    def test_cancel_one_arm_keep_other(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 40.0, 100], 1400),
+            # cancels BOTH arms? price 60 > 55.6 and > 40.0 — yes both
+            ("Stream2", ["X", 60.0, 1], 1500),
+            ("Tick", [1], 3000),
+        ])
+        assert got == []
+
+    def test_cancel_only_lower_arm(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 40.0, 100], 1400),
+            # 45.0 > 40.0 only: cancels the GOOG arm, WSO2 fires
+            ("Stream2", ["X", 45.0, 1], 1500),
+            ("Tick", [1], 3000),
+        ])
+        assert [g[0] for g in got] == ["WSO2"]
+
+    def test_rearms_after_firing(self):
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2500),   # first absence fires
+            ("Stream1", ["IBM", 30.0, 100], 3000),
+            ("Tick", [1], 4500),   # second absence fires
+        ])
+        assert [g[0] for g in got] == ["WSO2", "IBM"]
+
+
+class TestLogicalAbsent:
+    """(e1 and not e2 for T) shapes
+    (reference LogicalAbsentPatternTestCase)."""
+
+    def test_and_not_waits_full_window_from_start(self):
+        # the leading absent side's clock runs from QUERY START
+        # (reference: AbsentStreamPreStateProcessor arms its scheduler
+        # when the start state activates); e1 within the window waits
+        # for the deadline before completing
+        q = ("@info(name='q') from e1=Stream1[price>20] and "
+             "not Stream2[price>50] for 1 sec "
+             "select e1.symbol as symbol1 insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 300),
+            ("Tick", [1], 2500),  # deadline (start + 1 sec) passes
+        ])
+        assert got == [["WSO2"]]
+
+    def test_and_not_canceled_by_presence(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] and "
+             "not Stream2[price>50] for 1 sec "
+             "select e1.symbol as symbol1 insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 300),
+            ("Stream2", ["IBM", 70.0, 100], 600),  # inside the window
+            ("Tick", [1], 3000),
+        ])
+        assert got == []
+
+    def test_chained_after_absent_completion(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "not Stream2[price>e1.price] for 1 sec -> "
+             "e3=Stream1[price>e1.price] "
+             "select e1.symbol as s1, e3.symbol as s3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2500),                       # absence holds
+            ("Stream1", ["IBM", 75.0, 100], 3000),     # completes chain
+        ])
+        assert got == [["WSO2", "IBM"]]
+
+    def test_chain_blocked_when_absence_violated(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "not Stream2[price>e1.price] for 1 sec -> "
+             "e3=Stream1[price>e1.price] "
+             "select e1.symbol as s1, e3.symbol as s3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["X", 60.0, 1], 1500),         # violates absence
+            ("Stream1", ["IBM", 75.0, 100], 3000),
+        ])
+        assert got == []

@@ -1,0 +1,323 @@
+"""Filter conformance matrix: per-type comparisons, arithmetic,
+boolean logic, null handling, builtin functions.
+
+Ported behavior families from the reference's filter corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/
+FilterTestCase1.java, FilterTestCase2.java, BooleanCompareTestCase.java,
+StringCompareTestCase.java, IsNullTestCase.java) — black-box SiddhiQL
+string in -> events in -> concrete event values out, the reference's own
+test style (SURVEY.md section 4).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run(app, sends, out="OutputStream", stream="S"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler(stream)
+        t = 1000
+        for row in sends:
+            h.send(row, timestamp=t)
+            t += 100
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+STOCK = "define stream S (symbol string, price float, volume long); "
+TYPED = ("define stream S (i int, l long, f float, d double, "
+         "s string, b bool); ")
+
+ROWS = [
+    ["IBM", 700.0, 100],
+    ["WSO2", 60.5, 200],
+    ["GOOG", 50.0, 30],
+    ["IBM", 76.6, 400],
+    ["WSO2", 45.6, 50],
+]
+
+
+class TestNumericCompares:
+    """Reference: FilterTestCase1 — every operator against every numeric
+    type, concrete surviving rows asserted."""
+
+    CASES = [
+        ("volume < 100", ["GOOG", "WSO2"]),
+        ("volume <= 100", ["IBM", "GOOG", "WSO2"]),
+        ("volume > 100", ["WSO2", "IBM"]),
+        ("volume >= 200", ["WSO2", "IBM"]),
+        ("volume == 200", ["WSO2"]),
+        ("volume != 200", ["IBM", "GOOG", "IBM", "WSO2"]),
+        ("price < 60.0", ["GOOG", "WSO2"]),
+        ("price <= 50.0", ["GOOG", "WSO2"]),
+        ("price > 70.0", ["IBM", "IBM"]),
+        # float32(76.6) == 76.5999985... < double 76.6 — java float->double
+        # promotion semantics: the 76.6f row does NOT pass
+        ("price >= 76.6", ["IBM"]),
+        # float attr vs int literal (cross-type promotion)
+        ("price > 50", ["IBM", "WSO2", "IBM"]),
+        # long attr vs float literal
+        ("volume > 99.5", ["IBM", "WSO2", "IBM"]),
+    ]
+
+    @pytest.mark.parametrize("cond,expect", CASES)
+    def test_compare(self, cond, expect):
+        got = run(STOCK + f"from S[{cond}] select symbol "
+                          "insert into OutputStream;", ROWS)
+        assert [g[0] for g in got] == expect
+
+    def test_compound_and_or_not(self):
+        got = run(STOCK + "from S[(price > 50.0 and volume < 300) or "
+                          "symbol == 'GOOG'] select symbol, price "
+                          "insert into OutputStream;", ROWS)
+        assert got == [["IBM", 700.0], ["WSO2", 60.5], ["GOOG", 50.0]]
+
+    def test_not_operator(self):
+        got = run(STOCK + "from S[not (volume >= 100)] select symbol "
+                          "insert into OutputStream;", ROWS)
+        assert [g[0] for g in got] == ["GOOG", "WSO2"]
+
+    def test_bool_attribute_filter(self):
+        got = run(TYPED + "from S[b] select i insert into OutputStream;",
+                  [[1, 1, 1.0, 1.0, "x", True],
+                   [2, 2, 2.0, 2.0, "y", False],
+                   [3, 3, 3.0, 3.0, "z", True]])
+        assert [g[0] for g in got] == [1, 3]
+
+    def test_bool_compare_literal(self):
+        # reference: BooleanCompareTestCase
+        got = run(TYPED + "from S[b == true] select i "
+                          "insert into OutputStream;",
+                  [[1, 1, 1.0, 1.0, "x", True],
+                   [2, 2, 2.0, 2.0, "y", False]])
+        assert [g[0] for g in got] == [1]
+        got = run(TYPED + "from S[b != true] select i "
+                          "insert into OutputStream;",
+                  [[1, 1, 1.0, 1.0, "x", True],
+                   [2, 2, 2.0, 2.0, "y", False]])
+        assert [g[0] for g in got] == [2]
+
+
+class TestStringCompares:
+    """Reference: StringCompareTestCase."""
+
+    def test_equىality(self):
+        got = run(STOCK + "from S[symbol == 'IBM'] select symbol, volume "
+                          "insert into OutputStream;", ROWS)
+        assert got == [["IBM", 100], ["IBM", 400]]
+
+    def test_inequality(self):
+        got = run(STOCK + "from S[symbol != 'IBM'] select symbol "
+                          "insert into OutputStream;", ROWS)
+        assert [g[0] for g in got] == ["WSO2", "GOOG", "WSO2"]
+
+    def test_string_vs_attribute(self):
+        app = ("define stream S (a string, b string); "
+               "from S[a == b] select a insert into OutputStream;")
+        got = run(app, [["x", "x"], ["x", "y"], ["z", "z"]])
+        assert [g[0] for g in got] == ["x", "z"]
+
+
+class TestArithmetic:
+    """Reference: executor/math per-type classes; java semantics for
+    int division/modulo (truncation toward zero)."""
+
+    def test_add_sub_mul(self):
+        app = TYPED + ("from S select i + 2 as a, l - 1 as b, f * 2.0 as c, "
+                       "d / 2.0 as e insert into OutputStream;")
+        got = run(app, [[10, 100, 1.5, 9.0, "x", True]])
+        assert got == [[12, 99, 3.0, 4.5]]
+
+    def test_int_division_truncates(self):
+        app = TYPED + "from S select i / 3 as q insert into OutputStream;"
+        got = run(app, [[7, 0, 0.0, 0.0, "", True],
+                        [-7, 0, 0.0, 0.0, "", True]])
+        assert [g[0] for g in got] == [2, -2]  # java truncation, not floor
+
+    def test_int_modulo_sign(self):
+        app = TYPED + "from S select i % 3 as r insert into OutputStream;"
+        got = run(app, [[7, 0, 0.0, 0.0, "", True],
+                        [-7, 0, 0.0, 0.0, "", True]])
+        assert [g[0] for g in got] == [1, -1]  # java: sign of dividend
+
+    def test_promotion_int_long_float_double(self):
+        app = TYPED + ("from S select i + l as il, i + f as if_, "
+                       "l + d as ld insert into OutputStream;")
+        got = run(app, [[1, 2, 0.5, 0.25, "", True]])
+        assert got == [[3, 1.5, 2.25]]
+
+    def test_arithmetic_in_filter(self):
+        got = run(STOCK + "from S[price * 2.0 > 150.0] select symbol "
+                          "insert into OutputStream;", ROWS)
+        assert [g[0] for g in got] == ["IBM", "IBM"]
+
+
+class TestIsNullAndNullFlow:
+    """Reference: IsNullTestCase — null attribute routing."""
+
+    def test_is_null_on_sent_none(self):
+        app = ("define stream S (symbol string, price double); "
+               "from S[price is null] select symbol insert into OutputStream;")
+        got = run(app, [["A", 1.0], ["B", None], ["C", 2.0]])
+        assert [g[0] for g in got] == ["B"]
+
+    def test_not_null(self):
+        app = ("define stream S (symbol string, price double); "
+               "from S[not (price is null)] select symbol "
+               "insert into OutputStream;")
+        got = run(app, [["A", 1.0], ["B", None]])
+        assert [g[0] for g in got] == ["A"]
+
+    def test_null_comparison_is_false(self):
+        # reference: null compares false on every operator
+        app = ("define stream S (symbol string, price double); "
+               "from S[price > 0.0] select symbol insert into OutputStream;")
+        got = run(app, [["A", 1.0], ["B", None], ["C", -1.0]])
+        assert [g[0] for g in got] == ["A"]
+
+
+class TestBuiltinFunctions:
+    """Reference: executor/function builtins."""
+
+    def test_if_then_else(self):
+        got = run(STOCK + "from S select symbol, "
+                          "ifThenElse(volume > 150, 'hi', 'lo') as lvl "
+                          "insert into OutputStream;", ROWS[:3])
+        assert got == [["IBM", "lo"], ["WSO2", "hi"], ["GOOG", "lo"]]
+
+    def test_coalesce(self):
+        app = ("define stream S (a string, b string); "
+               "from S select coalesce(a, b) as v insert into OutputStream;")
+        got = run(app, [[None, "fallback"], ["first", "unused"]])
+        assert [g[0] for g in got] == ["fallback", "first"]
+
+    def test_cast_and_convert(self):
+        app = ("define stream S (v double); "
+               "from S select convert(v, 'int') as i, "
+               "convert(v, 'string') as s insert into OutputStream;")
+        got = run(app, [[3.7]])
+        assert got[0][0] == 3 and got[0][1].startswith("3.7")
+
+    def test_math_min_max(self):
+        app = ("define stream S (a double, b double); "
+               "from S select maximum(a, b) as mx, minimum(a, b) as mn "
+               "insert into OutputStream;")
+        got = run(app, [[3.0, 7.0], [9.0, 2.0]])
+        assert got == [[7.0, 3.0], [9.0, 2.0]]
+
+    def test_event_timestamp(self):
+        app = ("define stream S (v double); "
+               "from S select eventTimestamp() as ts, v "
+               "insert into OutputStream;")
+        got = run(app, [[1.0], [2.0]])
+        assert got == [[1000, 1.0], [1100, 2.0]]
+
+    def test_instance_of(self):
+        app = ("define stream S (v double, s string); "
+               "from S select instanceOfDouble(v) as a, "
+               "instanceOfString(v) as b, instanceOfString(s) as c "
+               "insert into OutputStream;")
+        got = run(app, [[1.5, "x"]])
+        assert got == [[True, False, True]]
+
+
+class TestSelectorShapes:
+    """Reference: PassThroughTestCase / selector basics."""
+
+    def test_select_star_passthrough(self):
+        got = run(STOCK + "from S select * insert into OutputStream;",
+                  ROWS[:2])
+        assert got == [["IBM", 700.0, 100], ["WSO2", 60.5, 200]]
+
+    def test_rename_and_expression_projection(self):
+        got = run(STOCK + "from S select symbol as sym, "
+                          "price * volume as notional "
+                          "insert into OutputStream;", ROWS[:2])
+        assert got == [["IBM", 70000.0], ["WSO2", 12100.0]]
+
+    def test_constant_projection(self):
+        got = run(STOCK + "from S select symbol, 42 as k "
+                          "insert into OutputStream;", ROWS[:1])
+        assert got == [["IBM", 42]]
+
+
+class TestOrderByLimit:
+    """Reference: OrderByLimitTestCase — deterministic ordering with
+    limit/offset over batch windows."""
+
+    APP = STOCK + ("from S#window.lengthBatch(5) select symbol, volume "
+                   "order by volume {} insert into OutputStream;")
+
+    def test_order_asc_limit(self):
+        got = run(self.APP.format("limit 2"), ROWS)
+        assert got == [["GOOG", 30], ["WSO2", 50]]
+
+    def test_order_desc(self):
+        got = run(STOCK + "from S#window.lengthBatch(5) "
+                          "select symbol, volume order by volume desc "
+                          "limit 3 insert into OutputStream;", ROWS)
+        assert got == [["IBM", 400], ["WSO2", 200], ["IBM", 100]]
+
+    def test_offset(self):
+        got = run(STOCK + "from S#window.lengthBatch(5) "
+                          "select symbol, volume order by volume "
+                          "limit 2 offset 2 insert into OutputStream;", ROWS)
+        assert got == [["IBM", 100], ["WSO2", 200]]
+
+    def test_order_by_two_keys(self):
+        got = run(STOCK + "from S#window.lengthBatch(5) "
+                          "select symbol, volume order by symbol, volume desc "
+                          "insert into OutputStream;", ROWS)
+        assert got == [["GOOG", 30], ["IBM", 400], ["IBM", 100],
+                       ["WSO2", 200], ["WSO2", 50]]
+
+
+class TestGroupByHaving:
+    """Reference: GroupByTestCase — per-group aggregates with having."""
+
+    def test_group_by_running_sum(self):
+        got = run(STOCK + "from S select symbol, sum(volume) as total "
+                          "group by symbol insert into OutputStream;", ROWS)
+        assert got == [["IBM", 100], ["WSO2", 200], ["GOOG", 30],
+                       ["IBM", 500], ["WSO2", 250]]
+
+    def test_group_by_two_keys(self):
+        app = ("define stream S (a string, b string, v double); "
+               "from S select a, b, sum(v) as t group by a, b "
+               "insert into OutputStream;")
+        got = run(app, [["x", "1", 10.0], ["x", "2", 20.0],
+                        ["x", "1", 5.0]])
+        assert got == [["x", "1", 10.0], ["x", "2", 20.0], ["x", "1", 15.0]]
+
+    def test_having_filters_groups(self):
+        got = run(STOCK + "from S select symbol, sum(volume) as total "
+                          "group by symbol having total > 150 "
+                          "insert into OutputStream;", ROWS)
+        assert got == [["WSO2", 200], ["IBM", 500], ["WSO2", 250]]
+
+    def test_avg_min_max_count(self):
+        got = run(STOCK + "from S select avg(price) as a, min(price) as mn, "
+                          "max(price) as mx, count() as c "
+                          "insert into OutputStream;", ROWS[:3])
+        assert got[-1] == [pytest.approx((700.0 + 60.5 + 50.0) / 3), 50.0,
+                           700.0, 3]
+
+    def test_distinct_count(self):
+        got = run(STOCK + "from S select distinctCount(symbol) as dc "
+                          "insert into OutputStream;", ROWS)
+        assert [g[0] for g in got] == [1, 2, 3, 3, 3]
+
+    def test_stddev(self):
+        app = "define stream S (v double); " \
+              "from S select stdDev(v) as sd insert into OutputStream;"
+        got = run(app, [[2.0], [4.0], [4.0], [4.0], [5.0], [5.0], [7.0],
+                        [9.0]])
+        assert got[-1][0] == pytest.approx(2.0)

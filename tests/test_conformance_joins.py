@@ -1,0 +1,191 @@
+"""Join conformance matrix: join types x window buffering x tables.
+
+Ported behavior families from the reference's join suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/join/
+JoinTestCase.java, OuterJoinTestCase.java, table/JoinTableTestCase.java):
+window-buffered stream joins, outer-join null fills, unidirectional
+triggering, table probes.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STREAMS = (
+    "define stream Ticks (symbol string, price double); "
+    "define stream News (symbol string, headline string); "
+)
+
+
+def run(app, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        t = 1000
+        for stream, row in sends:
+            rt.get_input_handler(stream).send(row, timestamp=t)
+            t += 100
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestInnerJoin:
+    Q = (STREAMS +
+         "from Ticks#window.length(5) as t join News#window.length(5) as n "
+         "on t.symbol == n.symbol "
+         "select t.symbol as symbol, t.price as price, n.headline as h "
+         "insert into OutputStream;")
+
+    def test_match_after_both_sides_buffered(self):
+        got = run(self.Q, [
+            ("Ticks", ["IBM", 100.0]),
+            ("News", ["IBM", "up"]),
+        ])
+        assert got == [["IBM", 100.0, "up"]]
+
+    def test_no_match_different_symbols(self):
+        got = run(self.Q, [
+            ("Ticks", ["IBM", 100.0]),
+            ("News", ["WSO2", "up"]),
+        ])
+        assert got == []
+
+    def test_each_arrival_probes_opposite_window(self):
+        got = run(self.Q, [
+            ("Ticks", ["IBM", 100.0]),
+            ("News", ["IBM", "a"]),     # match 1
+            ("Ticks", ["IBM", 101.0]),  # matches buffered news -> match 2
+        ])
+        assert got == [["IBM", 100.0, "a"], ["IBM", 101.0, "a"]]
+
+    def test_window_eviction_limits_matches(self):
+        q = (STREAMS +
+             "from Ticks#window.length(1) as t join News#window.length(5) "
+             "as n on t.symbol == n.symbol "
+             "select t.symbol as symbol, t.price as price "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Ticks", ["IBM", 100.0]),
+            ("Ticks", ["WSO2", 50.0]),   # evicts IBM from length(1)
+            ("News", ["IBM", "x"]),      # IBM gone: no match
+            ("News", ["WSO2", "y"]),     # WSO2 present: match
+        ])
+        assert got == [["WSO2", 50.0]]
+
+    def test_join_condition_on_values(self):
+        q = ("define stream A (k string, v double); "
+             "define stream B (k string, v double); "
+             "from A#window.length(5) as a join B#window.length(5) as b "
+             "on a.v < b.v select a.v as av, b.v as bv "
+             "insert into OutputStream;")
+        got = run(q, [("A", ["x", 1.0]), ("A", ["y", 5.0]),
+                      ("B", ["z", 3.0])])
+        assert got == [[1.0, 3.0]]
+
+
+class TestOuterJoins:
+    def test_left_outer_null_fill(self):
+        q = (STREAMS +
+             "from Ticks#window.length(5) as t left outer join "
+             "News#window.length(5) as n on t.symbol == n.symbol "
+             "select t.symbol as symbol, n.headline as h "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Ticks", ["IBM", 100.0]),   # no news yet -> null fill
+            ("News", ["IBM", "up"]),     # now matches
+        ])
+        assert got == [["IBM", None], ["IBM", "up"]]
+
+    def test_right_outer_null_fill(self):
+        q = (STREAMS +
+             "from Ticks#window.length(5) as t right outer join "
+             "News#window.length(5) as n on t.symbol == n.symbol "
+             "select n.symbol as symbol, t.price as price "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("News", ["IBM", "up"]),     # no tick yet -> null fill
+            ("Ticks", ["IBM", 100.0]),
+        ])
+        assert got == [["IBM", None], ["IBM", 100.0]]
+
+    def test_full_outer_both_sides(self):
+        q = (STREAMS +
+             "from Ticks#window.length(5) as t full outer join "
+             "News#window.length(5) as n on t.symbol == n.symbol "
+             "select t.symbol as ts, n.symbol as ns "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Ticks", ["IBM", 100.0]),
+            ("News", ["WSO2", "up"]),
+        ])
+        assert got == [["IBM", None], [None, "WSO2"]]
+
+
+class TestUnidirectional:
+    def test_only_left_triggers(self):
+        q = (STREAMS +
+             "from Ticks#window.length(5) unidirectional join "
+             "News#window.length(5) "
+             "on Ticks.symbol == News.symbol "
+             "select Ticks.symbol as symbol, News.headline as h "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("News", ["IBM", "up"]),     # buffered, no trigger
+            ("Ticks", ["IBM", 100.0]),   # triggers against buffer
+            ("News", ["IBM", "again"]),  # must NOT trigger
+        ])
+        assert got == [["IBM", "up"]]
+
+
+class TestTableJoin:
+    APP = ("define stream S (symbol string, qty int); "
+           "define table Prices (symbol string, price double); "
+           "define stream P (symbol string, price double); "
+           "from P insert into Prices; "
+           "from S join Prices as pr on S.symbol == pr.symbol "
+           "select S.symbol as symbol, S.qty as qty, pr.price as price "
+           "insert into OutputStream;")
+
+    def test_stream_probes_table(self):
+        got = run(self.APP, [
+            ("P", ["IBM", 700.0]),
+            ("P", ["WSO2", 60.0]),
+            ("S", ["IBM", 3]),
+            ("S", ["GOOG", 1]),   # not in table: no row
+            ("S", ["WSO2", 2]),
+        ])
+        assert got == [["IBM", 3, 700.0], ["WSO2", 2, 60.0]]
+
+    def test_table_update_visible_to_next_probe(self):
+        app = self.APP + (" define stream U (symbol string, price double); "
+                          "from U update Prices set Prices.price = U.price "
+                          "on Prices.symbol == U.symbol; ")
+        got = run(app, [
+            ("P", ["IBM", 700.0]),
+            ("S", ["IBM", 1]),
+            ("U", ["IBM", 710.0]),
+            ("S", ["IBM", 2]),
+        ])
+        assert got == [["IBM", 1, 700.0], ["IBM", 2, 710.0]]
+
+
+class TestJoinWithAggregation:
+    def test_join_groupby_over_window(self):
+        q = (STREAMS +
+             "from Ticks#window.lengthBatch(4) as t join "
+             "News#window.length(10) as n on t.symbol == n.symbol "
+             "select t.symbol as symbol, sum(t.price) as total "
+             "group by t.symbol insert into OutputStream;")
+        got = run(q, [
+            ("News", ["IBM", "x"]),
+            ("Ticks", ["IBM", 10.0]),
+            ("Ticks", ["IBM", 20.0]),
+            ("Ticks", ["WSO2", 5.0]),
+            ("Ticks", ["IBM", 30.0]),  # batch flushes here
+        ])
+        assert got[-1] == ["IBM", 60.0]

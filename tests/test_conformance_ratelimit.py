@@ -1,0 +1,144 @@
+"""Output rate-limiting conformance matrix.
+
+Ported behavior families from the reference's ratelimit suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/ratelimit/ —
+output first/last/all every N events / every T time / snapshot every T),
+driven on event-time playback so time-based limits fire
+deterministically.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFINE = "define stream S (symbol string, price double, volume long); "
+TICK = "define stream Tick (x int); from Tick select x insert into _T; "
+
+
+def run(query, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + DEFINE + TICK + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def s_rows(rows, t0=1000, dt=100):
+    return [("S", r, t0 + i * dt) for i, r in enumerate(rows)]
+
+
+ROWS = [["A", 1.0, 10], ["B", 2.0, 20], ["C", 3.0, 30],
+        ["D", 4.0, 40], ["E", 5.0, 50], ["F", 6.0, 60]]
+
+
+class TestEventRateLimits:
+    """output first/last/all every N events."""
+
+    def test_first_every_3_events(self):
+        got = run("from S select symbol output first every 3 events "
+                  "insert into OutputStream;", s_rows(ROWS))
+        assert [g[0] for g in got] == ["A", "D"]
+
+    def test_last_every_3_events(self):
+        got = run("from S select symbol output last every 3 events "
+                  "insert into OutputStream;", s_rows(ROWS))
+        assert [g[0] for g in got] == ["C", "F"]
+
+    def test_all_every_3_events_batches(self):
+        got = run("from S select symbol output every 3 events "
+                  "insert into OutputStream;", s_rows(ROWS))
+        assert [g[0] for g in got] == ["A", "B", "C", "D", "E", "F"]
+
+    def test_partial_batch_not_emitted(self):
+        got = run("from S select symbol output last every 4 events "
+                  "insert into OutputStream;", s_rows(ROWS))
+        # only one full window of 4 completes; E/F stay buffered
+        assert [g[0] for g in got] == ["D"]
+
+
+class TestTimeRateLimits:
+    """output first/last/all every T — fired by the event-time
+    scheduler."""
+
+    def test_first_every_second(self):
+        sends = s_rows(ROWS, t0=1000, dt=300)  # spans 1000..2500
+        sends.append(("Tick", [1], 4000))      # closes the last period
+        got = run("from S select symbol output first every 1 sec "
+                  "insert into OutputStream;", sends)
+        # events at 1000..2500 step 300; periods [1000,2000): first A;
+        # [2000,3000): first E (2200)
+        assert [g[0] for g in got] == ["A", "E"]
+
+    def test_last_every_second(self):
+        sends = s_rows(ROWS, t0=1000, dt=300)
+        sends.append(("Tick", [1], 4000))
+        got = run("from S select symbol output last every 1 sec "
+                  "insert into OutputStream;", sends)
+        # last of [1000,2000) is D (1900); last of [2000,3000) is F (2500)
+        assert [g[0] for g in got] == ["D", "F"]
+
+    def test_all_every_second_flushes_period(self):
+        sends = s_rows(ROWS, t0=1000, dt=300)
+        sends.append(("Tick", [1], 4000))
+        got = run("from S select symbol output every 1 sec "
+                  "insert into OutputStream;", sends)
+        assert [g[0] for g in got] == ["A", "B", "C", "D", "E", "F"]
+
+    def test_empty_period_emits_nothing(self):
+        sends = [("S", ROWS[0], 1000), ("Tick", [1], 5000)]
+        got = run("from S select symbol output last every 1 sec "
+                  "insert into OutputStream;", sends)
+        assert [g[0] for g in got] == ["A"]
+
+
+class TestSnapshotRate:
+    """output snapshot every T — periodic full-state emission of the
+    aggregation (reference: snapshot/ WrappedSnapshotOutputRateLimiter)."""
+
+    def test_snapshot_running_sum(self):
+        q = ("from S select symbol, sum(volume) as total group by symbol "
+             "output snapshot every 1 sec insert into OutputStream;")
+        sends = [("S", ["A", 1.0, 10], 1000),
+                 ("S", ["B", 1.0, 5], 1200),
+                 ("S", ["A", 1.0, 7], 1300),
+                 ("Tick", [1], 2100)]
+        got = run(q, sends)
+        # snapshot at 2000: current per-group totals
+        assert sorted(map(tuple, got)) == [("A", 17), ("B", 5)]
+
+    def test_snapshot_updates_between_periods(self):
+        q = ("from S select symbol, sum(volume) as total group by symbol "
+             "output snapshot every 1 sec insert into OutputStream;")
+        sends = [("S", ["A", 1.0, 10], 1000),
+                 ("Tick", [1], 2100),          # snapshot 1: A=10
+                 ("S", ["A", 1.0, 5], 2500),
+                 ("Tick", [1], 3100)]          # snapshot 2: A=15
+        got = run(q, sends)
+        assert [tuple(g) for g in got] == [("A", 10), ("A", 15)]
+
+
+class TestRateLimitWithGroupBy:
+    def test_last_per_group_every_events(self):
+        q = ("from S select symbol, sum(volume) as t group by symbol "
+             "output last every 4 events insert into OutputStream;")
+        sends = s_rows([["A", 1.0, 10], ["B", 1.0, 20],
+                        ["A", 1.0, 30], ["B", 1.0, 40]])
+        got = run(q, sends)
+        # per-group LAST within the 4-event window
+        assert sorted(map(tuple, got)) == [("A", 40), ("B", 60)]
+
+    def test_first_per_group_every_events(self):
+        q = ("from S select symbol, sum(volume) as t group by symbol "
+             "output first every 4 events insert into OutputStream;")
+        sends = s_rows([["A", 1.0, 10], ["B", 1.0, 20],
+                        ["A", 1.0, 30], ["B", 1.0, 40]])
+        got = run(q, sends)
+        assert sorted(map(tuple, got)) == [("A", 10), ("B", 20)]

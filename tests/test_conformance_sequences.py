@@ -1,0 +1,140 @@
+"""Sequence conformance: strict continuity, counts, every, within.
+
+Ported behavior families from the reference's sequence suites
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/sequence/
+SequenceTestCase.java, absent/...).  A sequence (`,`) requires
+CONSECUTIVE matching events — any non-matching event kills pending
+chains; the start state stays armed.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STREAMS = (
+    "define stream S (symbol string, price float, volume int); "
+    "define stream S2 (symbol string, price float, volume int); "
+)
+
+
+def run(query, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + STREAMS + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        t = 1000
+        for item in sends:
+            if len(item) == 2:
+                stream, row = item
+                ts = t
+                t += 100
+            else:
+                stream, row, ts = item
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestStrictContinuity:
+    Q = ("@info(name='q') from e1=S[price > 100], e2=S[price > e1.price] "
+         "select e1.price as p1, e2.price as p2 insert into OutputStream;")
+
+    def test_consecutive_matches(self):
+        got = run(self.Q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1])])
+        assert got == [[110.0, 120.0]]
+
+    def test_interloper_kills_chain(self):
+        # the middle event matches neither e1-continuation nor e2
+        got = run(self.Q, [("S", ["A", 110.0, 1]),
+                           ("S", ["X", 50.0, 1]),     # kills the pending e2
+                           ("S", ["B", 120.0, 1])])
+        # B then arms a NEW e1 (120) — no match emitted for (110, 120)
+        assert got == []
+
+    def test_start_stays_armed_after_kill(self):
+        got = run(self.Q, [("S", ["A", 110.0, 1]),
+                           ("S", ["X", 50.0, 1]),
+                           ("S", ["B", 120.0, 1]),
+                           ("S", ["C", 130.0, 1])])
+        assert got == [[120.0, 130.0]]
+
+    def test_non_every_matches_once(self):
+        got = run(self.Q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1]),
+                           ("S", ["C", 130.0, 1]), ("S", ["D", 140.0, 1])])
+        assert got == [[110.0, 120.0]]
+
+
+class TestEverySequence:
+    Q = ("@info(name='q') from every e1=S[price > 100], "
+         "e2=S[price > e1.price] "
+         "select e1.price as p1, e2.price as p2 insert into OutputStream;")
+
+    def test_every_overlapping_consecutive_pairs(self):
+        got = run(self.Q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1]),
+                           ("S", ["C", 130.0, 1]), ("S", ["D", 140.0, 1])])
+        # `every` rearms the start on EVERY event, so each ascending
+        # consecutive pair matches (overlapping) — the reference's
+        # every-sequence contract
+        assert got == [[110.0, 120.0], [120.0, 130.0], [130.0, 140.0]]
+
+    def test_every_with_kill_between(self):
+        got = run(self.Q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1]),
+                           ("S", ["X", 10.0, 1]),
+                           ("S", ["C", 130.0, 1]), ("S", ["D", 140.0, 1])])
+        # the X interloper kills the (120, ...) arm; pairs on both sides
+        # of it survive
+        assert got == [[110.0, 120.0], [130.0, 140.0]]
+
+
+class TestSequenceCounts:
+    def test_plus_collects_consecutive(self):
+        q = ("@info(name='q') from e1=S[price > 100]+, e2=S[price < 50] "
+             "select e1[0].price as first, e1[last].price as last_, "
+             "e2.price as stop insert into OutputStream;")
+        got = run(q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1]),
+                      ("S", ["C", 130.0, 1]), ("S", ["D", 10.0, 1])])
+        assert got == [[110.0, 130.0, 10.0]]
+
+    def test_star_zero_occurrences(self):
+        q = ("@info(name='q') from e1=S[price > 200]*, e2=S[price < 50] "
+             "select e2.price as stop insert into OutputStream;")
+        got = run(q, [("S", ["D", 10.0, 1])])
+        assert got == [[10.0]]
+
+    def test_bounded_count_exact(self):
+        q = ("@info(name='q') from e1=S[price > 100]<2>, e2=S[price < 50] "
+             "select e1[0].price as a, e1[last].price as b, e2.price as c "
+             "insert into OutputStream;")
+        got = run(q, [("S", ["A", 110.0, 1]), ("S", ["B", 120.0, 1]),
+                      ("S", ["D", 10.0, 1])])
+        assert got == [[110.0, 120.0, 10.0]]
+
+
+class TestSequenceTwoStreams:
+    def test_cross_stream_strictness(self):
+        q = ("@info(name='q') from e1=S[price > 100], e2=S2[price > 100] "
+             "select e1.symbol as a, e2.symbol as b "
+             "insert into OutputStream;")
+        got = run(q, [("S", ["A", 110.0, 1]), ("S2", ["B", 120.0, 1])])
+        assert got == [["A", "B"]]
+        # an S event between them kills the chain (strict continuity is
+        # across ALL source streams of the sequence)
+        got = run(q, [("S", ["A", 110.0, 1]), ("S", ["X", 10.0, 1]),
+                      ("S2", ["B", 120.0, 1])])
+        assert got == []
+
+    def test_within_prunes_sequence(self):
+        q = ("@info(name='q') from every e1=S[price > 100], "
+             "e2=S[price > e1.price] within 1 sec "
+             "select e1.price as p1, e2.price as p2 "
+             "insert into OutputStream;")
+        got = run(q, [("S", ["A", 110.0, 1], 1000),
+                      ("S", ["B", 120.0, 1], 2500)])  # too late
+        assert got == []
+        got = run(q, [("S", ["A", 110.0, 1], 1000),
+                      ("S", ["B", 120.0, 1], 1500)])
+        assert got == [[110.0, 120.0]]
