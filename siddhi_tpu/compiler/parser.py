@@ -310,7 +310,9 @@ class Parser:
         name = self.expect_name()
         attrs = self._parse_attr_list()
         fn = self._parse_function_operation()
-        out_type = "current"
+        # reference default: ALL events (WindowDefinition.java:40) so
+        # queries reading the window see expiries and can retract
+        out_type = "all"
         if self.accept_kw("output"):
             out_type = self._parse_output_event_type()
         return WindowDefinition(

@@ -16,7 +16,10 @@ import numpy as np
 
 from siddhi_tpu.core import event as ev
 from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
-from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
 from siddhi_tpu.core.stream import QueryCallback, StreamJunction
 from siddhi_tpu.ops.aggregators import AggExecutor
 from siddhi_tpu.planner.expr import CompiledExpression, N_KEY, TS_KEY
@@ -302,6 +305,10 @@ class QuerySelector:
 
 
 class OutputRateLimiter:
+    # time-driven limiters need a scheduler task (next_wakeup/on_time);
+    # event-count limiters set this False so the planner registers none
+    needs_scheduler_task = True
+
     def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
         return batch
 
@@ -319,12 +326,14 @@ class OutputRateLimiter:
 
 
 class PassThroughRateLimiter(OutputRateLimiter):
-    pass
+    needs_scheduler_task = False
 
 
 class EventRateLimiter(OutputRateLimiter):
     """`output <all|first|last> every N events` (reference:
     ratelimit/event/*PerEventOutputRateLimiter)."""
+
+    needs_scheduler_task = False
 
     def __init__(self, n: int, mode: str):
         self.n = n
@@ -368,6 +377,8 @@ class GroupByEventRateLimiter(OutputRateLimiter):
     ratelimit/event/FirstGroupByPerEventOutputRateLimiter.java,
     LastGroupByPerEventOutputRateLimiter.java)."""
 
+    needs_scheduler_task = False
+
     def __init__(self, n: int, mode: str):
         self.n = n
         self.mode = mode  # first | last
@@ -384,6 +395,14 @@ class GroupByEventRateLimiter(OutputRateLimiter):
         if nrows == 0:
             return None
         keys = batch.aux.get("group_keys")
+        if keys is None or len(keys) != len(batch):
+            # the planner only builds this limiter for grouped queries,
+            # whose selector always attaches the side channel — a missing
+            # aux is a wiring bug; degrading to one global group would be
+            # silently wrong output
+            raise SiddhiAppRuntimeError(
+                "per-group rate limiter received a batch without the "
+                "group-key side channel")
         outs: List[EventBatch] = []
         first_rows: List[int] = []
 

@@ -23,7 +23,10 @@ class NamedWindowRuntime:
         self.window = window
         self.junction = junction
         self.app_context = app_context
-        self.output_event_type = definition.output_event_type or "current"
+        # reference default: ALL events (WindowDefinition.java:40 —
+        # queries reading the window see CURRENT + EXPIRED so windowed
+        # aggregates can retract on expiry)
+        self.output_event_type = definition.output_event_type or "all"
 
     # -- ingestion (insert into W) ------------------------------------------
 

@@ -302,7 +302,7 @@ class QueryPlanner:
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
+        if rate_limiter.needs_scheduler_task:
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
 
         jr = JoinRuntime(
@@ -361,7 +361,7 @@ class QueryPlanner:
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
+        if rate_limiter.needs_scheduler_task:
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
 
         # presence keys used anywhere in the selector expressions
@@ -478,7 +478,7 @@ class QueryPlanner:
         # fallback to the host path never leaks a live scheduler task;
         # the task handle is kept so multi-query callers (partition
         # lowering) can unregister if a LATER query fails eligibility
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
+        if rate_limiter.needs_scheduler_task:
             task = _RateLimiterTask(qr, rate_limiter)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
@@ -526,7 +526,7 @@ class QueryPlanner:
         for w in windows:
             if w.needs_scheduler:
                 self.app.scheduler.register_window(qr, w)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
+        if rate_limiter.needs_scheduler_task:
             self.app.scheduler.register_task(_RateLimiterTask(qr, rate_limiter))
         junction = self.app.junction_for_input(s)
         junction.subscribe(ProcessStreamReceiver(qr))
@@ -596,7 +596,7 @@ class QueryPlanner:
         # registered LAST: nothing below may raise, so a fallback to the
         # host path never leaks a live scheduler task
         self.app.scheduler.register_task(runtime)
-        if not isinstance(rate_limiter, (PassThroughRateLimiter, EventRateLimiter, GroupByEventRateLimiter)):
+        if rate_limiter.needs_scheduler_task:
             task = _RateLimiterTask(qr, rate_limiter)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
@@ -614,8 +614,6 @@ class QueryPlanner:
             return PassThroughRateLimiter()
         if isinstance(r, EventOutputRate):
             if r.type in ("first", "last") and query.selector.group_by:
-                from siddhi_tpu.core.query import GroupByEventRateLimiter
-
                 return GroupByEventRateLimiter(r.events, r.type)
             return EventRateLimiter(r.events, r.type)
         if isinstance(r, TimeOutputRate):

@@ -51,7 +51,8 @@ class WindowDefinition(AbstractDefinition):
     """``define window W (a int) length(5) output all events``."""
 
     window_function: Optional[FunctionCall] = None
-    output_event_type: str = "current"  # current | expired | all
+    # reference default: ALL events (WindowDefinition.java:40)
+    output_event_type: str = "all"  # current | expired | all
 
 
 @dataclass

@@ -81,7 +81,8 @@ class TestDefinitions:
         app = parse("define window W2 (a int) time(2 sec);")
         d = app.window_definitions["W2"]
         assert d.window_function.args[0] == TimeConstant(2000)
-        assert d.output_event_type == "current"
+        # reference default: ALL events (WindowDefinition.java:40)
+        assert d.output_event_type == "all"
 
     def test_trigger_definitions(self):
         app = parse(

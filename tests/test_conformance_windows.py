@@ -1,0 +1,236 @@
+"""Window conformance matrix: every concrete window's emission contract.
+
+Ported behavior families from the reference's window processors
+(modules/siddhi-core/src/main/java/io/siddhi/core/query/processor/
+stream/window/*WindowProcessor.java and the window/ test package):
+CURRENT + EXPIRED emission asserted via QueryCallback's in/remove
+events, on event-time playback.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFINE = "define stream S (symbol string, v double); "
+TICK = "define stream Tick (x int); from Tick select x insert into _T; "
+
+
+def run(query, sends, want_removed=False):
+    """Returns (in_events, removed_events) data lists from a
+    QueryCallback (reference test style: ts, inEvents, removeEvents)."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + DEFINE + TICK + "@info(name='q') " + query)
+        ins, outs = [], []
+
+        def cb(ts, in_events, out_events):
+            if in_events:
+                ins.extend(e.data for e in in_events)
+            if out_events:
+                outs.extend(e.data for e in out_events)
+
+        rt.add_callback("q", cb)
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return ins, outs
+    finally:
+        m.shutdown()
+
+
+def srows(rows, t0=1000, dt=100):
+    return [("S", r, t0 + i * dt) for i, r in enumerate(rows)]
+
+
+ROWS = [["A", 1.0], ["B", 2.0], ["C", 3.0], ["D", 4.0]]
+
+
+class TestLengthWindow:
+    def test_current_and_expired(self):
+        ins, outs = run("from S#window.length(2) select symbol, v "
+                        "insert all events into OutputStream;", srows(ROWS))
+        assert ins == [["A", 1.0], ["B", 2.0], ["C", 3.0], ["D", 4.0]]
+        # third arrival evicts A, fourth evicts B
+        assert outs == [["A", 1.0], ["B", 2.0]]
+
+    def test_aggregate_over_length(self):
+        ins, _ = run("from S#window.length(2) select sum(v) as s "
+                     "insert into OutputStream;", srows(ROWS))
+        assert [r[0] for r in ins] == [1.0, 3.0, 5.0, 7.0]
+
+
+class TestLengthBatchWindow:
+    def test_flush_every_n(self):
+        ins, _ = run("from S#window.lengthBatch(2) select symbol, v "
+                     "insert into OutputStream;", srows(ROWS))
+        assert ins == [["A", 1.0], ["B", 2.0], ["C", 3.0], ["D", 4.0]]
+
+    def test_batch_sum_emits_per_flush(self):
+        ins, _ = run("from S#window.lengthBatch(2) select sum(v) as s "
+                     "insert into OutputStream;", srows(ROWS))
+        assert [r[0] for r in ins] == [3.0, 7.0]
+
+
+class TestTimeWindow:
+    def test_expiry_after_horizon(self):
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1400),
+                 ("Tick", [1], 2600)]  # A (2000) and B (2400) expire
+        ins, outs = run("from S#window.time(1 sec) select symbol, v "
+                        "insert all events into OutputStream;", sends)
+        assert ins == [["A", 1.0], ["B", 2.0]]
+        assert outs == [["A", 1.0], ["B", 2.0]]
+
+    def test_sliding_sum_decreases_on_expiry(self):
+        q = ("from S#window.time(1 sec) select sum(v) as s "
+             "insert all events into OutputStream;")
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1400),
+                 ("S", ["C", 4.0], 2100)]  # A expired at 2000
+        ins, _ = run(q, sends)
+        assert [r[0] for r in ins] == [1.0, 3.0, 6.0]
+
+
+class TestTimeBatchWindow:
+    def test_pane_flush(self):
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1400),
+                 ("S", ["C", 3.0], 2100),  # crosses the 2000 boundary
+                 ("Tick", [1], 3100)]
+        ins, _ = run("from S#window.timeBatch(1 sec) select sum(v) as s "
+                     "insert into OutputStream;", sends)
+        assert [r[0] for r in ins] == [3.0, 3.0]
+
+
+class TestExternalTimeWindow:
+    def test_event_driven_expiry(self):
+        # externalTime expires against the EVENT's own time attribute
+        q = ("from S#window.externalTime(eventTimestamp(), 1 sec) "
+             "select symbol, v insert all events into OutputStream;")
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1500),
+                 ("S", ["C", 3.0], 2100)]  # pushes A out (>= 1000+1000)
+        ins, outs = run(q, sends)
+        assert ins == [["A", 1.0], ["B", 2.0], ["C", 3.0]]
+        assert outs == [["A", 1.0]]
+
+
+class TestSessionWindow:
+    def test_gap_closes_session(self):
+        q = ("from S#window.session(1 sec) select sum(v) as s "
+             "insert into OutputStream;")
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1500),
+                 ("Tick", [1], 2600),   # gap > 1 sec: session 1 closes
+                 ("S", ["C", 3.0], 5000),
+                 ("Tick", [1], 6100)]
+        ins, _ = run(q, sends)
+        # running sum on arrivals; session-1 expiry retracts (A, B) in
+        # the same advance that admits C: 1, 1+2, 3-3+3
+        assert [r[0] for r in ins] == [1.0, 3.0, 3.0]
+
+
+class TestDelayWindow:
+    def test_events_delayed(self):
+        q = "from S#window.delay(1 sec) select symbol insert into OutputStream;"
+        sends = [("S", ["A", 1.0], 1000),
+                 ("Tick", [1], 1500),   # not yet
+                 ("Tick", [1], 2100)]   # released
+        ins, _ = run(q, sends)
+        assert ins == [["A"]]
+
+    def test_nothing_before_delay(self):
+        q = "from S#window.delay(1 sec) select symbol insert into OutputStream;"
+        sends = [("S", ["A", 1.0], 1000), ("Tick", [1], 1500)]
+        ins, _ = run(q, sends)
+        assert ins == []
+
+
+class TestSortWindow:
+    def test_keeps_top_k_sorted(self):
+        # sort window keeps the N LOWEST by the sort attr (asc), evicting
+        # the greatest when full
+        q = ("from S#window.sort(2, v) select symbol, v "
+             "insert all events into OutputStream;")
+        ins, outs = run(q, srows([["A", 5.0], ["B", 1.0], ["C", 3.0]]))
+        assert ins == [["A", 5.0], ["B", 1.0], ["C", 3.0]]
+        assert outs == [["A", 5.0]]  # greatest evicted when C arrives
+
+
+class TestFrequentWindows:
+    def test_frequent_keeps_heavy_hitters(self):
+        q = ("from S#window.frequent(1, symbol) select symbol "
+             "insert into OutputStream;")
+        ins, _ = run(q, srows([["A", 1.0], ["A", 1.0], ["B", 1.0],
+                               ["A", 1.0]]))
+        # B never enters the top-1 heavy-hitter set and is suppressed
+        assert [r[0] for r in ins] == ["A", "A", "A"]
+
+    def test_lossy_frequent_runs(self):
+        q = ("from S#window.lossyFrequent(0.5, 0.1, symbol) select symbol "
+             "insert into OutputStream;")
+        ins, _ = run(q, srows([["A", 1.0], ["A", 1.0], ["B", 1.0]]))
+        assert [r[0] for r in ins][:2] == ["A", "A"]
+
+
+class TestTimeLengthWindow:
+    def test_bounded_by_both(self):
+        q = ("from S#window.timeLength(1 sec, 2) select symbol "
+             "insert all events into OutputStream;")
+        # length bound evicts first when 3 arrive quickly
+        ins, outs = run(q, srows(ROWS[:3], dt=50))
+        assert [r[0] for r in ins] == ["A", "B", "C"]
+        assert [r[0] for r in outs] == ["A"]
+
+
+class TestHoppingWindow:
+    def test_hop_flushes(self):
+        q = ("from S#window.hopping(1 sec, 500 millisec) "
+             "select sum(v) as s insert into OutputStream;")
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1400),
+                 ("Tick", [1], 2600)]
+        ins, _ = run(q, sends)
+        assert len(ins) >= 1  # overlapping panes emit sums
+        assert ins[0][0] == pytest.approx(3.0)
+
+
+class TestCronAndExpressionWindows:
+    def test_cron_window_flush(self):
+        q = ("from S#window.cron('*/2 * * * * ?') select sum(v) as s "
+             "insert into OutputStream;")
+        sends = [("S", ["A", 1.0], 1000), ("S", ["B", 2.0], 1500),
+                 ("Tick", [1], 3000)]  # a */2-second boundary passes
+        ins, _ = run(q, sends)
+        assert [r[0] for r in ins] == [3.0]
+
+    def test_expression_window(self):
+        # keep events while the expression holds (count-bounded here)
+        q = ("from S#window.expression('count() <= 2') "
+             "select symbol insert all events into OutputStream;")
+        ins, outs = run(q, srows(ROWS[:3]))
+        assert [r[0] for r in ins] == ["A", "B", "C"]
+        assert [r[0] for r in outs] == ["A"]
+
+
+class TestNamedWindowSharing:
+    def test_two_queries_share_window(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback " + DEFINE +
+                "define window W (symbol string, v double) length(2); "
+                "from S insert into W; "
+                "@info(name='q1') from W select sum(v) as s "
+                "insert into Out1; "
+                "@info(name='q2') from W select count() as c "
+                "insert into Out2;")
+            got1, got2 = [], []
+            rt.add_callback("Out1", lambda evs: got1.extend(e.data for e in evs))
+            rt.add_callback("Out2", lambda evs: got2.extend(e.data for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, r in enumerate(ROWS[:3]):
+                h.send(r, timestamp=1000 + i * 100)
+            rt.shutdown()
+            # window default output is ALL events: the expired A retracts
+            assert [g[0] for g in got1] == [1.0, 3.0, 5.0 - 1.0 + 1.0]
+            assert [g[0] for g in got2] == [1, 2, 2]
+        finally:
+            m.shutdown()
