@@ -417,7 +417,7 @@ class GroupByEventRateLimiter(OutputRateLimiter):
             self._last.clear()
 
         for i in range(nrows):
-            k = keys[i] if keys is not None and i < len(keys) else None
+            k = keys[i]
             if self.mode == "first":
                 if k not in self._seen:
                     self._seen.add(k)

@@ -1,0 +1,130 @@
+"""Partition conformance matrix: value/range keys, inner streams, purge.
+
+Ported behavior families from the reference's partition suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/partition/
+PartitionTestCase1/2.java): per-key isolated query state, range labels,
+inner (#) streams scoped per key, idle-key purge.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFINE = "define stream S (user string, region string, v double); "
+
+
+def run(app, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + DEFINE + app)
+        got = []
+        if out in rt.junctions:
+            rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        t = 1000
+        for row in sends:
+            if isinstance(row, tuple):
+                row, t = row
+            rt.get_input_handler("S").send(row, timestamp=t)
+            t += 100
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestValuePartition:
+    def test_per_key_running_sum_isolated(self):
+        app = ("partition with (user of S) begin "
+               "from S select user, sum(v) as total insert into OutputStream; "
+               "end;")
+        got = run(app, [["a", "r1", 10.0], ["b", "r1", 5.0],
+                        ["a", "r1", 1.0], ["b", "r1", 2.0]])
+        assert got == [["a", 10.0], ["b", 5.0], ["a", 11.0], ["b", 7.0]]
+
+    def test_per_key_length_window(self):
+        app = ("partition with (user of S) begin "
+               "from S#window.length(2) select user, sum(v) as total "
+               "insert into OutputStream; end;")
+        got = run(app, [["a", "r", 1.0], ["a", "r", 2.0], ["a", "r", 3.0],
+                        ["b", "r", 10.0]])
+        # a's window slides independently of b's
+        assert got == [["a", 1.0], ["a", 3.0], ["a", 5.0], ["b", 10.0]]
+
+    def test_per_key_pattern_state(self):
+        app = ("partition with (user of S) begin "
+               "from every e1=S[v > 100.0] -> e2=S[v > e1.v] "
+               "select e1.user as user, e1.v as a, e2.v as b "
+               "insert into OutputStream; end;")
+        got = run(app, [["x", "r", 150.0], ["y", "r", 500.0],
+                        ["x", "r", 200.0],   # completes x only
+                        ["y", "r", 600.0]])  # completes y only
+        assert got == [["x", 150.0, 200.0], ["y", 500.0, 600.0]]
+
+    def test_multi_attribute_keys_independent(self):
+        app = ("partition with (region of S) begin "
+               "from S select region, count() as c insert into OutputStream; "
+               "end;")
+        got = run(app, [["u1", "east", 1.0], ["u2", "west", 1.0],
+                        ["u3", "east", 1.0]])
+        assert got == [["east", 1], ["west", 1], ["east", 2]]
+
+
+class TestRangePartition:
+    APP = ("partition with (v < 100.0 as 'small' or v >= 100.0 as 'large' "
+           "of S) begin from S select user, count() as c "
+           "insert into OutputStream; end;")
+
+    def test_ranges_isolate_counts(self):
+        got = run(self.APP, [["a", "r", 50.0], ["b", "r", 500.0],
+                             ["c", "r", 60.0]])
+        # 'small' partition counts a,c; 'large' counts b
+        assert got == [["a", 1], ["b", 1], ["c", 2]]
+
+    def test_unmatched_rows_dropped(self):
+        app = ("partition with (v < 100.0 as 'small' of S) begin "
+               "from S select user, count() as c insert into OutputStream; "
+               "end;")
+        got = run(app, [["a", "r", 50.0], ["b", "r", 500.0],
+                        ["c", "r", 60.0]])
+        assert got == [["a", 1], ["c", 2]]  # b matches no range
+
+
+class TestInnerStreams:
+    def test_inner_stream_scoped_per_key(self):
+        # '#P' inner streams connect queries within ONE key's instance
+        app = ("partition with (user of S) begin "
+               "from S select user, v * 2.0 as d insert into #Mid; "
+               "from #Mid select user, sum(d) as total "
+               "insert into OutputStream; end;")
+        got = run(app, [["a", "r", 1.0], ["b", "r", 10.0],
+                        ["a", "r", 2.0]])
+        assert got == [["a", 2.0], ["b", 20.0], ["a", 6.0]]
+
+
+class TestPartitionPurge:
+    def test_idle_instances_purged_and_state_reset(self):
+        app = ("@purge(enable='true', interval='1 sec', "
+               "idle.period='2 sec') "
+               "partition with (user of S) begin "
+               "from S select user, count() as c insert into OutputStream; "
+               "end;")
+        got = run(app, [
+            (["a", "r", 1.0], 1000),
+            (["a", "r", 1.0], 1500),   # c=2
+            (["b", "r", 1.0], 9000),   # watermark jump: a idle > 2 sec
+            (["a", "r", 1.0], 9500),   # a's instance was purged: c restarts
+        ])
+        assert got == [["a", 1], ["a", 2], ["b", 1], ["a", 1]]
+
+
+class TestPartitionWithExpressionKey:
+    def test_expression_partition_key(self):
+        # any expression may key the partition (reference
+        # ValuePartitionExecutor evaluates a compiled expression)
+        app = ("partition with (v % 2.0 of S) begin "
+               "from S select user, count() as c insert into OutputStream; "
+               "end;")
+        got = run(app, [["a", "x", 1.0], ["b", "y", 2.0], ["c", "x", 3.0]])
+        # keys 1.0, 0.0, 1.0 — first and third share an instance
+        assert got == [["a", 1], ["b", 1], ["c", 2]]

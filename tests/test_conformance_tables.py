@@ -166,6 +166,8 @@ class TestCacheTable:
                "from StockStream insert into T; "
                "from Check join T on Check.symbol == T.symbol "
                "select T.symbol as symbol insert into OutputStream;")
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
         try:
             got, _ = run(app, [
                 ("StockStream", ["A", 1.0, 1]),
@@ -173,7 +175,9 @@ class TestCacheTable:
                 ("StockStream", ["C", 3.0, 3]),
                 ("Check", ["C"]),
             ])
-        except Exception:
+        except SiddhiAppCreationError:
+            # creation-time only: the record-store test double is not
+            # registered in this environment; runtime failures still fail
             pytest.skip("record-store test double not registered")
         assert [g[0] for g in got] == ["C"]
 
