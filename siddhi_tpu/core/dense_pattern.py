@@ -44,31 +44,48 @@ log = logging.getLogger("siddhi_tpu")
 
 
 def build_dense_engine(query, st: StateInputStream, resolve_def,
-                       n_partitions: int, n_instances: int = 4):
+                       n_partitions: int, n_instances: int = 4,
+                       select_override=None, builder=None):
     """Lower one pattern/sequence query to a DensePatternEngine or raise
-    SiddhiAppCreationError with the reason it is not dense-eligible."""
+    SiddhiAppCreationError with the reason it is not dense-eligible.
+
+    ``select_override=(vars, names)`` bypasses the plain-select-items
+    requirement: the engine emits those raw capture columns and the
+    CALLER owns selection semantics (the aggregating-selector form runs
+    the host QuerySelector over dense match rows).  ``builder`` reuses a
+    caller's NFABuilder (one lowering serves both the selector scope and
+    the engine)."""
     from siddhi_tpu.ops.dense_nfa import DensePatternEngine
     from siddhi_tpu.ops.nfa import NFABuilder
 
     sel = query.selector
-    if sel.group_by or sel.having is not None:
-        raise SiddhiAppCreationError(
-            "dense path: group-by/having selectors run on the host engine")
-    if not sel.selection:
-        raise SiddhiAppCreationError(
-            "dense path: select * is not supported for patterns")
-
-    select_vars: List[Variable] = []
-    select_names: List[str] = []
-    for oa in sel.selection:
-        if not isinstance(oa.expression, Variable) or oa.expression.stream_id is None:
+    if select_override is not None:
+        select_vars, select_names = select_override
+    else:
+        if sel.group_by or sel.having is not None:
             raise SiddhiAppCreationError(
-                "dense path: select items must be event references (e1.attr)")
-        select_vars.append(oa.expression)
-        select_names.append(oa.name)
+                "dense path: group-by/having selectors take the "
+                "host-selector dense form")
+        if not sel.selection:
+            raise SiddhiAppCreationError(
+                "dense path: select * is not supported for patterns")
 
-    builder = NFABuilder(st, resolve_def)
-    nodes = builder.build()
+        select_vars = []
+        select_names = []
+        for oa in sel.selection:
+            if not isinstance(oa.expression, Variable) or oa.expression.stream_id is None:
+                raise SiddhiAppCreationError(
+                    "dense path: select items must be event references (e1.attr)")
+            select_vars.append(oa.expression)
+            select_names.append(oa.name)
+
+    if builder is None:
+        builder = NFABuilder(st, resolve_def)
+        nodes = builder.build()
+    else:
+        # caller's builder already lowered (build() is not idempotent —
+        # it appends); reuse its node chain
+        nodes = builder.nodes
     for node in nodes:
         for spec in node.specs:
             if spec.filter_presence_keys:

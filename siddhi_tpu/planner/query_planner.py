@@ -423,30 +423,72 @@ class QueryPlanner:
 
         if n_partitions is None:
             n_partitions = 1 if key_fn is None else self.app.app_context.tpu_partitions
-        engine = build_dense_engine(
-            query, st, self.app.resolve_stream_definition, n_partitions,
-            n_instances=self.app.app_context.tpu_instances)
 
         sel = query.selector
-        out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
-        out_names = engine.output_names
-        out_attrs = [
-            Attribute(nm, t) for nm, t in zip(out_names, output_attr_types(engine))
-        ]
-        order_by = []
-        for ob in sel.order_by:
-            if ob.variable.attribute not in out_names:
+        if sel.group_by or sel.having is not None or self._has_aggregators(sel):
+            # aggregating-selector form: the dense engine emits the RAW
+            # captured columns (keyed exactly like the host pattern
+            # scope, e.g. "e1.amount") and the ordinary host
+            # QuerySelector aggregates/groups/filters the match rows —
+            # matches are sparse, so selector cost is negligible next to
+            # the jitted NFA step (reference analog: QuerySelector over
+            # StateEvent chunks, QuerySelector.java:76-99)
+            if key_fn is not None or n_partitions > 1:
+                # a single shared QuerySelector would pool aggregation
+                # state ACROSS partition keys; the host form keeps
+                # per-key selector state, so partitioned aggregating
+                # patterns stay on per-key host instances until the
+                # selector grows a partition-key group axis
                 raise SiddhiAppCreationError(
-                    f"order by attribute '{ob.variable.attribute}' not in select output"
-                )
-            order_by.append((ob.variable.attribute, ob.ascending))
-        const_compiler = ExpressionCompiler(Scope())
-        limit = self._const_int(sel.limit, const_compiler, "limit")
-        offset = self._const_int(sel.offset, const_compiler, "offset")
-        selector = QuerySelector(
-            out_target, None, out_names, [], [], None, order_by, limit, offset,
-        )
-        out_def = StreamDefinition(id=out_target, attributes=out_attrs)
+                    "dense path: partitioned aggregating pattern "
+                    "selectors need per-key selector state — host "
+                    "instances used")
+            from siddhi_tpu.ops.nfa import NFABuilder, PatternScope
+
+            builder = NFABuilder(st, self.app.resolve_stream_definition)
+            builder.build()
+            scope = PatternScope(builder.ref_defs, builder.stream_to_ref,
+                                 cand_def=None)
+            compiler = ExpressionCompiler(
+                scope, functions=self.app.functions,
+                table_resolver=self.app.table_resolver)
+            selector, out_def = self._plan_selector(
+                query.selector, scope, compiler, name, query, batch_mode=False
+            )
+            select_vars = [
+                Variable(stream_id=ref, attribute=attr, stream_index=idx)
+                for _key, (ref, idx, attr, _t) in scope.used_captures.items()
+            ]
+            select_names = list(scope.used_captures.keys())
+            engine = build_dense_engine(
+                query, st, self.app.resolve_stream_definition, n_partitions,
+                n_instances=self.app.app_context.tpu_instances,
+                select_override=(select_vars, select_names),
+                builder=builder)
+        else:
+            engine = build_dense_engine(
+                query, st, self.app.resolve_stream_definition, n_partitions,
+                n_instances=self.app.app_context.tpu_instances)
+
+            out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
+            out_names = engine.output_names
+            out_attrs = [
+                Attribute(nm, t) for nm, t in zip(out_names, output_attr_types(engine))
+            ]
+            order_by = []
+            for ob in sel.order_by:
+                if ob.variable.attribute not in out_names:
+                    raise SiddhiAppCreationError(
+                        f"order by attribute '{ob.variable.attribute}' not in select output"
+                    )
+                order_by.append((ob.variable.attribute, ob.ascending))
+            const_compiler = ExpressionCompiler(Scope())
+            limit = self._const_int(sel.limit, const_compiler, "limit")
+            offset = self._const_int(sel.offset, const_compiler, "offset")
+            selector = QuerySelector(
+                out_target, None, out_names, [], [], None, order_by, limit, offset,
+            )
+            out_def = StreamDefinition(id=out_target, attributes=out_attrs)
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
@@ -751,6 +793,22 @@ class QueryPlanner:
         )
         out_def = StreamDefinition(id=out_target, attributes=out_attrs)
         return selector, out_def
+
+    @staticmethod
+    def _has_aggregators(sel: Selector) -> bool:
+        """Does any select item call an aggregator (sum/count/...)?"""
+        def walk(e) -> bool:
+            if isinstance(e, FunctionCall):
+                if e.namespace is None and e.name in AGGREGATOR_NAMES:
+                    return True
+                return any(walk(a) for a in e.args)
+            for attr in ("left", "right", "expr"):
+                child = getattr(e, attr, None)
+                if isinstance(child, Expression) and walk(child):
+                    return True
+            return False
+
+        return any(walk(oa.expression) for oa in (sel.selection or []))
 
     @staticmethod
     def _const_int(expr, compiler, what) -> Optional[int]:

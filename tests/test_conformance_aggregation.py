@@ -1,0 +1,188 @@
+"""Incremental-aggregation conformance: the sec->year cascade.
+
+Ported behavior families from the reference's aggregation suites
+(modules/siddhi-core/src/test/java/io/siddhi/core/aggregation/
+AggregationTestCase.java): multi-duration rollups, out-of-order events,
+on-demand `within ... per ...` stitching, and joins against
+aggregations.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+BASE_TS = 1_600_002_000_000  # hour-aligned (divisible by 3_600_000) so buckets nest
+
+DEFINE = (
+    "define stream Trades (symbol string, price double, volume long, "
+    "ts long); "
+)
+AGG = (
+    "define aggregation TradeAgg from Trades "
+    "select symbol, sum(price) as total, avg(price) as avgPrice, "
+    "count() as n "
+    "group by symbol aggregate by ts every sec ... hour;"
+)
+
+
+def setup(extra=""):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("@app:playback " + DEFINE + AGG + extra)
+    rt.start()
+    return m, rt
+
+
+def send_trades(rt, rows):
+    h = rt.get_input_handler("Trades")
+    for symbol, price, volume, off in rows:
+        ts = BASE_TS + off
+        h.send([symbol, price, volume, ts], timestamp=ts)
+
+
+class TestOnDemandStitching:
+    def test_within_per_seconds(self):
+        m, rt = setup()
+        try:
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 20.0, 1, 500),     # same second
+                ("IBM", 30.0, 1, 1500),    # next second
+            ])
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS} , {BASE_TS + 10_000} per 'seconds' "
+                "select symbol, total, n")
+            data = sorted(e.data for e in rows)
+            assert data == [["IBM", 30.0, 2], ["IBM", 30.0, 1]] or data == [
+                ["IBM", 30.0, 1], ["IBM", 30.0, 2]]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+    def test_per_minutes_rolls_up(self):
+        m, rt = setup()
+        try:
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 20.0, 1, 30_000),    # same minute
+                ("IBM", 40.0, 1, 90_000),    # next minute
+            ])
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS}, {BASE_TS + 600_000} per 'minutes' "
+                "select symbol, total, n")
+            got = sorted(e.data for e in rows)
+            assert got == [["IBM", 30.0, 2], ["IBM", 40.0, 1]]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+    def test_group_isolation_across_symbols(self):
+        m, rt = setup()
+        try:
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("WSO2", 5.0, 1, 100),
+                ("IBM", 20.0, 1, 200),
+            ])
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS}, {BASE_TS + 10_000} per 'seconds' "
+                "select symbol, total")
+            got = sorted(e.data for e in rows)
+            assert got == [["IBM", 30.0], ["WSO2", 5.0]]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+    def test_avg_stitched(self):
+        m, rt = setup()
+        try:
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 30.0, 1, 100),
+            ])
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS}, {BASE_TS + 10_000} per 'seconds' "
+                "select symbol, avgPrice")
+            assert [e.data for e in rows] == [["IBM", 20.0]]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+
+class TestOutOfOrder:
+    def test_late_event_merges_into_closed_bucket(self):
+        m, rt = setup()
+        try:
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 20.0, 1, 2_000),   # closes the first second
+            ])
+            # late event for the FIRST second arrives after it closed
+            h = rt.get_input_handler("Trades")
+            h.send(["IBM", 5.0, 1, BASE_TS + 500], timestamp=BASE_TS + 2_500)
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS}, {BASE_TS + 10_000} per 'seconds' "
+                "select symbol, total order by total")
+            totals = sorted(e.data[1] for e in rows)
+            assert totals == [15.0, 20.0]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+
+class TestAggregationJoin:
+    def test_stream_joins_aggregation_with_per(self):
+        extra = (
+            "define stream Q (symbol string); "
+            "@info(name='j') from Q join TradeAgg "
+            "on Q.symbol == TradeAgg.symbol "
+            f"within {BASE_TS}, {BASE_TS + 600_000} per 'seconds' "
+            "select TradeAgg.symbol as symbol, TradeAgg.total as total "
+            "insert into OutputStream;")
+        m, rt = setup(extra)
+        try:
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(e.data for e in evs))
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 20.0, 1, 400),
+            ])
+            rt.get_input_handler("Q").send(["IBM"],
+                                           timestamp=BASE_TS + 5_000)
+            assert got == [["IBM", 30.0]]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+
+class TestPurgeAnnotation:
+    def test_purge_drops_old_buckets(self):
+        agg = AGG.replace(
+            "define aggregation TradeAgg",
+            "@purge(enable='true', interval='1 sec', "
+            "@retentionPeriod(sec='2 min', min='all')) "
+            "define aggregation TradeAgg")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback " + DEFINE + agg)
+            rt.start()
+            send_trades(rt, [
+                ("IBM", 10.0, 1, 0),
+                ("IBM", 20.0, 1, 5_000),
+                ("IBM", 30.0, 1, 6_000),
+            ])
+            rows = rt.query(
+                "from TradeAgg within "
+                f"{BASE_TS}, {BASE_TS + 60_000} per 'minutes' "
+                "select symbol, total")
+            # minute rollup keeps everything even after seconds purge
+            assert [e.data for e in rows] == [["IBM", 60.0]]
+        finally:
+            rt.shutdown()
+            m.shutdown()

@@ -61,7 +61,7 @@ def norm(rows):
     ]
 
 
-def differential(app, seed, n=60, **stream_kw):
+def differential(app, seed, n=60, approx=False, **stream_kw):
     sends = gen_stream(seed, n=n, **stream_kw)
     host, _, _ = run(app, sends, mode_tpu=False)
     dense, runtime, overflow = run(app, sends, mode_tpu=True)
@@ -70,6 +70,15 @@ def differential(app, seed, n=60, **stream_kw):
         # capacity-dropped instances legitimately diverge; with 16 lanes
         # over these streams this should stay rare — surface it
         pytest.skip(f"instance overflow ({overflow}) — not comparable")
+    if approx:
+        # aggregated outputs (sum over float32-quantized captures) carry
+        # accumulated lane error — 4dp rounding could flip at a boundary,
+        # so compare with a relative tolerance instead
+        assert len(dense) == len(host), (
+            f"seed {seed}: {len(dense)} dense vs {len(host)} host rows")
+        for dr, hr in zip(dense, host):
+            assert dr == pytest.approx(hr, rel=1e-4, abs=1e-3), (dr, hr)
+        return host
     assert norm(dense) == norm(host), (
         f"seed {seed}: dense {len(dense)} rows != host {len(host)} rows\n"
         f"dense: {dense[:6]}...\nhost:  {host[:6]}...")
@@ -111,13 +120,24 @@ SHAPES = {
     "no_within": (
         "@info(name='q') from every a=S[v > 15.0] -> b=S[v > a.v] "
         "select a.v as av, b.v as bv insert into Alerts;"),
+    "aggregating_selector": (
+        "@info(name='q') from every a=S[v > 10.0] -> b=S[v > a.v] "
+        "within 3 sec select a.v as av, sum(b.v) as t, count() as c "
+        "group by a.v insert into Alerts;"),
+    "having_over_aggregate": (
+        "@info(name='q') from every a=S[v > 10.0] -> b=S[v > a.v] "
+        "within 3 sec select a.v as av, sum(b.v) as t "
+        "group by a.v having t > 20.0 insert into Alerts;"),
 }
+
+
+APPROX_SHAPES = {"aggregating_selector", "having_over_aggregate"}
 
 
 @pytest.mark.parametrize("shape", sorted(SHAPES))
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
 def test_shape_matches_host(shape, seed):
-    differential(SHAPES[shape], seed)
+    differential(SHAPES[shape], seed, approx=shape in APPROX_SHAPES)
 
 
 @pytest.mark.parametrize("seed", [11, 12, 13])

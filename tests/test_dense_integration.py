@@ -126,17 +126,48 @@ class TestDensePath:
         assert not isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
 
-    def test_fallback_on_aggregating_selector(self, manager):
-        app = TPU + (
+    def test_aggregating_selector_lowers_dense_with_host_selector(self, manager):
+        """Group-by/aggregating pattern selectors lower densely: the
+        engine emits raw capture columns and the host QuerySelector
+        aggregates the (sparse) match rows — output matches host mode."""
+        app = (
             "define stream Txn (card long, amount double); "
             "@info(name='q') "
             "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
-            "select a.amount as base, b.amount as bv "
-            "group by base insert into Alerts;"
+            "within 10 min "
+            "select a.amount as base, sum(b.amount) as total "
+            "group by a.amount insert into Alerts;"
         )
-        rt = manager.create_siddhi_app_runtime(app)
-        assert not isinstance(
+        sends = [([1, 150.0], 1000), ([1, 200.0], 2000),
+                 ([1, 300.0], 3000), ([1, 120.0], 3500),
+                 ([1, 400.0], 4000)]
+        rt, dense = run_app(manager, TPU + app, sends)
+        assert isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+        m2 = SiddhiManager()
+        _rt2, host = run_app(m2, app, sends)
+        m2.shutdown()
+        assert dense == host and len(host) > 0
+
+    def test_partitioned_aggregating_selector_stays_on_host(self, manager):
+        """A partitioned aggregating pattern needs PER-KEY selector
+        state; one shared dense selector would pool sums across keys —
+        so it falls back to host instances and matches host output."""
+        app = (
+            "define stream Txn (card string, amount double); "
+            "partition with (card of Txn) begin "
+            "@info(name='q') from every a=Txn[amount > 100.0] "
+            "-> b=Txn[amount > a.amount] within 10 min "
+            "select sum(b.amount) as t insert into Alerts; end;")
+        sends = [(["c1", 150.0], 1000), (["c2", 500.0], 1100),
+                 (["c1", 200.0], 2000), (["c2", 600.0], 2100)]
+        _rt, dense_mode = run_app(
+            manager, "@app:execution('tpu', partitions='64') " + app, sends)
+        m2 = SiddhiManager()
+        _rt2, host = run_app(m2, app, sends)
+        m2.shutdown()
+        # per-key sums: c1 gets 200, c2 gets 600 — never pooled
+        assert dense_mode == host == [[200.0], [600.0]]
 
     def test_dense_persist_restore(self, manager):
         rt = manager.create_siddhi_app_runtime(TPU + PATTERN_APP)
