@@ -12,8 +12,11 @@ Activation: ``@app:execution('tpu')`` (the north-star gating from
 BASELINE.json).  The planner attempts dense lowering for every
 pattern/sequence query and falls back to the host engine — logging the
 reason — when the query needs semantics outside the dense subset
-(absent states, optional min-0 nodes, >32 nodes, non-float captures/
-filters/selects, aggregating selectors, ...).  Overlapping `every` arms
+(leading/sequence absent states, optional min-0 nodes, >32 nodes,
+non-numeric captures/filters/selects, ...).  Mid-chain and trailing
+absent states (`not X for t`) run densely via per-instance deadline
+registers and a jitted timer step driven by the app scheduler
+(``DensePatternRuntime.on_time``).  Overlapping `every` arms
 run independently on the engine's instance axis (up to
 ``@app:execution('tpu', instances='N')`` per (partition, node), default
 4); instances dropped when every successor lane is full are counted in
@@ -190,6 +193,10 @@ def _trace_check(eng):
             }
             step = eng.make_step(sk, jit=False)
             jax.eval_shape(step, state_shapes, i32, cols, i32, b1)
+        if eng.has_deadlines:
+            tstep = eng.make_time_step(jit=False)
+            jax.eval_shape(tstep, state_shapes,
+                           jax.ShapeDtypeStruct((), np.int32))
     except SiddhiAppCreationError:
         raise
     except Exception as e:
@@ -240,6 +247,12 @@ class DensePatternRuntime:
         else:
             self.state = engine.init_state()
         self.step_invocations = 0  # proof the jitted path ran (tests)
+        self.time_fires = 0  # timer-driven (absent deadline) emissions
+        # next_wakeup cache: the scheduler polls every send, but the
+        # earliest deadline can only change when a step touched state —
+        # recompute (one device reduce + scalar D2H) only then
+        self._wake_cache = None
+        self._wake_dirty = True
         # instance-capacity overflow surfacing: dropped pending instances
         # are counted on device; poll cheaply (one D2H per _OVF_POLL
         # steps) and warn when the count grows — a dense-mode match set
@@ -457,6 +470,7 @@ class DensePatternRuntime:
             del self._key_rows[k]
             self._free_rows.append(r)
         self._rebuild_key_index()
+        self._wake_dirty = True
 
     def _part_ids(self, batch: EventBatch) -> np.ndarray:
         if self.key_fn is None:
@@ -495,6 +509,8 @@ class DensePatternRuntime:
             self.state, ev_idx, out = eng.process(
                 self.state, stream_key, part, cols, ts)
         self.step_invocations += 1
+        if eng.has_deadlines:
+            self._wake_dirty = True
         if self.step_invocations % self._OVF_POLL == 0:
             self._check_overflow()
         if len(ev_idx) == 0:
@@ -612,18 +628,46 @@ class DensePatternRuntime:
         if rlu is not None:
             self._row_last_used = np.asarray(rlu).copy()
         self._rebuild_key_index()
+        self._wake_dirty = True
 
-    # -- scheduler-compatible no-ops (within expiry is event-driven on
-    # the dense path, like StreamPreStateProcessor's on-arrival pruning)
+    # -- scheduler integration: absent-node deadline timers.  Engines
+    # without deadline nodes keep these as no-ops (within expiry is
+    # event-driven on the dense path, like StreamPreStateProcessor's
+    # on-arrival pruning); engines with absent states are registered as
+    # a scheduler task by the planner and fire matches here.
 
     def on_time(self, now: int):
-        pass
+        eng = self.engine
+        if not getattr(eng, "has_deadlines", False):
+            return
+        self.state, fired = eng.on_time_state(self.state, now)
+        self._wake_dirty = True
+        if fired is None:
+            return
+        self.time_fires += 1
+        out, fire_ts, _rows = fired
+        names = eng.output_names
+        out_cols = {
+            name: out[:, oi].astype(self._out_dtypes[oi])
+            for oi, name in enumerate(names)
+        }
+        mb = EventBatch(
+            self.out_stream_id, names, out_cols,
+            fire_ts, np.full(len(fire_ts), ev.CURRENT, dtype=np.int8),
+        )
+        self.emit_cb(mb)
 
     def next_wakeup(self):
-        return None
+        eng = self.engine
+        if not getattr(eng, "has_deadlines", False):
+            return None
+        if self._wake_dirty:
+            self._wake_cache = eng.next_wakeup_state(self.state)
+            self._wake_dirty = False
+        return self._wake_cache
 
     def fire(self, now: int):
-        pass
+        self.on_time(now)
 
     def on_start(self, now: int):
         pass

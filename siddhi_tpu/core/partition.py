@@ -464,9 +464,10 @@ class PartitionRuntime:
             # unwind scheduler tasks of already-planned siblings before
             # the wholesale fallback to per-key instances
             for _n, qr, _r in planned:
-                task = getattr(qr, "_rate_task", None)
-                if task is not None:
-                    app_planner.scheduler.unregister_task(task)
+                for attr in ("_rate_task", "_dense_timer_task"):
+                    task = getattr(qr, attr, None)
+                    if task is not None:
+                        app_planner.scheduler.unregister_task(task)
             raise
         # all queries lowered — wire key-routed receivers
         for name, qr, runtime in planned:

@@ -23,9 +23,17 @@ jit-compiled step over **micro-batches of events across partitions**:
 Dense-mode semantics (documented subset of the host engine,
 ops/nfa.py — the planner falls back to the host engine otherwise):
  - linear chains (stream + count nodes; logical and/or as one node),
-   no absent states, <= 32 nodes; patterns and strict-continuity
-   sequences (non-matching events kill pending sequence instances
-   pre-advance, start node stays armed);
+   <= 32 nodes; patterns and strict-continuity sequences (non-matching
+   events kill pending sequence instances pre-advance, start node
+   stays armed);
+ - absent states (`not X for t`, `A and not B [for t]`) at positions
+   >= 1 of PATTERN chains: entry arms a per-instance deadline
+   register, a matching absent-stream event kills the instance, and a
+   jitted timer step (make_time_step) advances/emits deadline-passed
+   instances — the dense analog of the reference's scheduler-armed
+   AbsentStreamPreStateProcessor.  Leading absent (deadline from app
+   start), absent in sequences, and same-stream and-not stay on the
+   host engine;
  - **instance axis**: up to ``n_instances`` simultaneous pending
    instances per (partition, node) — overlapping `every` arms advance
    independently, matching the reference's pendingStateEventList.
@@ -222,6 +230,60 @@ class DenseExprCompiler(ExpressionCompiler):
         return super()._c_Variable(e)
 
 
+def _rank_place(jnp, t, mask, anchor, src_regs, src_iregs, entry_dl,
+                a, first, counts, regs, iregs, dl, ovf):
+    """Rank-matched placement of advancing instances into free lanes of
+    node ``t`` (shared by the event step and the timer step): the k-th
+    advancing instance takes the k-th free lane; advancers beyond the
+    free-lane count are dropped and counted in ``ovf`` — explicit
+    capacity where the reference grows an unbounded pending list.
+
+    ``entry_dl`` ([B, I] int32 or None) carries per-source deadline
+    values for a target node with an absent 'for' spec; ``dl`` may be
+    None when the engine has no deadline state at all.
+
+    Returns updated ``(a, first, counts, regs, iregs, dl, ovf)``."""
+    free = ~a[:, t, :] & (counts[:, t, :] == 0)  # [B, I]
+    src_rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
+    free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+    n_free = jnp.sum(free.astype(jnp.int32), axis=1)  # [B]
+    placed = mask & (src_rank < n_free[:, None])
+    ovf = ovf + jnp.sum((mask & ~placed).astype(jnp.int32), axis=1)
+    # [B, Isrc, Itgt] one-hot assignment
+    assign = (placed[:, :, None] & free[:, None, :]
+              & (src_rank[:, :, None] == free_rank[:, None, :]))
+    got = jnp.any(assign, axis=1)  # [B, I] target lanes filled
+    moved_regs = jnp.sum(
+        jnp.where(assign[:, :, :, None], src_regs[:, :, None, :], 0.0),
+        axis=1)  # [B, I, R]
+    moved_anchor = jnp.sum(
+        jnp.where(assign, anchor[:, :, None], 0), axis=1)  # [B, I]
+    a = a.at[:, t, :].set(a[:, t, :] | got)
+    regs = regs.at[:, t, :, :].set(
+        jnp.where(got[:, :, None], moved_regs, regs[:, t, :, :]))
+    if iregs.shape[-1]:
+        moved_iregs = jnp.sum(
+            jnp.where(assign[:, :, :, None], src_iregs[:, :, None, :], 0),
+            axis=1)
+        iregs = iregs.at[:, t, :, :].set(
+            jnp.where(got[:, :, None], moved_iregs, iregs[:, t, :, :]))
+    first = first.at[:, t, :].set(
+        jnp.where(got, moved_anchor.astype(jnp.int32), first[:, t, :]))
+    counts = counts.at[:, t, :].set(
+        jnp.where(got, 0, counts[:, t, :]))
+    if dl is not None:
+        if entry_dl is not None:
+            moved_dl = jnp.sum(
+                jnp.where(assign, entry_dl[:, :, None], 0), axis=1)
+            dl = dl.at[:, t, :].set(
+                jnp.where(got, moved_dl.astype(jnp.int32), dl[:, t, :]))
+        else:
+            # target without a deadline spec: clear any stale value left
+            # by a previous occupant of the lane
+            dl = dl.at[:, t, :].set(jnp.where(got, 0, dl[:, t, :]))
+    return a, first, counts, regs, iregs, dl, ovf
+
+
 class DensePatternEngine:
     """Compiles a lowered node chain into a jitted per-stream step.
 
@@ -270,18 +332,74 @@ class DensePatternEngine:
         self.I = 1 if (is_sequence or not every_start) else max(int(n_instances), 1)
         if self.S > 32:
             raise SiddhiAppCreationError("dense NFA supports at most 32 chain nodes")
+        # absent states ride deadline-timer registers: a node with an
+        # absent `for t` spec arms `deadline = entry_ts + t` on entry,
+        # a matching absent-stream event kills the pending instance, and
+        # the timer step (make_time_step) advances/emits instances whose
+        # deadline passed — the dense analog of
+        # AbsentStreamPreStateProcessor.java:35's scheduler arming
+        self.deadline_w: List[Optional[int]] = []
         for n in nodes:
-            if n.kind == "absent" or any(s.is_absent for s in n.specs):
-                raise SiddhiAppCreationError("dense NFA does not support absent states")
+            w = None
+            for sp in n.specs:
+                if sp.is_absent and sp.waiting_ms is not None:
+                    w = int(sp.waiting_ms)
+            self.deadline_w.append(w)
+        self.has_deadlines = any(w is not None for w in self.deadline_w)
+        for ni, n in enumerate(nodes):
             if n.kind == "stream" and n.min_count == 0:
                 raise SiddhiAppCreationError(
                     "dense NFA does not support optional (min 0) states yet; "
                     "use the host engine"
                 )
+            if n.kind != "absent" and not any(s.is_absent for s in n.specs):
+                continue
+            if is_sequence:
+                raise SiddhiAppCreationError(
+                    "dense NFA: absent states in sequences (strict "
+                    "continuity over a waiting state) need the host engine")
+            if n.kind == "absent" and self.deadline_w[ni] is None:
+                raise SiddhiAppCreationError(
+                    "dense NFA: standalone absent node without a 'for' "
+                    "duration needs the host engine")
+            if ni == 0 and self.deadline_w[ni] is not None:
+                raise SiddhiAppCreationError(
+                    "dense NFA: a leading absent 'for' deadline counts "
+                    "from app start — host engine used")
+            if self.deadline_w[ni] is not None and self.deadline_w[ni] > 2**23:
+                raise SiddhiAppCreationError(
+                    "dense NFA: absent 'for' durations above 2^23 ms would "
+                    "overflow the int32 relative-time deadline — host "
+                    "engine used")
+            if n.kind == "logical":
+                present_keys = {sp.stream_key for sp in n.specs
+                                if not sp.is_absent}
+                absent_keys = {sp.stream_key for sp in n.specs
+                               if sp.is_absent}
+                if present_keys & absent_keys:
+                    raise SiddhiAppCreationError(
+                        "dense NFA: logical and-not over the SAME stream "
+                        "(one event can both match and violate) needs the "
+                        "host engine")
+                if ni == 0 and every_start:
+                    # the host's start instance DIES on an absent-side
+                    # violation and nothing re-arms it; the dense
+                    # standing-virgin would immortally re-arm — diverging
+                    # match sets, so this shape stays on the host engine
+                    raise SiddhiAppCreationError(
+                        "dense NFA: every-start logical and-not (violation "
+                        "permanently kills the start state) needs the host "
+                        "engine")
 
         self.alloc = RegAllocator()
         self._compile_filters(stream_to_ref)
         self._compile_outputs(select_vars, stream_to_ref, select_names)
+        absent_refs = {sp.ref for n in nodes for sp in n.specs if sp.is_absent}
+        for (ref, _attr, _last) in self.alloc.slots:
+            if ref in absent_refs:
+                raise SiddhiAppCreationError(
+                    "dense NFA: filters/selects cannot reference an absent "
+                    "event (it never arrives) — host engine used")
         # open-ended counts stay dually pending: they capture more events
         # after satisfaction and clone per successor-matching event (the
         # via-path in the step, carrying clone-time registers exactly
@@ -397,6 +515,9 @@ class DensePatternEngine:
             # integer capture bank: hi/lo int32 pair per slot
             state["iregs"] = np.zeros((P, S, I, 2 * self.alloc.n_int),
                                       dtype=np.int32)
+        if self.has_deadlines:
+            # absent-node deadlines (relative ms; 0 == unset)
+            state["deadline"] = np.zeros((P, S, I), dtype=np.int32)
         return state
 
     def state_pspecs(self):
@@ -414,6 +535,8 @@ class DensePatternEngine:
         }
         if self.alloc.n_int:
             specs["iregs"] = Pspec(a, None, None, None)
+        if self.has_deadlines:
+            specs["deadline"] = Pspec(a, None, None)
         return specs
 
     def init_state(self):
@@ -518,6 +641,11 @@ class DensePatternEngine:
             iregs = (state["iregs"][part_idx] if "iregs" in state
                      else jnp.zeros((B, S, I, 0), dtype=jnp.int32))
             ovf = state["overflow"][part_idx]    # [B]
+            # deadline registers ride OUTSIDE the functional carry in a
+            # one-cell holder: only placement and the absent kill/complete
+            # branches touch them, and tracing is sequential python
+            dlh = [state["deadline"][part_idx] if "deadline" in state
+                   else None]
             emit = jnp.zeros((B, 2 * I), dtype=bool)
             out_vals = jnp.zeros((B, 2 * I, O), dtype=jnp.float32)
             out_ivals = jnp.zeros((B, 2 * I, 2 * n_iout), dtype=jnp.int32)
@@ -530,6 +658,8 @@ class DensePatternEngine:
                 a = a & ~expired
                 counts = jnp.where(expired, 0, counts)
                 first = jnp.where(expired, 0, first)
+                if dlh[0] is not None:
+                    dlh[0] = jnp.where(expired, 0, dlh[0])
 
             # node filters evaluated once against entry-state registers
             # (the reversed loop reads them before any same-step regs
@@ -631,43 +761,19 @@ class DensePatternEngine:
 
             def _place(mask, anchor, src_regs, t, carry, src_iregs=None):
                 """Move instances in ``mask`` into free lanes of node
-                ``t``.  Slot allocation is rank-matched (k-th advancing
-                instance takes the k-th free lane); advancers beyond the
-                free-lane count are dropped and counted in ``overflow`` —
-                explicit capacity where the reference grows an unbounded
-                list."""
+                ``t`` (rank-matched; see _rank_place).  A target node
+                with an absent 'for' spec arms its deadline to this
+                event's ts + waiting (the reference's _enter_node
+                scheduler arming)."""
                 a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
-                free = ~a[:, t, :] & (counts[:, t, :] == 0)  # [B, I]
-                src_rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
-                free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
-                n_free = jnp.sum(free.astype(jnp.int32), axis=1)  # [B]
-                placed = mask & (src_rank < n_free[:, None])
-                ovf = ovf + jnp.sum((mask & ~placed).astype(jnp.int32), axis=1)
-                # [B, Isrc, Itgt] one-hot assignment
-                assign = (placed[:, :, None] & free[:, None, :]
-                          & (src_rank[:, :, None] == free_rank[:, None, :]))
-                got = jnp.any(assign, axis=1)  # [B, I] target lanes filled
-                moved_regs = jnp.sum(
-                    jnp.where(assign[:, :, :, None], src_regs[:, :, None, :], 0.0),
-                    axis=1)  # [B, I, R]
-                moved_anchor = jnp.sum(
-                    jnp.where(assign, anchor[:, :, None], 0), axis=1)  # [B, I]
-                a = a.at[:, t, :].set(a[:, t, :] | got)
-                regs = regs.at[:, t, :, :].set(
-                    jnp.where(got[:, :, None], moved_regs, regs[:, t, :, :]))
-                if iregs.shape[-1]:
-                    si = iregs[:, t - 1, :, :] if src_iregs is None else src_iregs
-                    moved_iregs = jnp.sum(
-                        jnp.where(assign[:, :, :, None], si[:, :, None, :], 0),
-                        axis=1)
-                    iregs = iregs.at[:, t, :, :].set(
-                        jnp.where(got[:, :, None], moved_iregs,
-                                  iregs[:, t, :, :]))
-                first = first.at[:, t, :].set(
-                    jnp.where(got, moved_anchor.astype(jnp.int32),
-                              first[:, t, :]))
-                counts = counts.at[:, t, :].set(
-                    jnp.where(got, 0, counts[:, t, :]))
+                si = iregs[:, t - 1, :, :] if src_iregs is None else src_iregs
+                w = self.deadline_w[t]
+                entry_dl = (
+                    jnp.broadcast_to(ts[:, None] + w, mask.shape)
+                    if w is not None else None)
+                a, first, counts, regs, iregs, dlh[0], ovf = _rank_place(
+                    jnp, t, mask, anchor, src_regs, si, entry_dl,
+                    a, first, counts, regs, iregs, dlh[0], ovf)
                 return (a, first, counts, regs, iregs, emit, out_vals, out_ivals,
                         emit_anchor, ovf)
 
@@ -710,10 +816,33 @@ class DensePatternEngine:
                 a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
                 node = nodes[s]
                 spec = node.specs[0]
+                if node.kind == "absent":
+                    # a matching absent-stream event KILLS every pending
+                    # instance waiting out the deadline (reference:
+                    # absent violation, _process_event step 3); deadline
+                    # completion itself runs in the timer step
+                    if spec.stream_key != stream_key:
+                        carry = (a, first, counts, regs, iregs, emit, out_vals,
+                                 out_ivals, emit_anchor, ovf)
+                        continue
+                    viol = a[:, s, :] & ok_pre[s] & valid[:, None]
+                    a = a.at[:, s, :].set(a[:, s, :] & ~viol)
+                    counts = counts.at[:, s, :].set(
+                        jnp.where(viol, 0, counts[:, s, :]))
+                    first = first.at[:, s, :].set(
+                        jnp.where(viol, 0, first[:, s, :]))
+                    dlh[0] = dlh[0].at[:, s, :].set(
+                        jnp.where(viol, 0, dlh[0][:, s, :]))
+                    carry = (a, first, counts, regs, iregs, emit, out_vals,
+                             out_ivals, emit_anchor, ovf)
+                    continue
                 if node.kind == "logical":
                     sides = [i for i, sp in enumerate(node.specs)
-                             if sp.stream_key == stream_key]
-                    if not sides:
+                             if sp.stream_key == stream_key
+                             and not sp.is_absent]
+                    kills = [i for i, sp in enumerate(node.specs)
+                             if sp.stream_key == stream_key and sp.is_absent]
+                    if not sides and not kills:
                         carry = (a, first, counts, regs, iregs, emit, out_vals,
                                  out_ivals, emit_anchor, ovf)
                         continue
@@ -721,6 +850,29 @@ class DensePatternEngine:
                     if s == 0 and every_start:
                         # the standing virgin lives in lane 0
                         pending = pending | lane0
+                    for si in kills:
+                        # and-not violation: the absent side arriving
+                        # while the node is pending kills the instance
+                        # (virgins re-arm per event, so only real armed
+                        # lanes die)
+                        viol = a[:, s, :] & ok_pre[s][si] & valid[:, None]
+                        a = a.at[:, s, :].set(a[:, s, :] & ~viol)
+                        counts = counts.at[:, s, :].set(
+                            jnp.where(viol, 0, counts[:, s, :]))
+                        first = first.at[:, s, :].set(
+                            jnp.where(viol, 0, first[:, s, :]))
+                        if dlh[0] is not None:
+                            dlh[0] = dlh[0].at[:, s, :].set(
+                                jnp.where(viol, 0, dlh[0][:, s, :]))
+                        pending = pending & ~viol
+                        if s == 0 and every_start:
+                            pending = pending | lane0
+                    # event-time completion requires a present side to
+                    # have matched THIS event (host completes only inside
+                    # _try_capture's got branch); deferred completions —
+                    # sides matched earlier, and-not-for deadline passing
+                    # later — fire from the timer step alone
+                    matched_now = jnp.zeros((B, I), dtype=bool)
                     for si in sides:
                         ok = ok_pre[s][si]
                         # an already-matched side ignores further events
@@ -728,6 +880,7 @@ class DensePatternEngine:
                         # neither registers nor the anchor may refresh)
                         unmatched = (counts[:, s, :] & (1 << si)) == 0
                         fire = pending & ok & valid[:, None] & unmatched
+                        matched_now = matched_now | fire
                         counts = counts.at[:, s, :].set(
                             jnp.where(fire, counts[:, s, :] | (1 << si),
                                       counts[:, s, :]))
@@ -737,16 +890,23 @@ class DensePatternEngine:
                         first = first.at[:, s, :].set(
                             jnp.where(fire & (first[:, s, :] == 0), ts[:, None],
                                       first[:, s, :]))
-                    need = (
-                        (counts[:, s, :] & ((1 << len(node.specs)) - 1))
-                        if node.logical_op == "and"
-                        else counts[:, s, :]
-                    )
+                    # completion needs every PRESENT side (absent sides
+                    # contribute by staying silent); `and not B for t`
+                    # additionally requires the deadline to have passed
+                    # (host _logical_complete: now >= deadline, with a
+                    # timer-consumed deadline reading as satisfied)
+                    pmask = sum(1 << i for i, sp in enumerate(node.specs)
+                                if not sp.is_absent)
+                    need = counts[:, s, :] & pmask
                     complete = (
-                        (need == (1 << len(node.specs)) - 1)
+                        (need == pmask)
                         if node.logical_op == "and"
                         else (need > 0)
-                    ) & pending & valid[:, None]
+                    ) & pending & valid[:, None] & matched_now
+                    if self.deadline_w[s] is not None:
+                        dls = dlh[0][:, s, :]
+                        complete = complete & (
+                            (dls == 0) | (ts[:, None] >= dls))
                     carry = _advance(s, complete,
                                      (a, first, counts, regs, iregs, emit, out_vals,
                                       out_ivals, emit_anchor, ovf))
@@ -758,6 +918,9 @@ class DensePatternEngine:
                         jnp.where(complete, 0, counts[:, s, :]))
                     first = first.at[:, s, :].set(
                         jnp.where(complete, 0, first[:, s, :]))
+                    if dlh[0] is not None and self.deadline_w[s] is not None:
+                        dlh[0] = dlh[0].at[:, s, :].set(
+                            jnp.where(complete, 0, dlh[0][:, s, :]))
                     carry = (a, first, counts, regs, iregs, emit, out_vals,
                              out_ivals, emit_anchor, ovf)
                     continue
@@ -944,6 +1107,8 @@ class DensePatternEngine:
                 a = jnp.where(any_emit[:, None, None], False, a)
                 counts = jnp.where(any_emit[:, None, None], 0, counts)
                 first = jnp.where(any_emit[:, None, None], 0, first)
+                if dlh[0] is not None:
+                    dlh[0] = jnp.where(any_emit[:, None, None], 0, dlh[0])
 
             # scatter back (valid rows only)
             v1 = valid[:, None, None]
@@ -969,12 +1134,192 @@ class DensePatternEngine:
                 new_state["iregs"] = state["iregs"].at[part_idx].set(
                     jnp.where(valid[:, None, None, None], iregs,
                               state["iregs"][part_idx]))
+            if "deadline" in state:
+                new_state["deadline"] = state["deadline"].at[part_idx].set(
+                    jnp.where(v1, dlh[0], state["deadline"][part_idx]))
             # outs is a pytree: float lanes + integer hi/lo pair lanes
             return new_state, emit, {"f": out_vals, "i": out_ivals}, emit_anchor
 
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[cache_key] = fn
         return fn
+
+    # -- timer step (absent-node deadlines) ---------------------------------
+
+    def make_time_step(self, jit: bool = True) -> Callable:
+        """Build the deadline-timer step (engines with absent states).
+
+        time_step(state, now_i32_rel)
+          -> (state, emit[P, I] bool, outs {f, i}, fire[P, I] i32,
+              n_emit i32)
+
+        Runs over ALL partition rows (no event batch): pending instances
+        whose absent deadline passed advance to the next node — or emit,
+        when the absent node ends the chain — exactly like the host
+        engine's scheduler tick (ops/nfa.py on_time; reference
+        AbsentStreamPreStateProcessor timer path).  ``fire[p, i]`` is the
+        deadline (relative ms) the instance fired at, which becomes the
+        emitted match's timestamp.
+        """
+        cache_key = ("__time__", jit)
+        if cache_key in self._step_cache:
+            return self._step_cache[cache_key]
+        jnp = self.jnp
+        S, I = self.S, self.I
+        nodes = self.nodes
+        within = self.within_ms
+        reset_on_emit = self.reset_on_emit
+        out_spec = self.out_spec
+        O = max(len(out_spec), 1)
+        n_iout = sum(self.out_int)
+
+        def time_step(state, now):
+            a = state["active"]
+            first = state["first_ts"]
+            counts = state["counts"]
+            regs = state["regs"]
+            iregs = (state["iregs"] if "iregs" in state
+                     else jnp.zeros(a.shape + (0,), dtype=jnp.int32))
+            dl = state["deadline"]
+            ovf = state["overflow"]
+            Pr = a.shape[0]
+            emit = jnp.zeros((Pr, I), dtype=bool)
+            out_f = jnp.zeros((Pr, I, O), dtype=jnp.float32)
+            out_i = jnp.zeros((Pr, I, 2 * n_iout), dtype=jnp.int32)
+            fire = jnp.zeros((Pr, I), dtype=jnp.int32)
+
+            # within expiry first (host on_time calls _expire(now) before
+            # firing deadlines): an instance that ran out of its within
+            # window never fires
+            if within is not None:
+                expired = (first > 0) & (now - first > within)
+                a = a & ~expired
+                counts = jnp.where(expired, 0, counts)
+                first = jnp.where(expired, 0, first)
+                dl = jnp.where(expired, 0, dl)
+
+            # descending node order: a fire at node s placing into s+1
+            # cannot re-fire this tick (host on_time is likewise a
+            # single pass over instances)
+            for s in reversed(range(S)):
+                w = self.deadline_w[s]
+                if w is None:
+                    continue
+                node = nodes[s]
+                due = a[:, s, :] & (dl[:, s, :] > 0) & (now >= dl[:, s, :])
+                ft = dl[:, s, :]  # fire timestamps (valid where due)
+                if node.kind == "logical":
+                    # complete only if every present side already
+                    # matched; either way the deadline is CONSUMED (host
+                    # clears inst.deadline at the tick — a later present
+                    # match then completes immediately)
+                    pmask = sum(1 << i for i, sp in enumerate(node.specs)
+                                if not sp.is_absent)
+                    fire_mask = due & ((counts[:, s, :] & pmask) == pmask)
+                else:
+                    fire_mask = due
+                dl = dl.at[:, s, :].set(
+                    jnp.where(due, 0, dl[:, s, :]))
+                anchor = jnp.where(first[:, s, :] > 0, first[:, s, :], ft)
+                if s == S - 1:
+                    emit = emit | fire_mask
+                    fire = jnp.where(fire_mask, ft, fire)
+                    # outputs come from the node's register banks alone —
+                    # select items never reference the absent event
+                    # (validated at construction)
+                    ii = 0
+                    for oi, (_name, src) in enumerate(out_spec):
+                        if self.out_int[oi]:
+                            out_i = out_i.at[:, :, 2 * ii].set(jnp.where(
+                                fire_mask, iregs[:, s, :, 2 * src.index],
+                                out_i[:, :, 2 * ii]))
+                            out_i = out_i.at[:, :, 2 * ii + 1].set(jnp.where(
+                                fire_mask, iregs[:, s, :, 2 * src.index + 1],
+                                out_i[:, :, 2 * ii + 1]))
+                            ii += 1
+                        else:
+                            out_f = out_f.at[:, :, oi].set(jnp.where(
+                                fire_mask, regs[:, s, :, src.index],
+                                out_f[:, :, oi]))
+                else:
+                    w2 = self.deadline_w[s + 1]
+                    entry_dl = (ft + w2) if w2 is not None else None
+                    a, first, counts, regs, iregs, dl, ovf = _rank_place(
+                        jnp, s + 1, fire_mask, anchor,
+                        regs[:, s, :, :], iregs[:, s, :, :], entry_dl,
+                        a, first, counts, regs, iregs, dl, ovf)
+                a = a.at[:, s, :].set(a[:, s, :] & ~fire_mask)
+                counts = counts.at[:, s, :].set(
+                    jnp.where(fire_mask, 0, counts[:, s, :]))
+                first = first.at[:, s, :].set(
+                    jnp.where(fire_mask, 0, first[:, s, :]))
+
+            if reset_on_emit:
+                any_emit = jnp.any(emit, axis=1)
+                a = jnp.where(any_emit[:, None, None], False, a)
+                counts = jnp.where(any_emit[:, None, None], 0, counts)
+                first = jnp.where(any_emit[:, None, None], 0, first)
+                dl = jnp.where(any_emit[:, None, None], 0, dl)
+
+            new_state = {
+                "active": a,
+                "first_ts": first,
+                "counts": counts,
+                "regs": regs,
+                "overflow": ovf,
+                "deadline": dl,
+            }
+            if "iregs" in state:
+                new_state["iregs"] = iregs
+            n_emit = jnp.sum(emit.astype(jnp.int32))
+            return new_state, emit, {"f": out_f, "i": out_i}, fire, n_emit
+
+        fn = self.jax.jit(time_step, donate_argnums=(0,)) if jit else time_step
+        self._step_cache[cache_key] = fn
+        return fn
+
+    def next_wakeup_state(self, state) -> Optional[int]:
+        """Earliest armed absent deadline (absolute ms), or None.  One
+        device reduction + scalar transfer; engines without deadline
+        nodes return None without touching the device."""
+        if not self.has_deadlines or self.base_ts is None:
+            return None
+        if not hasattr(self, "_wakeup_fn"):
+            jnp = self.jnp
+            self._wakeup_fn = self.jax.jit(lambda a, dl: jnp.min(
+                jnp.where(a & (dl > 0), dl, jnp.int32(2**31 - 1))))
+        m = int(self._wakeup_fn(state["active"], state["deadline"]))
+        if m >= 2**31 - 1:
+            return None
+        return self.base_ts + m
+
+    def on_time_state(self, state, now: int):
+        """Advance deadline timers to absolute time ``now``.
+
+        Returns ``(state, fired)`` where ``fired`` is None (common) or
+        ``(out[m, n_out], fire_ts[m] absolute-ms, part_rows[m])``
+        ordered by (fire time, partition row, lane) — the host engine's
+        deadline-ordered flush.  Works on sharded state too: the step is
+        row-parallel, so XLA's sharding propagation runs it shard-local
+        with no collectives."""
+        if not self.has_deadlines or self.base_ts is None:
+            return state, None
+        rel = now - self.base_ts
+        if rel <= 0:
+            return state, None
+        rel = min(rel, 2**31 - 1)
+        tstep = self.make_time_step()
+        state, emit, outs, fire, n_emit = tstep(state, np.int32(rel))
+        if int(n_emit) == 0:
+            return state, None
+        emit_np = np.asarray(emit)
+        rows, lanes = np.nonzero(emit_np)
+        out = self.assemble_out(np.asarray(outs["f"]), np.asarray(outs["i"]),
+                                rows, lanes)
+        fire_np = (np.asarray(fire)[rows, lanes].astype(np.int64)
+                   + self.base_ts)
+        order = np.lexsort((lanes, rows, fire_np))
+        return state, (out[order], fire_np[order], rows[order])
 
     # -- host wrapper -------------------------------------------------------
 
@@ -1042,6 +1387,13 @@ class DensePatternEngine:
         state["first_ts"] = conv("first_ts", shifted.astype(np.int32))
         state["active"] = conv("active", active)
         state["counts"] = conv("counts", counts)
+        if "deadline" in state:
+            # armed deadlines shift with the base; one already at/below
+            # the new zero clamps to 1 (long overdue — fires on the next
+            # tick, which is where the un-shifted value pointed too)
+            dlv = np.asarray(state["deadline"]).astype(np.int64)
+            dshift = np.where(dlv > 0, np.maximum(dlv - delta, 1), 0)
+            state["deadline"] = conv("deadline", dshift.astype(np.int32))
         return state, rel64
 
     def process(self, state, stream_key: str, part_idx: np.ndarray, cols: Dict[str, np.ndarray], ts: np.ndarray):

@@ -518,12 +518,19 @@ class QueryPlanner:
                 junction.subscribe(_DenseStreamReceiver(runtime, sk))
         # registered LAST: nothing above may raise afterwards, so a
         # fallback to the host path never leaks a live scheduler task;
-        # the task handle is kept so multi-query callers (partition
+        # the task handles are kept so multi-query callers (partition
         # lowering) can unregister if a LATER query fails eligibility
         if rate_limiter.needs_scheduler_task:
             task = _RateLimiterTask(qr, rate_limiter)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
+        if getattr(engine, "has_deadlines", False):
+            # absent-node deadlines fire from the app scheduler (the
+            # dense analog of registering the PatternProcessor's
+            # on_time; reference: AbsentStreamPreStateProcessor's
+            # scheduler arming)
+            qr._dense_timer_task = runtime
+            self.app.scheduler.register_task(runtime)
         return qr
 
     # -- single stream ------------------------------------------------------

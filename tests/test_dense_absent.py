@@ -1,0 +1,399 @@
+"""Dense (jitted) absent-state patterns: `not X for t` on the TPU path.
+
+Differential host-vs-dense corpus for absent semantics under
+`@app:execution('tpu')`: trailing absent (timer emission), mid-chain
+absent, logical and-not (with and without `for`), every-arms with
+independent deadlines, within interplay, partitioned deadlines, and the
+eligibility fallbacks.  Reference analog: the scheduler-armed absent
+processors (AbsentStreamPreStateProcessor.java:35,
+LogicalAbsentPreStateProcessor) exercised by
+query/pattern/absent/AbsentPatternTestCase.java — here the deadline
+lives in a per-(partition, node, instance) int32 register advanced by a
+jitted timer step (ops/dense_nfa.py make_time_step).
+"""
+
+import numpy as np
+import pytest
+
+F56 = np.float32(55.6).item()  # 'price float' is float32 on both engines
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+STREAMS = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+    "define stream Stream3 (symbol string, price float, volume int); "
+    "define stream Tick (x int); "
+)
+# the Tick consumer keeps the junction alive so ticks always advance the
+# playback watermark (and with it, absent deadlines)
+TICK_SINK = "from Tick select x insert into IgnoredTicks; "
+TPU = "@app:execution('tpu') "
+
+
+def run(app, sends, out="OutputStream", with_ts=False):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        if with_ts:
+            cb = lambda evs: got.extend(
+                (list(e.data), e.timestamp) for e in evs)
+        else:
+            cb = lambda evs: got.extend(list(e.data) for e in evs)
+        rt.add_callback(out, cb)
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        qr = rt.query_runtimes.get("q")
+        proc = getattr(qr, "pattern_processor", None) if qr else None
+        rt.shutdown()
+        return got, proc
+    finally:
+        m.shutdown()
+
+
+def differential(query, sends, out="OutputStream", dense_expected=True):
+    """Run host and dense forms of the same app; assert identical output
+    (values AND timestamps) and that the dense form really lowered."""
+    app = STREAMS + TICK_SINK + query
+    host, hproc = run(app, sends, out, with_ts=True)
+    dense, dproc = run(TPU + app, sends, out, with_ts=True)
+    if dense_expected:
+        assert isinstance(dproc, DensePatternRuntime), (
+            "query did not lower to the dense path")
+        assert not isinstance(hproc, DensePatternRuntime)
+    assert dense == host, f"dense {dense} != host {host}"
+    return host, dproc
+
+
+class TestTrailingAbsentDense:
+    Q = ("@info(name='q') from e1=Stream1[price>20] -> "
+         "not Stream2[price>e1.price] for 1 sec "
+         "select e1.price as p1 insert into OutputStream;")
+
+    def test_fires_at_deadline(self):
+        got, proc = differential(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2500),
+        ])
+        # emission timestamp is the deadline, not the tick
+        assert got == [([F56], 2000)]
+        assert proc.time_fires == 1
+        assert proc.step_invocations > 0
+
+    def test_suppressed_by_matching_event(self):
+        got, _ = differential(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 1500),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+    def test_non_matching_absent_event_keeps_pending(self):
+        # Stream2 event FAILING the filter (price <= e1.price) must not
+        # cancel the pending deadline
+        got, _ = differential(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 10.0, 100], 1500),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [([F56], 2000)]
+
+    def test_event_after_deadline_does_not_cancel(self):
+        got, _ = differential(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 2100),  # too late
+        ])
+        assert got == [([F56], 2000)]
+
+    def test_every_arms_fire_independent_deadlines(self):
+        q = self.Q.replace("from e1=", "from every e1=")
+        got, proc = differential(q, [
+            ("Stream1", ["A", 30.0, 1], 1000),   # deadline 2000
+            ("Stream1", ["B", 40.0, 1], 1400),   # deadline 2400
+            ("Tick", [1], 2200),                  # fires only A
+            ("Tick", [2], 3000),                  # fires B
+        ])
+        assert got == [([30.0], 2000), ([40.0], 2400)]
+        assert proc.time_fires == 2
+
+    def test_every_kill_hits_all_matching_arms(self):
+        q = self.Q.replace("from e1=", "from every e1=")
+        got, _ = differential(q, [
+            ("Stream1", ["A", 30.0, 1], 1000),
+            ("Stream1", ["B", 40.0, 1], 1400),
+            # price 35 > A's 30 kills A's arm; B's arm (40) survives
+            ("Stream2", ["K", 35.0, 1], 1600),
+            ("Tick", [1], 3000),
+        ])
+        assert got == [([40.0], 2400)]
+
+    def test_within_expires_before_deadline(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "not Stream2[price>e1.price] for 2 sec "
+             "within 1 sec "
+             "select e1.price as p1 insert into OutputStream;")
+        got, _ = differential(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 4000),
+        ])
+        assert got == []
+
+
+class TestMidChainAbsentDense:
+    Q = ("@info(name='q') from e1=Stream1[price>20] -> "
+         "not Stream2[price == e1.price] for 1 sec -> "
+         "e3=Stream3[price > e1.price] "
+         "select e1.price as p1, e3.price as p insert into OutputStream;")
+
+    def test_third_state_matches_only_after_deadline(self):
+        got, proc = differential(self.Q, [
+            ("Stream1", ["W", 30.0, 1], 1000),    # deadline 2000
+            ("Stream3", ["W", 50.0, 1], 1500),    # too early: still waiting
+            ("Tick", [1], 2100),                   # deadline passes
+            ("Stream3", ["W", 60.0, 1], 2500),    # now matches
+        ])
+        assert got == [([30.0, 60.0], 2500)]
+        assert proc.step_invocations > 0
+
+    def test_absent_event_kills_chain(self):
+        got, _ = differential(self.Q, [
+            ("Stream1", ["W", 30.0, 1], 1000),
+            ("Stream2", ["W", 30.0, 1], 1500),    # same price: kill
+            ("Tick", [1], 2100),
+            ("Stream3", ["W", 60.0, 1], 2500),
+        ])
+        assert got == []
+
+    def test_absent_filter_mismatch_keeps_chain(self):
+        got, _ = differential(self.Q, [
+            ("Stream1", ["W", 30.0, 1], 1000),
+            ("Stream2", ["X", 1.0, 1], 1500),     # different price
+            ("Tick", [1], 2100),
+            ("Stream3", ["W", 60.0, 1], 2500),
+        ])
+        assert got == [([30.0, 60.0], 2500)]
+
+
+class TestLogicalAbsentDense:
+    def test_and_not_without_for_fires_on_present(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(e2=Stream3[price>30] and not Stream2[price>40]) "
+             "select e1.price as p1, e2.price as p insert into OutputStream;")
+        got, proc = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),
+            ("Stream3", ["W", 35.0, 1], 1500),    # completes immediately
+        ])
+        assert got == [([25.0, 35.0], 1500)]
+
+    def test_and_not_without_for_killed_by_absent(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(e2=Stream3[price>30] and not Stream2[price>40]) "
+             "select e1.price as p1, e2.price as p insert into OutputStream;")
+        got, _ = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),
+            ("Stream2", ["K", 45.0, 1], 1200),    # violates before e2
+            ("Stream3", ["W", 35.0, 1], 1500),
+        ])
+        assert got == []
+
+    def test_and_not_for_waits_out_the_window(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(e2=Stream3[price>30] and not Stream2[price>40] for 1 sec) "
+             "select e1.price as p1, e2.price as p insert into OutputStream;")
+        # e2 arrives INSIDE the window: completion deferred to deadline
+        got, proc = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),    # window ends 2000
+            ("Stream3", ["W", 35.0, 1], 1500),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [([25.0, 35.0], 2000)]
+        assert proc.time_fires == 1
+
+    def test_and_not_for_present_after_window_completes_immediately(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(e2=Stream3[price>30] and not Stream2[price>40] for 1 sec) "
+             "select e1.price as p1, e2.price as p insert into OutputStream;")
+        got, _ = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),
+            ("Tick", [1], 2200),                   # window passes, no e2 yet
+            ("Stream3", ["W", 35.0, 1], 2500),    # completes at its own ts
+        ])
+        assert got == [([25.0, 35.0], 2500)]
+
+    def test_and_not_for_violated_inside_window(self):
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(e2=Stream3[price>30] and not Stream2[price>40] for 1 sec) "
+             "select e1.price as p1, e2.price as p insert into OutputStream;")
+        got, _ = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),
+            ("Stream3", ["W", 35.0, 1], 1300),
+            ("Stream2", ["K", 45.0, 1], 1600),    # violates pre-deadline
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+
+class TestPartitionedAbsentDense:
+    APP = (
+        "@app:execution('tpu', partitions='64') "
+        + STREAMS + TICK_SINK +
+        "partition with (symbol of Stream1, symbol of Stream2) begin "
+        "@info(name='q') from e1=Stream1[price>20] -> "
+        "not Stream2[price>e1.price] for 1 sec "
+        "select e1.price as p insert into OutputStream; "
+        "end;"
+    )
+    HOST_APP = APP.replace("@app:execution('tpu', partitions='64') ", "")
+
+    def _run(self, app, sends):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            got = []
+            rt.add_callback(
+                "OutputStream",
+                lambda evs: got.extend((list(e.data), e.timestamp)
+                                       for e in evs))
+            rt.start()
+            for stream, row, ts in sends:
+                rt.get_input_handler(stream).send(row, timestamp=ts)
+            rt.shutdown()
+            return got
+        finally:
+            m.shutdown()
+
+    def test_per_key_deadlines(self):
+        sends = [
+            ("Stream1", ["A", 30.0, 1], 1000),    # A deadline 2000
+            ("Stream1", ["B", 50.0, 1], 1200),    # B deadline 2200
+            ("Stream2", ["B", 60.0, 1], 1500),    # kills B's key only
+            ("Tick", [1], 3000),
+        ]
+        host = self._run(self.HOST_APP, sends)
+        dense = self._run(self.APP, sends)
+        assert dense == host
+        assert sorted(dense) == [([30.0], 2000)]
+
+
+class TestAbsentEligibilityFallbacks:
+    def _proc(self, query, app_prefix=TPU):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback " + app_prefix + STREAMS + TICK_SINK + query)
+            qr = rt.query_runtimes.get("q")
+            proc = getattr(qr, "pattern_processor", None)
+            rt.shutdown()
+            return proc
+        finally:
+            m.shutdown()
+
+    def test_leading_absent_falls_back(self):
+        proc = self._proc(
+            "@info(name='q') from not Stream1[price>20] for 1 sec -> "
+            "e2=Stream2[price>20] "
+            "select e2.price as p insert into OutputStream;")
+        assert not isinstance(proc, DensePatternRuntime)
+
+    def test_sequence_absent_falls_back(self):
+        proc = self._proc(
+            "@info(name='q') from e1=Stream1[price>20], "
+            "not Stream2[price>e1.price] for 1 sec "
+            "select e1.price as p insert into OutputStream;")
+        assert not isinstance(proc, DensePatternRuntime)
+
+    def test_every_start_logical_and_not_falls_back(self):
+        # the host virgin instance dies permanently on an absent-side
+        # violation; the dense standing virgin would re-arm forever —
+        # the shape must stay on the host engine (review finding r4)
+        proc = self._proc(
+            "@info(name='q') from every (e1=Stream1[price>20] "
+            "and not Stream2[price>40]) "
+            "select e1.price as p insert into OutputStream;")
+        assert not isinstance(proc, DensePatternRuntime)
+        got_h, _ = run(
+            STREAMS + TICK_SINK +
+            "@info(name='q') from every (e1=Stream1[price>20] "
+            "and not Stream2[price>40]) "
+            "select e1.price as p insert into OutputStream;", [
+                ("Stream2", ["K", 45.0, 1], 1000),
+                ("Stream1", ["W", 25.0, 1], 1500),
+                ("Stream1", ["W", 26.0, 1], 1600),
+            ])
+        got_d, _ = run(
+            TPU + STREAMS + TICK_SINK +
+            "@info(name='q') from every (e1=Stream1[price>20] "
+            "and not Stream2[price>40]) "
+            "select e1.price as p insert into OutputStream;", [
+                ("Stream2", ["K", 45.0, 1], 1000),
+                ("Stream1", ["W", 25.0, 1], 1500),
+                ("Stream1", ["W", 26.0, 1], 1600),
+            ])
+        assert got_d == got_h == []
+
+    def test_all_absent_logical_node_matches_host(self):
+        # (not B and not C for t): no present side — completion can only
+        # come from the timer, never from a non-killing event of a
+        # constituent stream (review finding r4)
+        q = ("@info(name='q') from e1=Stream1[price>20] -> "
+             "(not Stream2[price>40] and not Stream3[price>40] for 1 sec) "
+             "select e1.price as p insert into OutputStream;")
+        got, _ = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),     # window ends 2000
+            ("Stream2", ["X", 10.0, 1], 1500),     # filter fails: no kill
+            ("Tick", [1], 2500),                    # timer completes
+        ])
+        assert got == [([25.0], 2000)]
+        got2, _ = differential(q, [
+            ("Stream1", ["W", 25.0, 1], 1000),
+            ("Stream3", ["K", 45.0, 1], 1500),     # violation: killed
+            ("Tick", [1], 2500),
+        ])
+        assert got2 == []
+
+    def test_same_stream_and_not_falls_back(self):
+        proc = self._proc(
+            "@info(name='q') from e1=Stream1[price>20] -> "
+            "(e2=Stream1[price>30] and not Stream1[price>100]) "
+            "select e1.price as p insert into OutputStream;")
+        assert not isinstance(proc, DensePatternRuntime)
+
+    def test_eligible_absent_lowers_dense(self):
+        proc = self._proc(
+            "@info(name='q') from e1=Stream1[price>20] -> "
+            "not Stream2[price>e1.price] for 1 sec "
+            "select e1.price as p insert into OutputStream;")
+        assert isinstance(proc, DensePatternRuntime)
+        assert proc.engine.has_deadlines
+
+
+class TestAbsentSnapshotDense:
+    def test_pending_deadline_survives_restore(self):
+        app = ("@app:playback " + TPU + STREAMS + TICK_SINK +
+               "@info(name='q') from e1=Stream1[price>20] -> "
+               "not Stream2[price>e1.price] for 1 sec "
+               "select e1.price as p insert into OutputStream;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            got = []
+            rt.add_callback(
+                "OutputStream",
+                lambda evs: got.extend((list(e.data), e.timestamp)
+                                       for e in evs))
+            rt.start()
+            rt.get_input_handler("Stream1").send(
+                ["WSO2", 55.6, 100], timestamp=1000)
+            snap = rt.snapshot()
+            # kill the pending instance, then restore: it must come back
+            rt.get_input_handler("Stream2").send(
+                ["K", 60.0, 1], timestamp=1200)
+            rt.restore(snap)
+            rt.get_input_handler("Tick").send([1], timestamp=2500)
+            rt.shutdown()
+            assert got == [([F56], 2000)]
+        finally:
+            m.shutdown()

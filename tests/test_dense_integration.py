@@ -105,15 +105,19 @@ class TestDensePath:
         assert not isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
 
-    def test_fallback_on_absent_pattern(self, manager):
+    def test_trailing_absent_lowers_dense(self, manager):
+        # round 4: `not X for t` rides deadline registers + the jitted
+        # timer step (see tests/test_dense_absent.py for the semantics
+        # corpus); only leading/sequence absent still falls back
         app = TPU + (
             "define stream A (v double); define stream B (v double); "
             "@info(name='q') from A -> not B for 1 sec "
             "select a.v as av insert into Alerts;"
         ).replace("from A ->", "from a=A ->")
         rt = manager.create_siddhi_app_runtime(app)
-        assert not isinstance(
-            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+        proc = rt.query_runtimes["q"].pattern_processor
+        assert isinstance(proc, DensePatternRuntime)
+        assert proc.engine.has_deadlines
 
     def test_fallback_on_string_capture(self, manager):
         app = TPU + (

@@ -210,8 +210,9 @@ class TestPatternStateIntrospection:
             "define stream S (v double); "
             "@info(name='qd') from every a=S[v > 100.0] -> b=S[v > a.v] "
             "within 10 min select a.v as av, b.v as bv insert into Alerts; "
-            "@info(name='qh') from every a=S[v > 100.0] -> "
-            "not S[v > 1000.0] for 1 sec "
+            "define stream T (card string, v double); "
+            "@info(name='qh') from every a=T[v > 100.0] -> "
+            "b=T[card == a.card] "
             "select a.v as av insert into Alerts2;")
         m = SiddhiManager()
         try:
@@ -221,12 +222,14 @@ class TestPatternStateIntrospection:
             h = rt.get_input_handler("S")
             h.send([500.0], timestamp=1000)
             h.send([400.0], timestamp=1100)
+            ht = rt.get_input_handler("T")
+            ht.send(["c1", 500.0], timestamp=1200)
             st = rt.pattern_state()
             assert st["qd"]["engine"] == "dense"
             assert st["qd"]["active_instances"] == 2
             assert st["qd"]["dropped_instances"] == 0
             assert st["qd"]["instance_lanes"] == 4
-            assert st["qh"]["engine"] == "host"  # absent -> host fallback
+            assert st["qh"]["engine"] == "host"  # string capture -> host
             assert st["qh"]["active_instances"] >= 1
             rt.shutdown()
         finally:
