@@ -504,6 +504,17 @@ class Parser:
                 depth -= 1
                 if depth < 0:
                     break
+            elif depth > 0:
+                # markers that cannot occur inside expression parentheses
+                # still classify a parenthesized whole pattern, e.g.
+                # `from (every e1=A -> e2=B) within 1 sec` (reference
+                # WithinPatternTestCase.testQuery2's shape)
+                if t.kind == T.SYM and t.text == "->":
+                    has_arrow = True
+                elif t.kind == T.SYM and t.text == "=":
+                    has_binding = True
+                elif t.kind == T.KW and t.text == "every":
+                    has_every = True
             elif depth == 0:
                 if t.kind == T.SYM and t.text == "->":
                     has_arrow = True

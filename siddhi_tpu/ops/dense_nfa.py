@@ -109,8 +109,9 @@ def _i64_join(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
 class DenseScope(PatternScope):
     """Filter/selector scope resolving captured refs to register slots."""
 
-    def __init__(self, ref_defs, stream_to_ref, cand_def, alloc: "RegAllocator"):
-        super().__init__(ref_defs, stream_to_ref, cand_def)
+    def __init__(self, ref_defs, stream_to_ref, cand_def, alloc: "RegAllocator",
+                 cand_ref=None):
+        super().__init__(ref_defs, stream_to_ref, cand_def, cand_ref=cand_ref)
         self.alloc = alloc
 
     def resolve(self, var: Variable):
@@ -332,6 +333,16 @@ class DensePatternEngine:
         self.I = 1 if (is_sequence or not every_start) else max(int(n_instances), 1)
         if self.S > 32:
             raise SiddhiAppCreationError("dense NFA supports at most 32 chain nodes")
+        for n in nodes:
+            if n.rearm_to is not None and not (n.pos == 0 and n.rearm_to == 0):
+                # the standing virgin models `every` only when re-arm
+                # fires at node 0's completion (`every e1 -> ...`);
+                # group-every re-arms at GROUP completion — one arm at a
+                # time, which a per-event virgin would over-arm
+                # (WithinPatternTestCase.testQuery4/6)
+                raise SiddhiAppCreationError(
+                    "dense NFA: group-scoped `every` re-arms at group "
+                    "completion — host engine used")
         # absent states ride deadline-timer registers: a node with an
         # absent `for t` spec arms `deadline = entry_ts + t` on entry,
         # a matching absent-stream event kills the pending instance, and
@@ -442,7 +453,9 @@ class DensePatternEngine:
                     fs.append(None)
                     continue
                 # recompile the raw filter against the dense scope
-                scope = DenseScope(self.ref_defs, stream_to_ref, spec.stream_def, self.alloc)
+                scope = DenseScope(self.ref_defs, stream_to_ref,
+                                   spec.stream_def, self.alloc,
+                                   cand_ref=spec.ref)
                 compiler = DenseExprCompiler(scope)
                 fs.append(compiler.compile(spec.raw_filter))
             self.node_filters.append(fs)
@@ -880,6 +893,12 @@ class DensePatternEngine:
                         # neither registers nor the anchor may refresh)
                         unmatched = (counts[:, s, :] & (1 << si)) == 0
                         fire = pending & ok & valid[:, None] & unmatched
+                        if node.logical_op == "or":
+                            # 'or' consumes only the FIRST matching side
+                            # (host/reference leave the other side's
+                            # capture null — LogicalPatternTestCase.
+                            # testQuery3); 'and' lets one event fill both
+                            fire = fire & ~matched_now
                         matched_now = matched_now | fire
                         counts = counts.at[:, s, :].set(
                             jnp.where(fire, counts[:, s, :] | (1 << si),
@@ -1098,6 +1117,20 @@ class DensePatternEngine:
                                 carry = _place(fire_via, via_anchor, via_regs,
                                                s + 1, carry,
                                                src_iregs=via_iregs)
+                            # PATTERN forward-once: the dually-pending arm
+                            # is consumed at its successor match — it can
+                            # emit at most once (reference
+                            # removeIfNextStateProcessed; the host engine
+                            # kills the source on via-advance likewise)
+                            a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
+                            a = a.at[:, s - 1, :].set(
+                                a[:, s - 1, :] & ~fire_via)
+                            counts = counts.at[:, s - 1, :].set(
+                                jnp.where(fire_via, 0, counts[:, s - 1, :]))
+                            first = first.at[:, s - 1, :].set(
+                                jnp.where(fire_via, 0, first[:, s - 1, :]))
+                            carry = (a, first, counts, regs, iregs, emit,
+                                     out_vals, out_ivals, emit_anchor, ovf)
 
             a, first, counts, regs, iregs, emit, out_vals, out_ivals, emit_anchor, ovf = carry
 
@@ -1630,6 +1663,16 @@ def compile_pattern(
     nodes = builder.build()
     if every_start is None:
         every_start = any(n.rearm_to is not None for n in nodes)
+        for n in nodes:
+            if n.rearm_to is not None and not (n.pos == 0 and n.rearm_to == 0):
+                # the dense standing-virgin models `every` only when the
+                # re-arm fires at node 0's completion (`every e1 -> ...`);
+                # group-every (`every (e1->e2) -> ...`) re-arms at GROUP
+                # completion — one arm at a time, which a per-event virgin
+                # would over-arm (WithinPatternTestCase.testQuery4/6)
+                raise SiddhiAppCreationError(
+                    "dense path: group-scoped `every` re-arms at group "
+                    "completion — host engine used")
 
     select_vars = []
     select_names = []

@@ -168,11 +168,13 @@ class PatternScope(Scope):
         ref_defs: Dict[str, StreamDefinition],
         stream_to_ref: Dict[str, Optional[str]],
         cand_def: Optional[StreamDefinition] = None,
+        cand_ref: Optional[str] = None,
     ):
         super().__init__()
         self.ref_defs = ref_defs
         self.stream_to_ref = stream_to_ref
         self.cand_def = cand_def
+        self.cand_ref = cand_ref
         # recorded needs: key -> (ref, idx|None, attr, AttrType)
         self.used_captures: Dict[str, Tuple[str, Optional[int], str, AttrType]] = {}
 
@@ -212,6 +214,18 @@ class PatternScope(Scope):
                 f"cannot resolve attribute '{var.attribute}' in pattern scope"
                 + (" (ambiguous)" if len(hits) > 1 else "")
             )
+        if (
+            self.cand_ref is not None
+            and var.stream_id == self.cand_ref
+            and var.stream_index is None
+            and self.cand_def is not None
+            and var.attribute in self.cand_def.attribute_names
+        ):
+            # a state's own ref inside its own filter is the INCOMING
+            # event (reference: ExpressionParser resolves the current
+            # state's ref to the candidate, e.g.
+            # `e2=S[e1.symbol==e2.symbol]` — CountPatternTestCase.testQuery13)
+            return "__cand." + var.attribute, self.cand_def.attribute_type(var.attribute)
         ref = self._ref_for(var.stream_id)
         if ref is None:
             raise SiddhiAppCreationError(
@@ -342,7 +356,8 @@ class NFABuilder:
             expr = filters[0]
             for f in filters[1:]:
                 expr = AndOp(expr, f)
-            scope = PatternScope(self.ref_defs, self.stream_to_ref, cand_def=d)
+            scope = PatternScope(self.ref_defs, self.stream_to_ref, cand_def=d,
+                                 cand_ref=sse.event_ref)
             compiler = ExpressionCompiler(scope)
             spec.raw_filter = expr
             spec.filter_compiled = compiler.compile(expr)
@@ -606,12 +621,27 @@ class PatternProcessor:
                 if node.kind == "stream" and inst.count >= node.min_count and (
                     node.max_count == ANY or inst.count < node.max_count
                 ):
+                    advanced = False
                     for sp in self._successors(inst.pos):
-                        used |= self._try_enter(
+                        advanced |= self._try_enter(
                             inst, self.nodes[sp], stream_key, row, ts, staged, via_clone=True
                         )
+                    if advanced and self.mode == "pattern":
+                        # PATTERN: the forwarded instance is SHARED with
+                        # the successor — once the successor captures, the
+                        # count state drops its copy and the arm emits at
+                        # most once (reference CountPreStateProcessor.
+                        # removeIfNextStateProcessed / CountPostState-
+                        # Processor.processMinCountReached fires only at
+                        # ==min; ComplexPatternTestCase.testQuery3's three
+                        # non-repeating matches pin this).  SEQUENCE
+                        # re-forwards per capture (the reference's
+                        # stateType==SEQUENCE branch) — keep dual alive.
+                        inst.alive = False
+                    used |= advanced
                 # 2) capture at current node
-                used |= self._try_capture(inst, node, stream_key, row, ts)
+                if inst.alive:
+                    used |= self._try_capture(inst, node, stream_key, row, ts)
                 # 3) absent violation
                 for s in node.specs:
                     if (
@@ -677,7 +707,12 @@ class PatternProcessor:
                     if inst.first_ts is None:
                         inst.first_ts = ts
                     got = True
-                    break
+                    # 'and': ONE event can satisfy BOTH sides (reference
+                    # partner processors each see it —
+                    # LogicalPatternTestCase.testQuery5); 'or' consumes
+                    # the first matching side only (testQuery3)
+                    if node.logical_op == "or":
+                        break
             if got and self._logical_complete(node, inst):
                 self._complete_logical(inst, node, ts)
             return got
@@ -711,19 +746,21 @@ class PatternProcessor:
                     self._enter_node(inst, node.pos + 1, ts)
             return True
         if node.kind == "logical":
-            hit = None
+            hits = []
             for si, spec in enumerate(node.specs):
                 if spec.is_absent:
                     continue
                 if spec.stream_key == stream_key and self._filter_pass(spec, src, row, ts):
-                    hit = si
-                    break
-            if hit is None:
+                    hits.append(si)
+                    if node.logical_op == "or":
+                        break
+            if not hits:
                 return False
             inst = src.clone()
             self._enter_node_quiet(inst, node.pos, ts)
-            inst.captured.setdefault(node.specs[hit].ref, []).append(dict(row, __ts=ts))
-            inst.matched_sides = {hit}
+            for si in hits:
+                inst.captured.setdefault(node.specs[si].ref, []).append(dict(row, __ts=ts))
+            inst.matched_sides = set(hits)
             if inst.first_ts is None:
                 inst.first_ts = ts
             staged.append(inst)
@@ -770,10 +807,28 @@ class PatternProcessor:
     def _expire(self, now: int):
         if self.within_ms is None:
             return
+        expired_src: Optional[Instance] = None
         for inst in self.instances:
             if inst.first_ts is not None and now - inst.first_ts > self.within_ms:
                 inst.alive = False
+                expired_src = inst
         self.instances = [i for i in self.instances if i.alive]
+        if (
+            expired_src is not None
+            and self.mode == "pattern"
+            and self.has_every
+        ):
+            # an every-pattern whose pending arm ran out of its within
+            # window re-arms a fresh start (reference: expireEvents →
+            # withinEveryPreStateProcessor.addEveryState, one re-arm per
+            # tick; keeps captures before the every-group start).
+            # _arm_fresh dedupes against an existing virgin, so patterns
+            # that already keep a standing virgin are unaffected
+            # (WithinPatternTestCase.testQuery1 vs testQuery4).
+            restart = min(
+                n.rearm_to for n in self.nodes if n.rearm_to is not None
+            )
+            self._arm_fresh(restart, now, src=expired_src)
 
     def on_time(self, now: int):
         """Scheduler tick: absent-node deadlines fire."""

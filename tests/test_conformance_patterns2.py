@@ -1,0 +1,679 @@
+"""Pattern conformance, part 2: Complex/Count/Every/Logical/Within
+matrices ported from the reference TestNG corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/pattern/
+ComplexPatternTestCase.java, CountPatternTestCase.java,
+EveryPatternTestCase.java, LogicalPatternTestCase.java,
+WithinPatternTestCase.java).  Each case asserts the reference's concrete
+output rows (Thread.sleep gaps become playback timestamp gaps).  Where a
+query is dense-eligible, `both()` also runs it under
+@app:execution('tpu') and asserts the dense output matches host
+bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S12 = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+)
+S123 = S12 + "define stream Stream3 (symbol string, price float, volume int); "
+
+
+def f32(x):
+    return np.float32(x).item()
+
+
+def run(app, sends, out="OutputStream"):
+    """Playback-mode run; sends = (stream, row, ts)."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def both(app, sends, expected, out="OutputStream"):
+    """Host run asserts the reference rows; TPU run (dense where
+    eligible, host fallback otherwise) must agree exactly."""
+    host = run(app, sends, out)
+    assert host == expected, f"host {host} != expected {expected}"
+    tpu = run("@app:execution('tpu') " + app, sends, out)
+    assert tpu == host, f"tpu {tpu} != host {host}"
+    return host
+
+
+def ts_seq(streams_rows, base=1000, gap=100):
+    """[(stream, row), ...] -> evenly spaced playback sends."""
+    return [(s, r, base + i * gap) for i, (s, r) in enumerate(streams_rows)]
+
+
+class TestComplexPatterns:
+    def test_every_group_or_then_next(self):
+        # ComplexPatternTestCase.testQuery1
+        q = ("@info(name='q') from every (e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]) "
+             "-> e4=Stream2[price > e1.price] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3, e4.price as price4 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["WSO2", 55.7, 100]),
+            ("Stream2", ["GOOG", 55.0, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream2", ["IBM", 57.7, 100]),
+            ("Stream2", ["IBM", 59.7, 100]),
+        ]), [
+            [f32(55.6), f32(55.7), None, f32(57.7)],
+            [f32(54.0), f32(57.7), None, f32(59.7)],
+        ])
+
+    def test_every_group_count_then_cross_filter(self):
+        # ComplexPatternTestCase.testQuery2
+        q = ("@info(name='q') from every (e1=Stream1[price > 20] -> "
+             "e2=Stream1[price > 20]<1:2>) -> e3=Stream1[price > e1.price] "
+             "select e1.price as price1, e2[0].price as price2_0, "
+             "e2[1].price as price2_1, e3.price as price3 "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 54.0, 100]),
+            ("Stream1", ["WSO2", 53.6, 100]),
+            ("Stream1", ["GOOG", 57.0, 100]),
+        ]), [[f32(55.6), f32(54.0), f32(53.6), f32(57.0)]])
+
+    def test_every_open_count_single_stream(self):
+        # ComplexPatternTestCase.testQuery3: three interleaved matches
+        q = ("@info(name='q') from every e1=Stream1[price >= 50 and "
+             "volume > 100] -> e2=Stream1[price <= 40]<2:> -> "
+             "e3=Stream1[volume <= 70] "
+             "select e1.symbol as symbol1, e2[last].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream1", ["GOOG", 39.8, 91]),
+            ("Stream1", ["FB", 35.0, 81]),
+            ("Stream1", ["WSO2", 21.0, 61]),
+            ("Stream1", ["ADP", 50.0, 101]),
+            ("Stream1", ["GOOG", 41.2, 90]),
+            ("Stream1", ["FB", 40.0, 100]),
+            ("Stream1", ["WSO2", 33.6, 85]),
+            ("Stream1", ["AMZN", 23.5, 55]),
+            ("Stream1", ["WSO2", 51.7, 180]),
+            ("Stream1", ["TXN", 34.0, 61]),
+            ("Stream1", ["QQQ", 24.6, 45]),
+            ("Stream1", ["CSCO", 181.6, 40]),
+            ("Stream1", ["WSO2", 53.7, 200]),
+        ]), [
+            ["IBM", "FB", "WSO2"],
+            ["ADP", "WSO2", "AMZN"],
+            ["WSO2", "QQQ", "CSCO"],
+        ])
+
+    def test_every_open_count_two_streams(self):
+        # ComplexPatternTestCase.testQuery4
+        q = ("@info(name='q') from every e1=Stream1[price >= 50 and "
+             "volume > 100] -> e2=Stream2[price <= 40]<1:> -> "
+             "e3=Stream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream2", ["GOOG", 21.0, 81]),
+            ("Stream2", ["WSO2", 176.6, 65]),
+            ("Stream1", ["BIRT", 21.0, 81]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DDD", 23.0, 181]),
+            ("Stream2", ["BIRT", 21.0, 86]),
+            ("Stream2", ["BIRT", 21.0, 82]),
+            ("Stream2", ["WSO2", 176.6, 60]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DOX", 16.2, 25]),
+        ]), [["WSO2", "GOOG", 65], ["WSO2", "DDD", 60]])
+
+    def test_cross_ref_filter_in_second_state(self):
+        # ComplexPatternTestCase.testQuery5 (non-every)
+        q = ("@info(name='q') from e1=Stream1[price >= 50 and volume > 100] "
+             "-> e2=Stream2[e1.symbol != 'AMBA'] -> "
+             "e3=Stream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as volume3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream2", ["GOOG", 21.0, 81]),
+            ("Stream2", ["WSO2", 176.6, 65]),
+            ("Stream1", ["BIRT", 21.0, 81]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DDD", 23.0, 181]),
+            ("Stream2", ["BIRT", 21.0, 86]),
+            ("Stream2", ["BIRT", 21.0, 82]),
+            ("Stream2", ["WSO2", 176.6, 60]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DOX", 16.2, 25]),
+        ]), [["WSO2", "GOOG", 65]])
+
+    def test_every_unfiltered_start_open_count(self):
+        # ComplexPatternTestCase.testQuery6
+        q = ("@info(name='q') from every e1=Stream1 -> "
+             "e2=Stream2[e1.symbol != 'AMBA']<2:> -> "
+             "e3=Stream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as volume3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream2", ["GOOG", 21.0, 51]),
+            ("Stream2", ["FBX", 21.0, 81]),
+            ("Stream2", ["WSO2", 176.6, 65]),
+            ("Stream1", ["BIRT", 21.0, 81]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DDD", 23.0, 181]),
+            ("Stream2", ["BIRT", 21.0, 86]),
+            ("Stream2", ["IBN", 21.0, 70]),
+            ("Stream2", ["WSO2", 176.6, 90]),
+            ("Stream1", ["AMBA", 126.6, 165]),
+            ("Stream2", ["DOX", 16.2, 25]),
+        ]), [["WSO2", "GOOG", 65], ["IBN", "DDD", 70]])
+
+
+class TestCountPatterns2:
+    CQ = ("@info(name='q') from e1=Stream1[price>20] <0:5> -> "
+          "e2=Stream2[price>20] "
+          "select e1[0].price as price1_0, e1[1].price as price1_1, "
+          "e2.price as price2 insert into OutputStream;")
+
+    def test_zero_min_skipped_entirely(self):
+        # CountPatternTestCase.testQuery7: <0:5> satisfied with no events
+        both(S12 + self.CQ, ts_seq([
+            ("Stream2", ["IBM", 45.7, 100]),
+        ]), [[None, None, f32(45.7)]])
+
+    def test_zero_min_cross_ref_filter(self):
+        # CountPatternTestCase.testQuery8: failing capture not stored
+        q = ("@info(name='q') from e1=Stream1[price>20] <0:5> -> "
+             "e2=Stream2[price>e1[0].price] "
+             "select e1[0].price as price1_0, e1[1].price as price1_1, "
+             "e2.price as price2 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["GOOG", 7.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+        ]), [[f32(25.6), None, f32(45.7)]])
+
+    def test_zero_min_mid_chain(self):
+        # CountPatternTestCase.testQuery9
+        q = ("@info(name='q') from e1=Stream1[price >= 50 and volume > 100] "
+             "-> e2=Stream1[price <= 40]<0:5> -> e3=Stream1[volume <= 70] "
+             "select e1.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream1", ["GOOG", 21.0, 81]),
+            ("Stream1", ["WSO2", 176.6, 65]),
+        ]), [["IBM", "GOOG", "WSO2"]])
+
+    def test_upper_only_count_zero_captures(self):
+        # CountPatternTestCase.testQuery10: <:5> with first-ref select
+        q = ("@info(name='q') from e1=Stream1[price >= 50 and volume > 100] "
+             "-> e2=Stream1[price <= 40]<:5> -> e3=Stream1[volume <= 70] "
+             "select e1.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream1", ["GOOG", 21.0, 61]),
+            ("Stream1", ["WSO2", 21.0, 61]),
+        ]), [["IBM", None, "GOOG"]])
+
+    def test_upper_only_count_last_ref(self):
+        # CountPatternTestCase.testQuery11: e2[last] null when e2 empty
+        q = ("@info(name='q') from e1=Stream1[price >= 50 and volume > 100] "
+             "-> e2=Stream1[price <= 40]<:5> -> e3=Stream1[volume <= 70] "
+             "select e1.symbol as symbol1, e2[last].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream1", ["GOOG", 21.0, 61]),
+            ("Stream1", ["WSO2", 21.0, 61]),
+        ]), [["IBM", None, "GOOG"]])
+
+    def test_upper_only_count_last_ref_filled(self):
+        # CountPatternTestCase.testQuery12
+        q = ("@info(name='q') from e1=Stream1[price >= 50 and volume > 100] "
+             "-> e2=Stream1[price <= 40]<:5> -> e3=Stream1[volume <= 70] "
+             "select e1.symbol as symbol1, e2[last].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 105]),
+            ("Stream1", ["GOOG", 21.0, 91]),
+            ("Stream1", ["FB", 21.0, 81]),
+            ("Stream1", ["WSO2", 21.0, 61]),
+        ]), [["IBM", "FB", "WSO2"]])
+
+    def test_every_sliding_count_window(self):
+        # CountPatternTestCase.testQuery13: every + <4:6> same-symbol runs
+        q = ("@info(name='q') from every e1=Stream1 -> "
+             "e2=Stream1[e1.symbol==e2.symbol]<4:6> "
+             "select e1.volume as volume1, e2[0].volume as volume2, "
+             "e2[1].volume as volume3, e2[2].volume as volume4, "
+             "e2[3].volume as volume5, e2[4].volume as volume6, "
+             "e2[5].volume as volume7 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["IBM", 75.6, 100]),
+            ("Stream1", ["IBM", 75.6, 200]),
+            ("Stream1", ["IBM", 75.6, 300]),
+            ("Stream1", ["GOOG", 21.0, 91]),
+            ("Stream1", ["IBM", 75.6, 400]),
+            ("Stream1", ["IBM", 75.6, 500]),
+            ("Stream1", ["GOOG", 21.0, 91]),
+            ("Stream1", ["IBM", 75.6, 600]),
+            ("Stream1", ["IBM", 75.6, 700]),
+            ("Stream1", ["IBM", 75.6, 800]),
+            ("Stream1", ["GOOG", 21.0, 91]),
+            ("Stream1", ["IBM", 75.6, 900]),
+        ]), [
+            [100, 200, 300, 400, 500, None, None],
+            [200, 300, 400, 500, 600, None, None],
+            [300, 400, 500, 600, 700, None, None],
+            [400, 500, 600, 700, 800, None, None],
+            [500, 600, 700, 800, 900, None, None],
+        ])
+
+    def test_instanceof_having_on_count_refs(self):
+        # CountPatternTestCase.testQuery14
+        q = ("@info(name='q') from e1=Stream1[price>20] <0:5> -> "
+             "e2=Stream2[price>e1[0].price] "
+             "select e1[0].price as price1_0, e1[1].price as price1_1, "
+             "e1[2].price as price1_2, e2.price as price2 "
+             "having instanceOfFloat(e1[1].price) and "
+             "not instanceOfFloat(e1[2].price) and "
+             "instanceOfFloat(price1_1) and not instanceOfFloat(price1_2) "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["WSO2", 23.6, 100]),
+            ("Stream1", ["GOOG", 7.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+        ]), [[f32(25.6), f32(23.6), None, f32(45.7)]])
+
+    def test_exact_count_then_not_and(self):
+        # CountPatternTestCase.testQuery15: <2> then (not S1 and e3=S2)
+        q = ("@info(name='q') from every e1=Stream1[price>20] -> "
+             "e2=Stream1[price>20]<2> -> "
+             "not Stream1[price>20] and e3=Stream2 "
+             "select e1.price as price1_0, e2[0].price as price2_0, "
+             "e2[1].price as price2_1, e2[2].price as price2_2, "
+             "e3.price as price3_0 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.6, 100]),
+            ("Stream1", ["WSO2", 23.6, 100]),
+            ("Stream1", ["WSO2", 23.6, 100]),
+            ("Stream1", ["GOOG", 27.6, 100]),
+            ("Stream1", ["GOOG", 28.6, 100]),
+            ("Stream2", ["IBM", 45.7, 100]),
+        ]), [[f32(23.6), f32(27.6), f32(28.6), None, f32(45.7)]])
+
+
+class TestEveryPatterns2:
+    def test_reused_event_ref(self):
+        # EveryPatternTestCase.testQuery9: the same ref name on two
+        # states — the select resolves to the FIRST captured event
+        q = ("@info(name='q') from every e1=Stream1[symbol == 'MSFT'] -> "
+             "e1=Stream1[symbol == 'WSO2'] "
+             "select e1.price as price1 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["MSFT", 55.6, 100]),
+            ("Stream1", ["MSFT", 77.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ]), [[f32(55.6)], [f32(77.6)]])
+
+
+class TestLogicalPatterns2:
+    OQ = ("@info(name='q') from e1=Stream1[price > 20] -> "
+          "e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol] "
+          "select e1.symbol as symbol1, e2.symbol as symbol2 "
+          "insert into OutputStream;")
+
+    def test_or_first_branch(self):
+        # LogicalPatternTestCase.testQuery1
+        both(S12 + self.OQ, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["GOOG", 59.6, 100]),
+        ]), [["WSO2", "GOOG"]])
+
+    def test_or_second_branch_null_side(self):
+        # LogicalPatternTestCase.testQuery2
+        both(S12 + self.OQ, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 10.7, 100]),
+        ]), [["WSO2", None]])
+
+    def test_or_both_sides_could_match_first_wins(self):
+        # LogicalPatternTestCase.testQuery3
+        q = ("@info(name='q') from e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 72.7, 100]),
+            ("Stream2", ["IBM", 75.7, 100]),
+        ]), [["WSO2", f32(72.7), None]])
+
+    def test_and_same_stream_two_events(self):
+        # LogicalPatternTestCase.testQuery5: one event per side
+        q = ("@info(name='q') from e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 72.7, 100]),
+            ("Stream2", ["IBM", 75.7, 100]),
+        ]), [["WSO2", f32(72.7), f32(72.7)]])
+
+    def test_and_cross_stream_sides(self):
+        # LogicalPatternTestCase.testQuery6
+        q = ("@info(name='q') from e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] and e3=Stream1['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 72.7, 100]),
+            ("Stream1", ["IBM", 75.7, 100]),
+        ]), [["WSO2", f32(72.7), f32(75.7)]])
+
+    def test_and_start_then_next(self):
+        # LogicalPatternTestCase.testQuery7
+        q = ("@info(name='q') from e1=Stream1[price > 20] and "
+             "e2=Stream2[price > 30] -> e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["GOOG", 72.7, 100]),
+            ("Stream2", ["IBM", 4.7, 100]),
+        ]), [["WSO2", f32(72.7), f32(4.7)]])
+
+    def test_or_start_then_next(self):
+        # LogicalPatternTestCase.testQuery8
+        q = ("@info(name='q') from e1=Stream1[price > 20] or "
+             "e2=Stream2[price > 30] -> e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["GOOG", 72.7, 100]),
+            ("Stream2", ["IBM", 4.7, 100]),
+        ]), [["WSO2", None, f32(4.7)]])
+
+    def test_or_start_second_side(self):
+        # LogicalPatternTestCase.testQuery9
+        q = ("@info(name='q') from e1=Stream1[price > 20] or "
+             "e2=Stream2[price > 30] -> e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream2", ["GOOG", 72.7, 100]),
+            ("Stream2", ["IBM", 4.7, 100]),
+        ]), [[None, f32(72.7), f32(4.7)]])
+
+    def test_or_start_one_event_each(self):
+        # LogicalPatternTestCase.testQuery10
+        q = ("@info(name='q') from e1=Stream1[price > 20] or "
+             "e2=Stream2[price > 30] -> e3=Stream2['IBM' == symbol] "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 4.7, 100]),
+        ]), [["WSO2", None, f32(4.7)]])
+
+    def test_every_then_and_fanout(self):
+        # LogicalPatternTestCase.testQuery11: two every-arms share the
+        # later and-completion
+        q = ("@info(name='q') from every e1=Stream1[price > 20] -> "
+             "e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S123 + q, ts_seq([
+            ("Stream1", ["IBM", 25.5, 100]),
+            ("Stream1", ["IBM", 59.65, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+            ("Stream3", ["WSO2", 46.56, 100]),
+        ]), [
+            [f32(25.5), f32(45.5), f32(46.56)],
+            [f32(59.65), f32(45.5), f32(46.56)],
+        ])
+
+    def test_every_then_or_fanout(self):
+        # LogicalPatternTestCase.testQuery12
+        q = ("@info(name='q') from every e1=Stream1[price > 20] -> "
+             "e2=Stream2['IBM' == symbol] or e3=Stream3['WSO2' == symbol] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S123 + q, ts_seq([
+            ("Stream1", ["IBM", 25.5, 100]),
+            ("Stream1", ["IBM", 59.65, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+        ]), [
+            [f32(25.5), f32(45.5), None],
+            [f32(59.65), f32(45.5), None],
+        ])
+
+    def test_whole_query_and(self):
+        # LogicalPatternTestCase.testQuery13 (non-every: one match)
+        q = ("@info(name='q') from e1=Stream1[price > 20] and "
+             "e2=Stream2[price > 30] "
+             "select e1.symbol as symbol1, e2.price as price2 "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream2", ["IBM", 35.0, 100]),
+            ("Stream1", ["GOOGLE", 45.0, 100]),
+            ("Stream2", ["ORACLE", 55.0, 100]),
+        ]), [["WSO2", f32(35.0)]])
+
+    def test_whole_query_or(self):
+        # LogicalPatternTestCase.testQuery14
+        q = ("@info(name='q') from e1=Stream1[price > 20] or "
+             "e2=Stream2[price > 30] "
+             "select e1.symbol as symbol1, e2.price as price2 "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream2", ["IBM", 35.0, 100]),
+            ("Stream2", ["ORACLE", 45.0, 100]),
+        ]), [["WSO2", None]])
+
+    def test_every_and(self):
+        # LogicalPatternTestCase.testQuery15
+        q = ("@info(name='q') from every (e1=Stream1[price > 20] and "
+             "e2=Stream2[price > 30]) "
+             "select e1.symbol as symbol1, e2.price as price2 "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream2", ["IBM", 35.0, 100]),
+            ("Stream1", ["GOOGLE", 45.0, 100]),
+            ("Stream2", ["ORACLE", 55.0, 100]),
+        ]), [["WSO2", f32(35.0)], ["GOOGLE", f32(55.0)]])
+
+    def test_every_or(self):
+        # LogicalPatternTestCase.testQuery16: each event completes alone
+        q = ("@info(name='q') from every (e1=Stream1[price > 20] or "
+             "e2=Stream2[price > 30]) "
+             "select e1.symbol as symbol1, e2.price as price2 "
+             "insert into OutputStream;")
+        both(S12 + q, ts_seq([
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream2", ["IBM", 35.0, 100]),
+            ("Stream2", ["ORACLE", 45.0, 100]),
+        ]), [["WSO2", None], [None, f32(35.0)], [None, f32(45.0)]])
+
+    def test_or_within_expired(self):
+        # LogicalPatternTestCase.testQuery17: 1.1s gap kills the chain
+        q = ("@info(name='q') from e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol] "
+             "within 1 sec "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["GOOG", 59.6, 100], 2100),
+        ], [])
+
+    def test_and_within_expired_half_match(self):
+        # LogicalPatternTestCase.testQuery18: one side matched, window
+        # passes before the other side completes
+        q = ("@info(name='q') from e1=Stream1[price > 20] -> "
+             "e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol] "
+             "within 1 sec "
+             "select e1.symbol as symbol1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["GOOG", 72.7, 100], 1100),
+            ("Stream2", ["IBM", 4.7, 100], 2200),
+        ], [])
+
+    def test_every_and_group_then_next(self):
+        # LogicalPatternTestCase.testQuery19
+        q = ("@info(name='q') from every (e1=Stream1[price>10] and "
+             "e2=Stream2[price>20]) -> e3=Stream3[price>30] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(S123 + q, ts_seq([
+            ("Stream1", ["ORACLE", 15.0, 100]),
+            ("Stream2", ["MICROSOFT", 45.0, 100]),
+            ("Stream1", ["IBM", 55.0, 100]),
+            ("Stream2", ["WSO2", 65.0, 100]),
+            ("Stream3", ["GOOGLE", 75.0, 100]),
+        ]), [
+            ["ORACLE", "MICROSOFT", "GOOGLE"],
+            ["IBM", "WSO2", "GOOGLE"],
+        ])
+
+
+class TestWithinPatterns:
+    def test_within_survivor_matches(self):
+        # WithinPatternTestCase.testQuery1: first arm expires, the
+        # re-armed one (GOOG) survives the 1s window
+        q = ("@info(name='q') from every e1=Stream1[price>20] -> "
+             "e2=Stream2[price>e1.price] within 1 sec "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 54.0, 100], 2500),
+            ("Stream2", ["IBM", 55.7, 100], 3000),
+        ], [["GOOG", "IBM"]])
+
+    def test_within_parenthesized_whole(self):
+        # WithinPatternTestCase.testQuery2
+        q = ("@info(name='q') from (every e1=Stream1[price>20] -> "
+             "e2=Stream2[price>e1.price]) within 1 sec "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 54.0, 100], 2500),
+            ("Stream2", ["IBM", 55.7, 100], 3000),
+        ], [["GOOG", "IBM"]])
+
+    def test_within_every_group_pairs(self):
+        # WithinPatternTestCase.testQuery3: only the second (unexpired)
+        # pair completes inside 2s
+        q = ("@info(name='q') from (every (e1=Stream1[price>20] -> "
+             "e3=Stream1[price>20]) -> e2=Stream2[price>e1.price]) "
+             "within 2 sec "
+             "select e1.price as price1, e3.price as price3, "
+             "e2.price as price2 insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 54.0, 100], 1600),
+            ("Stream1", ["WSO2", 53.6, 100], 2200),
+            ("Stream1", ["GOOG", 53.0, 100], 3100),
+            ("Stream2", ["IBM", 57.7, 100], 3700),
+        ], [[f32(53.6), f32(53.0), f32(57.7)]])
+
+    def test_within_rearm_after_expiry(self):
+        # WithinPatternTestCase.testQuery4: 6s gap expires the first
+        # arm; the next pair inside 5s matches once
+        q = ("@info(name='q') from every (e1=Stream1 -> "
+             "e2=Stream1[symbol == e1.symbol]) within 5 sec "
+             "select e1.symbol as symbol1, e1.volume as volume1, "
+             "e2.symbol as symbol2, e2.volume as volume2 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["WSO2", 55.7, 150], 7000),
+            ("Stream1", ["WSO2", 58.7, 200], 7500),
+            ("Stream1", ["WSO2", 58.7, 250], 7510),
+        ], [["WSO2", 150, "WSO2", 200]])
+
+    def test_within_three_chain_expiry(self):
+        # WithinPatternTestCase.testQuery5
+        q = ("@info(name='q') from every (e1=Stream1 -> "
+             "e2=Stream1[symbol == e1.symbol] -> "
+             "e3=Stream1[symbol == e2.symbol]) within 5 sec "
+             "select e1.symbol as symbol1, e1.volume as volume1, "
+             "e2.symbol as symbol2, e2.volume as volume2, "
+             "e3.symbol as symbol3, e3.volume as volume3 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["WSO2", 56.6, 150], 1100),
+            ("Stream1", ["WSO2", 57.7, 200], 7100),
+            ("Stream1", ["WSO2", 58.7, 250], 7600),
+            ("Stream1", ["WSO2", 57.7, 300], 7610),
+            ("Stream1", ["WSO2", 59.7, 350], 7620),
+        ], [["WSO2", 200, "WSO2", 250, "WSO2", 300]])
+
+    def test_within_three_chain_two_matches(self):
+        # WithinPatternTestCase.testQuery6: everything inside the window
+        q = ("@info(name='q') from every (e1=Stream1 -> "
+             "e2=Stream1[symbol == e1.symbol] -> "
+             "e3=Stream1[symbol == e2.symbol]) within 5 sec "
+             "select e1.symbol as symbol1, e1.volume as volume1, "
+             "e2.symbol as symbol2, e2.volume as volume2, "
+             "e3.symbol as symbol3, e3.volume as volume3 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["WSO2", 55.7, 150], 1010),
+            ("Stream1", ["WSO2", 58.7, 200], 1020),
+            ("Stream1", ["WSO2", 58.7, 210], 1030),
+            ("Stream1", ["WSO2", 58.7, 250], 1540),
+            ("Stream1", ["WSO2", 58.7, 260], 1550),
+            ("Stream1", ["WSO2", 58.7, 270], 1560),
+        ], [
+            ["WSO2", 100, "WSO2", 150, "WSO2", 200],
+            ["WSO2", 210, "WSO2", 250, "WSO2", 260],
+        ])
+
+    def test_within_first_pair_expired(self):
+        # WithinPatternTestCase.testQuery7
+        q = ("@info(name='q') from every (e1=Stream1 -> "
+             "e2=Stream1[symbol == e1.symbol] -> "
+             "e3=Stream1[symbol == e2.symbol]) within 5 sec "
+             "select e1.symbol as symbol1, e1.volume as volume1, "
+             "e2.symbol as symbol2, e2.volume as volume2, "
+             "e3.symbol as symbol3, e3.volume as volume3 "
+             "insert into OutputStream;")
+        both(S12 + q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["WSO2", 56.6, 150], 7000),
+            ("Stream1", ["WSO2", 57.7, 200], 7010),
+            ("Stream1", ["WSO2", 58.7, 250], 7520),
+            ("Stream1", ["WSO2", 57.7, 300], 7530),
+            ("Stream1", ["WSO2", 59.7, 350], 7540),
+        ], [["WSO2", 150, "WSO2", 200, "WSO2", 250]])
