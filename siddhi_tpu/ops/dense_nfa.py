@@ -1043,10 +1043,12 @@ class DensePatternEngine:
                         first = first.at[:, s, :].set(
                             jnp.where(fire & (first[:, s, :] == 0), ts[:, None],
                                       first[:, s, :]))
-                    # sequences keep the start node armed (host semantics:
-                    # "the start node is kept armed"); reset_on_emit still
-                    # stops non-every sequences after their first match
-                    keep_armed = s == 0 and (every_start or is_sequence)
+                    # only `every` keeps the start armed; a non-every
+                    # sequence arms once and dies with its arm (reference:
+                    # init() re-arms only for every —
+                    # SequenceTestCase.testQuery31, mirrored in the host
+                    # engine's _process_event re-arm gate)
+                    keep_armed = s == 0 and every_start
                     if not keep_armed:
                         a = a.at[:, s, :].set(a[:, s, :] & ~fire)
                     carry = _advance(s, fire,

@@ -615,6 +615,7 @@ class PatternProcessor:
                 continue
             was_virgin = inst.is_virgin()
             used = False
+            captured = False
             if inst.pos < len(self.nodes):
                 node = self.nodes[inst.pos]
                 # 1) dual-pending advances (tested against pre-capture state)
@@ -626,22 +627,23 @@ class PatternProcessor:
                         advanced |= self._try_enter(
                             inst, self.nodes[sp], stream_key, row, ts, staged, via_clone=True
                         )
-                    if advanced and self.mode == "pattern":
-                        # PATTERN: the forwarded instance is SHARED with
-                        # the successor — once the successor captures, the
+                    if advanced:
+                        # the forwarded instance is SHARED with the
+                        # successor — once the successor captures, the
                         # count state drops its copy and the arm emits at
-                        # most once (reference CountPreStateProcessor.
-                        # removeIfNextStateProcessed / CountPostState-
-                        # Processor.processMinCountReached fires only at
-                        # ==min; ComplexPatternTestCase.testQuery3's three
-                        # non-repeating matches pin this).  SEQUENCE
-                        # re-forwards per capture (the reference's
-                        # stateType==SEQUENCE branch) — keep dual alive.
+                        # most once, in BOTH modes, even when the event
+                        # could also have extended the count (reference
+                        # CountPreStateProcessor.removeIfNextState-
+                        # Processed runs before capture; pinned by
+                        # ComplexPatternTestCase.testQuery3's three
+                        # non-repeating matches and the peak corpus
+                        # SequenceTestCase.testQuery20/23 restarts)
                         inst.alive = False
                     used |= advanced
                 # 2) capture at current node
                 if inst.alive:
-                    used |= self._try_capture(inst, node, stream_key, row, ts)
+                    captured = self._try_capture(inst, node, stream_key, row, ts)
+                    used |= captured
                 # 3) absent violation
                 for s in node.specs:
                     if (
@@ -651,8 +653,13 @@ class PatternProcessor:
                     ):
                         inst.alive = False
                         used = True
-            # strict continuity for sequences
-            if self.mode == "sequence" and not used and not was_virgin and inst.alive:
+            # strict continuity for sequences: only a CAPTURE keeps an
+            # instance alive — an arm whose clone advanced via the
+            # dual-pending path but which could not use the event itself
+            # dies (reference: resetState clears all pendings each event;
+            # only addState'd instances survive — the peak-detection
+            # corpus SequenceTestCase.testQuery20 pins the restart)
+            if self.mode == "sequence" and not captured and not was_virgin and inst.alive:
                 inst.alive = False
 
         self.instances = [i for i in self.instances if i.alive]
@@ -668,7 +675,16 @@ class PatternProcessor:
                 else:
                     seen_pos.add(i.pos)
             self.instances = [i for i in self.instances if i.alive]
-        if self.mode == "sequence" and not (self.matched_once and not self.has_every):
+        if (
+            self.mode == "sequence"
+            and self.has_every
+            and not (self.matched_once and not self.has_every)
+        ):
+            # only `every` sequences re-arm the start per event; a
+            # non-every sequence arms once and dies with its arm
+            # (reference: init() re-arms only when
+            # nextEveryStatePreProcessor != null —
+            # SequenceTestCase.testQuery31 expects zero matches)
             if not any(i.alive and i.pos == 0 for i in self.instances):
                 self._arm_fresh(0, ts)
 

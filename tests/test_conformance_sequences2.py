@@ -1,0 +1,412 @@
+"""Sequence conformance, part 2: ported from the reference's
+SequenceTestCase.java (modules/siddhi-core/src/test/java/io/siddhi/core/
+query/sequence/SequenceTestCase.java) — the cases beyond the basics
+already pinned by tests/test_patterns.py: Kleene-star/plus capture
+edges, logical sequences, strict-continuity kills, and the peak/trough
+detection family using e2[last]/e2[last-1] back-references.  Expected
+rows are the reference's literal assertions.
+"""
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+
+S12 = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+)
+S123 = S12 + "define stream Stream3 (symbol string, price float, volume int); "
+
+
+def f32(x):
+    return np.float32(x).item()
+
+
+def run(app, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def both(app, sends, expected, out="OutputStream"):
+    host = run(app, sends, out)
+    assert host == expected, f"host {host} != expected {expected}"
+    tpu = run("@app:execution('tpu') " + app, sends, out)
+    assert tpu == host, f"tpu {tpu} != host {host}"
+    return host
+
+
+def seq(rows, base=1000, gap=100):
+    return [(s, r, base + i * gap) for i, (s, r) in enumerate(rows)]
+
+
+class TestKleeneSequences2:
+    def test_star_collects_with_smaller_second(self):
+        # SequenceTestCase.testQuery5
+        q = ("@info(name='q') from every e1=Stream2[price>20]*, "
+             "e2=Stream1[price>e1[0].price] "
+             "select e1[0].price as price1, e1[1].price as price2, "
+             "e2.price as price3 insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.0, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+        ]), [[f32(55.6), f32(55.0), f32(57.6)]])
+
+    def test_or_sequence_last_arm_wins(self):
+        # SequenceTestCase.testQuery9: the IBM branch completes the
+        # SECOND pending arm after the first completed via price
+        q = ("@info(name='q') from every e1=Stream2[price>20], "
+             "e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM'] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream2", ["WSO2", 59.6, 100]),
+            ("Stream2", ["WSO2", 55.6, 100]),
+            ("Stream2", ["WSO2", 57.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+        ]), [
+            [f32(55.6), f32(57.6), None],
+            [f32(57.6), None, f32(55.7)],
+        ])
+
+    def test_two_stream_every_sequence(self):
+        # SequenceTestCase.testQuery12: strict continuity across streams
+        st = ("define stream StockStream (symbol string, price float, "
+              "volume int); "
+              "define stream TwitterStream (symbol string, count int); ")
+        q = ("@info(name='q') from every e1=StockStream[price >= 50 and "
+             "volume > 100], e2=TwitterStream[count > 10] "
+             "select e1.price as price, e1.symbol as symbol, "
+             "e2.count as count insert into OutputStream;")
+        both(st + q, seq([
+            ("StockStream", ["IBM", 75.6, 105]),
+            ("StockStream", ["GOOG", 51.0, 101]),
+            ("StockStream", ["IBM", 76.6, 111]),
+            ("TwitterStream", ["IBM", 20]),
+            ("StockStream", ["WSO2", 45.6, 100]),
+            ("TwitterStream", ["GOOG", 20]),
+        ]), [[f32(76.6), "IBM", 20]])
+
+    def test_star_mid_chain(self):
+        # SequenceTestCase.testQuery13
+        st = ("define stream StockStream (symbol string, price float, "
+              "volume int); "
+              "define stream TwitterStream (symbol string, count int); ")
+        q = ("@info(name='q') from every e1=StockStream[price >= 50 and "
+             "volume > 100], e2=StockStream[price <= 40]*, "
+             "e3=StockStream[volume <= 70] "
+             "select e1.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        both(st + q, seq([
+            ("StockStream", ["IBM", 75.6, 105]),
+            ("StockStream", ["GOOG", 21.0, 81]),
+            ("StockStream", ["WSO2", 176.6, 65]),
+        ]), [["IBM", "GOOG", "WSO2"]])
+
+    def test_star_two_streams_multi_match(self):
+        # SequenceTestCase.testQuery14
+        st = ("define stream StockStream1 (symbol string, price float, "
+              "volume int); "
+              "define stream StockStream2 (symbol string, price float, "
+              "volume int); ")
+        q = ("@info(name='q') from every e1=StockStream1[price >= 50 and "
+             "volume > 100], e2=StockStream2[price <= 40]*, "
+             "e3=StockStream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as volume insert into OutputStream;")
+        both(st + q, seq([
+            ("StockStream1", ["IBM", 75.6, 105]),
+            ("StockStream2", ["GOOG", 21.0, 81]),
+            ("StockStream2", ["WSO2", 176.6, 65]),
+            ("StockStream1", ["BIRT", 21.0, 81]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DDD", 23.0, 181]),
+            ("StockStream2", ["BIRT", 21.0, 86]),
+            ("StockStream2", ["BIRT", 21.0, 82]),
+            ("StockStream2", ["WSO2", 176.6, 60]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DOX", 16.2, 25]),
+        ]), [
+            ["WSO2", "GOOG", 65],
+            ["WSO2", "DDD", 60],
+            ["DOX", None, 25],
+        ])
+
+    def test_star_cross_ref_filter(self):
+        # SequenceTestCase.testQuery15
+        st = ("define stream StockStream1 (symbol string, price float, "
+              "volume int); "
+              "define stream StockStream2 (symbol string, price float, "
+              "volume int); ")
+        q = ("@info(name='q') from every e1=StockStream1[price >= 50 and "
+             "volume > 100], e2=StockStream2[e1.symbol != 'AMBA']*, "
+             "e3=StockStream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as volume insert into OutputStream;")
+        both(st + q, seq([
+            ("StockStream1", ["IBM", 75.6, 105]),
+            ("StockStream2", ["GOOG", 21.0, 81]),
+            ("StockStream2", ["WSO2", 176.6, 65]),
+            ("StockStream1", ["BIRT", 21.0, 81]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DDD", 23.0, 181]),
+            ("StockStream2", ["BIRT", 21.0, 86]),
+            ("StockStream2", ["BIRT", 21.0, 82]),
+            ("StockStream2", ["WSO2", 176.6, 60]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DOX", 16.2, 25]),
+        ]), [
+            ["WSO2", "GOOG", 65],
+            ["DOX", None, 25],
+        ])
+
+    def test_star_unfiltered_start(self):
+        # SequenceTestCase.testQuery16
+        st = ("define stream StockStream1 (symbol string, price float, "
+              "volume int); "
+              "define stream StockStream2 (symbol string, price float, "
+              "volume int); ")
+        q = ("@info(name='q') from every e1=StockStream1, "
+             "e2=StockStream2[e1.symbol != 'AMBA']*, "
+             "e3=StockStream2[volume <= 70] "
+             "select e3.symbol as symbol1, e2[0].symbol as symbol2, "
+             "e3.volume as volume insert into OutputStream;")
+        both(st + q, seq([
+            ("StockStream1", ["IBM", 75.6, 105]),
+            ("StockStream2", ["GOOG", 21.0, 81]),
+            ("StockStream2", ["WSO2", 176.6, 65]),
+            ("StockStream1", ["BIRT", 21.0, 81]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DDD", 23.0, 181]),
+            ("StockStream2", ["BIRT", 21.0, 86]),
+            ("StockStream2", ["BIRT", 21.0, 82]),
+            ("StockStream2", ["WSO2", 176.6, 60]),
+            ("StockStream1", ["AMBA", 126.6, 165]),
+            ("StockStream2", ["DOX", 16.2, 25]),
+        ]), [
+            ["WSO2", "GOOG", 65],
+            ["DOX", None, 25],
+        ])
+
+
+PEAK_Q = ("@info(name='q') from every e1=Stream1[price>20], "
+          "e2=Stream1[((e2[last].price is null) and price>=e1.price) or "
+          "((not (e2[last].price is null)) and price>=e2[last].price)]+, "
+          "e3=Stream1[price<e2[last].price] "
+          "select e1.price as price1, e2[0].price as price2, "
+          "e2[1].price as price3, e3.price as price4 "
+          "insert into OutputStream;")
+
+
+class TestPeakDetection2:
+    def test_peak_restarts_on_dip(self):
+        # SequenceTestCase.testQuery18
+        both(S12 + PEAK_Q, seq([
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 35.6, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["IBM", 47.6, 100]),
+        ]), [[f32(25.0), f32(35.6), f32(57.6), f32(47.6)]])
+
+    def test_peak_single_rise(self):
+        # SequenceTestCase.testQuery19
+        both(S12 + PEAK_Q, seq([
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 40.0, 100]),
+            ("Stream1", ["WSO2", 35.0, 100]),
+        ]), [[f32(25.0), f32(40.0), None, f32(35.0)]])
+
+    def test_peak_three_matches(self):
+        # SequenceTestCase.testQuery20
+        both(S12 + PEAK_Q, seq([
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 35.6, 100]),
+            ("Stream1", ["WSO2", 25.5, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["WSO2", 58.6, 100]),
+            ("Stream1", ["IBM", 47.6, 100]),
+            ("Stream1", ["IBM", 27.6, 100]),
+            ("Stream1", ["IBM", 49.6, 100]),
+            ("Stream1", ["IBM", 45.6, 100]),
+        ]), [
+            [f32(25.0), f32(35.6), None, f32(25.5)],
+            [f32(25.5), f32(57.6), f32(58.6), f32(47.6)],
+            [f32(27.6), f32(49.6), None, f32(45.6)],
+        ])
+
+    def test_peak_ifthenelse_form(self):
+        # SequenceTestCase.testQuery20_2: same peaks via ifThenElse
+        q = ("@info(name='q') from every e1=Stream1, "
+             "e2=Stream1[ifThenElse(e2[last].price is null, "
+             "e1.price <= price, e2[last].price <= price)]+, "
+             "e3=Stream1[e2[last].price > price] "
+             "select e1.price as initialPrice, e2[last].price as peekPrice, "
+             "e3.price as firstDropPrice insert into OutputStream;")
+        got = run(S12 + q, seq([
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 15.6, 100]),
+            ("Stream1", ["WSO2", 25.5, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["WSO2", 58.6, 100]),
+            ("Stream1", ["IBM", 47.6, 100]),
+            ("Stream1", ["IBM", 27.6, 100]),
+            ("Stream1", ["IBM", 49.6, 100]),
+            ("Stream1", ["IBM", 45.6, 100]),
+            ("Stream1", ["IBM", 37.7, 100]),
+            ("Stream1", ["IBM", 33.7, 100]),
+            ("Stream1", ["IBM", 27.7, 100]),
+            ("Stream1", ["IBM", 49.7, 100]),
+            ("Stream1", ["IBM", 45.7, 100]),
+        ]))
+        assert len(got) == 3  # reference asserts the count
+
+    def test_peak_last_minus_n_refs(self):
+        # SequenceTestCase.testQuery23: e2[last-1]/e2[last-2] select refs
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "e2=Stream1[price>=e2[last].price or price>=e1.price]+, "
+             "e3=Stream1[price<e2[last].price] "
+             "select e1.price as price1, e2[0].price as price2, "
+             "e2[last-2].price as price3, e2[last-1].price as price4, "
+             "e2[last].price as price5, e3.price as price6 "
+             "insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 35.6, 100]),
+            ("Stream1", ["WSO2", 29.5, 100]),
+            ("Stream1", ["WSO2", 57.6, 100]),
+            ("Stream1", ["WSO2", 58.6, 100]),
+            ("Stream1", ["IBM", 57.7, 100]),
+            ("Stream1", ["IBM", 45.6, 100]),
+        ]), [
+            [f32(25.0), f32(35.6), None, None, f32(35.6), f32(29.5)],
+            [f32(29.5), f32(57.6), None, f32(57.6), f32(58.6), f32(57.7)],
+        ])
+
+    def test_peak_last_minus_n_filters(self):
+        # SequenceTestCase.testQuery24: e2[last-1] back-ref in FILTER
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "e2=Stream1[(price>=e2[last].price and "
+             "(not (e2[last-1].price is null)) and "
+             "price>=e2[last-1].price+5) or "
+             "((e2[last-1].price is null) and price>=e1.price+5)]+, "
+             "e3=Stream1[price<e2[last].price] "
+             "select e1.price as price1, e2[0].price as price2, "
+             "e2[last-2].price as price3, e2[last-1].price as price4, "
+             "e2[last].price as price5, e3.price as price6 "
+             "insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 29.6, 100]),
+            ("Stream1", ["WSO2", 25.0, 100]),
+            ("Stream1", ["WSO2", 35.6, 100]),
+            ("Stream1", ["WSO2", 41.5, 100]),
+            ("Stream1", ["WSO2", 42.6, 100]),
+            ("Stream1", ["WSO2", 43.6, 100]),
+            ("Stream1", ["IBM", 57.7, 100]),
+            ("Stream1", ["IBM", 58.7, 100]),
+            ("Stream1", ["IBM", 45.6, 100]),
+        ]), [
+            [f32(43.6), f32(57.7), None, f32(57.7), f32(58.7), f32(45.6)],
+        ])
+
+
+class TestLogicalSequences:
+    AQ = ("@info(name='q') from e1=Stream1[price >20], "
+          "e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol] "
+          "select e1.price as price1, e2.price as price2, "
+          "e3.price as price3 insert into OutputStream;")
+
+    def test_and_sequence(self):
+        # SequenceTestCase.testQuery25/26
+        both(S123 + self.AQ, seq([
+            ("Stream1", ["IBM", 25.5, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+            ("Stream3", ["WSO2", 46.56, 100]),
+        ]), [[f32(25.5), f32(45.5), f32(46.56)]])
+
+    def test_or_sequence_immediate(self):
+        # SequenceTestCase.testQuery27
+        q = ("@info(name='q') from e1=Stream1[price >20], "
+             "e2=Stream2['IBM' == symbol] or e3=Stream3['WSO2' == symbol] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S123 + q, seq([
+            ("Stream1", ["IBM", 59.65, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+        ]), [[f32(59.65), f32(45.5), None]])
+
+    def test_and_sequence_single_match(self):
+        # SequenceTestCase.testQuery28: non-every — one match only
+        both(S123 + self.AQ, seq([
+            ("Stream1", ["IBM", 59.65, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+            ("Stream3", ["WSO2", 46.56, 100]),
+        ]), [[f32(59.65), f32(45.5), f32(46.56)]])
+
+    def test_and_start_sequence(self):
+        # SequenceTestCase.testQuery32: logical node FIRST in sequence
+        q = ("@info(name='q') from e1=Stream1[price >20] and "
+             "e2=Stream2['IBM' == symbol], e3=Stream3['WSO2' == symbol] "
+             "select e1.price as price1, e2.price as price2, "
+             "e3.price as price3 insert into OutputStream;")
+        both(S123 + q, seq([
+            ("Stream1", ["IBM", 25.5, 100]),
+            ("Stream2", ["IBM", 45.5, 100]),
+            ("Stream3", ["WSO2", 46.56, 100]),
+        ]), [[f32(25.5), f32(45.5), f32(46.56)]])
+
+
+class TestStrictContinuity2:
+    def test_non_every_interrupted_never_matches(self):
+        # SequenceTestCase.testQuery31: GOOG breaks continuity; without
+        # `every` the engine never recovers for the later pair
+        q = ("@info(name='q') from e1=Stream1[price>20], "
+             "e2=Stream2[price>e1.price] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream1", ["GOOG", 57.6, 100]),
+            ("Stream2", ["IBM", 65.7, 100]),
+        ]), [])
+
+    def test_non_every_single_match_then_stop(self):
+        # SequenceTestCase.testQuery29
+        q = ("@info(name='q') from e1=Stream1[price>20], "
+             "e2=Stream2[price>e1.price] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+            ("Stream1", ["ORACLE", 55.6, 100]),
+            ("Stream2", ["GOOGLE", 55.7, 100]),
+        ]), [["WSO2", "IBM"]])
+
+    def test_every_interrupted_then_recovers(self):
+        # SequenceTestCase.testQuery30
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "e2=Stream2[price>e1.price] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2 "
+             "insert into OutputStream;")
+        both(S12 + q, seq([
+            ("Stream1", ["WSO2", 55.6, 100]),
+            ("Stream2", ["IBM", 55.7, 100]),
+            ("Stream1", ["ORACLE", 55.6, 100]),
+            ("Stream1", ["MICROSOFT", 55.8, 100]),
+            ("Stream2", ["GOOGLE", 55.9, 100]),
+        ]), [["WSO2", "IBM"], ["MICROSOFT", "GOOGLE"]])

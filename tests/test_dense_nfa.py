@@ -241,10 +241,10 @@ class TestDenseSequence:
 
 
 class TestDenseNonEverySequence:
-    def test_non_every_restarts_after_interruption(self):
-        # host semantics: the start node stays armed; 11 advances, 5 kills
-        # the pending instance, 20,21,22 then completes (and non-every
-        # stops after the first match)
+    def test_non_every_dies_after_interruption(self):
+        # reference semantics (SequenceTestCase.testQuery31): a non-every
+        # sequence arms ONCE; 11 advances, 5 kills the pending instance,
+        # and nothing re-arms — 20,21,22 must NOT match
         app = (
             "define stream Ticks (key long, price double); "
             "@info(name='ne') "
@@ -272,8 +272,7 @@ class TestDenseNonEverySequence:
             h.send([k, p], timestamp=t)
         rt.shutdown()
         m.shutdown()
-        assert len(emit) == len(host) == 1
-        assert out[0].tolist() == pytest.approx(host[0].data)  # 20 .. 22
+        assert len(emit) == len(host) == 0
 
 
 class TestReAnchor:
