@@ -267,11 +267,11 @@ class SiddhiAppRuntime:
 
     # -- on-demand (pull) queries -------------------------------------------
 
-    def table_resolver(self, table_name: str):
+    def table_resolver(self, table_name: str, obj: bool = False):
         table = self.tables.get(table_name)
         if table is None:
             raise SiddhiAppRuntimeError(f"'IN {table_name}': table is not defined")
-        return table.contains_fn()
+        return table if obj else table.contains_fn()
 
     def query(self, on_demand_query: str):
         """Execute a pull query against a table / named window / aggregation

@@ -155,8 +155,8 @@ class _InstancePlanner:
     def named_windows(self):
         return self._app.named_windows
 
-    def table_resolver(self, table_name: str):
-        return self._app.table_resolver(table_name)
+    def table_resolver(self, table_name: str, obj: bool = False):
+        return self._app.table_resolver(table_name, obj=obj)
 
     # -- junction namespace -------------------------------------------------
 

@@ -307,12 +307,14 @@ class AppPlanner:
             raise DefinitionNotExistError(f"stream '{key}' is not defined")
         return self.junctions[key]
 
-    def table_resolver(self, table_name: str):
-        """Membership-test provider for `expr IN Table` conditions."""
+    def table_resolver(self, table_name: str, obj: bool = False):
+        """Membership-test provider for `expr IN Table` conditions
+        (``obj=True`` hands back the table itself for condition-form
+        membership — see ExpressionCompiler._c_InOp)."""
         table = self.tables.get(table_name)
         if table is None:
             raise SiddhiAppCreationError(f"'IN {table_name}': table is not defined")
-        return table.contains_fn()
+        return table if obj else table.contains_fn()
 
     # -- build --------------------------------------------------------------
 

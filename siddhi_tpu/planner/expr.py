@@ -122,6 +122,23 @@ class Scope:
         return hit
 
 
+def _refs_stream(expr, sid: str) -> bool:
+    """True when the expression tree references a Variable qualified by
+    ``sid`` (used to pick condition-membership for `... in Table`)."""
+    if isinstance(expr, Variable):
+        return expr.stream_id == sid
+    if expr is None or isinstance(expr, (str, int, float, bool)):
+        return False
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, (list, tuple)):
+            if any(_refs_stream(x, sid) for x in v):
+                return True
+        elif _refs_stream(v, sid):
+            return True
+    return False
+
+
 _CMP = {
     "<": lambda a, b: a < b,
     "<=": lambda a, b: a <= b,
@@ -311,6 +328,46 @@ class ExpressionCompiler:
     def _c_InOp(self, e: InOp) -> CompiledExpression:
         if self.table_resolver is None:
             raise SiddhiAppCreationError(f"'IN {e.source_id}': no table resolver in this context")
+        # general form: `(cond) in Table` where cond references Table.attr
+        # columns — membership holds when SOME table row satisfies the
+        # condition against the event (reference: the on-condition
+        # compiled against the store, e.g.
+        # UpdateFromTableTestCase.updateFromTableTest3's
+        # `(symbol==StockTable.symbol and volume==StockTable.volume) in
+        # StockTable`).  The legacy value-membership (`attr in Table`,
+        # primary-key probe) stays for non-table-referencing scalars.
+        if _refs_stream(e.expr, e.source_id):
+            table = None
+            try:
+                table = self.table_resolver(e.source_id, obj=True)
+            except TypeError:
+                pass  # resolver without an object channel
+            if table is not None:
+                from siddhi_tpu.table.table import CompiledTableCondition
+
+                cond = CompiledTableCondition(
+                    table, e.expr, self.scope,
+                    extra_functions=self.functions,
+                    table_resolver=self.table_resolver)
+
+                def member_cond(env):
+                    n = env.get(N_KEY, 1)
+                    if not isinstance(n, (int, np.integer)):
+                        n = 1
+                    out = np.zeros(max(int(n), 1), dtype=bool)
+                    for i in range(len(out)):
+                        ev = {}
+                        for k, v in env.items():
+                            if (isinstance(v, np.ndarray) and v.ndim >= 1
+                                    and k != N_KEY):
+                                ev[k] = v[i] if i < len(v) else v[-1]
+                            else:
+                                ev[k] = v
+                        ev[N_KEY] = 1
+                        out[i] = len(cond.slots_matching(ev)) > 0
+                    return out if len(out) > 1 else out[0]
+
+                return CompiledExpression(member_cond, AttrType.BOOL)
         member_fn = self.table_resolver(e.source_id)
         c = self.compile(e.expr)
         return CompiledExpression(lambda env: member_fn(c.fn(env)), AttrType.BOOL)

@@ -175,10 +175,20 @@ class UpdateOrInsertTableCallback(UpdateTableCallback):
 
     def __init__(self, table, condition, set_ops, event_type, output_names=None):
         super().__init__(table, condition, set_ops, event_type)
-        _require_covering_schema(table, output_names, "update or insert into")
+        # a PARTIAL projection is allowed (reference
+        # UpdateOrInsertTableTestCase.updateOrInsertTableTest5: `select
+        # comp as symbol, vol as volume update or insert ...`): matched
+        # rows update only the projected columns; the insert path fills
+        # unprojected columns with null
+        self._projected = (
+            None if output_names is None
+            else [nm for nm in table.definition.attribute_names
+                  if nm in output_names])
 
     def send(self, batch: EventBatch, now: int):
         out = _select_types(batch, self.event_type)
+        names = (self._projected if self._projected is not None
+                 else self.table.definition.attribute_names)
         for i in range(len(out)):
             env = _event_env(out, i)
             slots = self.condition.slots_matching(env)
@@ -186,7 +196,8 @@ class UpdateOrInsertTableCallback(UpdateTableCallback):
                 self._apply(slots, env)
             else:
                 row = {
-                    nm: out.columns[nm][i] for nm in self.table.definition.attribute_names
+                    nm: (out.columns[nm][i] if nm in names else None)
+                    for nm in self.table.definition.attribute_names
                 }
                 with self.table._lock:
                     self.table._insert_row(row, int(out.timestamps[i]))

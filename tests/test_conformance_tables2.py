@@ -1,0 +1,479 @@
+"""Table-mutation conformance, part 2: update / update-or-insert /
+delete / set-clause matrices ported from the reference corpus
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/table/
+UpdateFromTableTestCase.java, UpdateOrInsertTableTestCase.java,
+DeleteFromTableTestCase.java, set/SetUpdateInMemoryTableTestCase.java).
+Final table contents are asserted with on-demand pull queries (the
+`in`-membership check streams mirror the reference's OutStream
+assertions where the scenario relies on them).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+F = lambda x: np.float32(x).item()  # table floats are exact float32
+
+DEFS = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream UpdateStockStream (symbol string, price float, volume long); "
+    "define stream DeleteStockStream (symbol string, price float, volume long); "
+    "define table StockTable (symbol string, price float, volume long); "
+)
+INSERT = "@info(name='q1') from StockStream insert into StockTable; "
+
+STOCKS = [["WSO2", 55.6, 100], ["IBM", 75.6, 100], ["WSO2", 57.6, 100]]
+
+
+def run_app(app, sends):
+    """sends: (stream_id, row); returns the runtime factory result
+    (runtime kept open for on-demand queries until shutdown)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    rt.start()
+    for stream, row in sends:
+        rt.get_input_handler(stream).send(row)
+    return m, rt
+
+
+def table_rows(rt, select="symbol, price, volume"):
+    events = rt.query(f"from StockTable select {select};")
+    return sorted(tuple(e.data) for e in events)
+
+
+class TestUpdateFromTable:
+    def test_update_on_constant_no_match_keeps_table(self):
+        # UpdateFromTableTestCase.updateFromTableTest1: GOOG update row
+        # matches on symbol=='IBM' -> IBM row takes GOOG's values
+        app = DEFS + INSERT + (
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "on StockTable.symbol=='IBM';")
+        m, rt = run_app(app, [("StockStream", s) for s in STOCKS]
+                        + [("UpdateStockStream", ["GOOG", 10.6, 100])])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("GOOG", F(10.6), 100),
+                ("WSO2", F(57.6), 100)])
+        finally:
+            m.shutdown()
+
+    def test_update_on_stream_attr(self):
+        # updateFromTableTest2: both WSO2 rows replaced
+        app = DEFS + INSERT + (
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [("StockStream", s) for s in STOCKS]
+                        + [("UpdateStockStream", ["WSO2", 10.0, 100])])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(10.0), 100), ("IBM", F(75.6), 100),
+                ("WSO2", F(10.0), 100)])
+        finally:
+            m.shutdown()
+
+    def test_update_then_in_membership(self):
+        # updateFromTableTest3: `in` checks see pre- and post-update rows
+        app = DEFS + INSERT + (
+            "define stream CheckStockStream (symbol string, volume long); "
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "on StockTable.symbol==symbol; "
+            "@info(name='q3') from CheckStockStream["
+            "(symbol==StockTable.symbol and volume==StockTable.volume) "
+            "in StockTable] insert into OutStream;")
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutStream", lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        try:
+            rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+            rt.get_input_handler("StockStream").send(["IBM", 55.6, 100])
+            chk = rt.get_input_handler("CheckStockStream")
+            chk.send(["IBM", 100])
+            chk.send(["WSO2", 100])
+            rt.get_input_handler("UpdateStockStream").send(["IBM", 77.6, 200])
+            chk.send(["IBM", 100])   # volume now 200: no membership
+            chk.send(["WSO2", 100])
+            assert got == [["IBM", 100], ["WSO2", 100], ["WSO2", 100]]
+        finally:
+            m.shutdown()
+
+    def test_update_with_projected_subset(self):
+        # updateFromTableTest4/5: update query projects (symbol, volume)
+        # only — untouched columns keep their values
+        app = DEFS.replace(
+            "define stream UpdateStockStream (symbol string, price float, "
+            "volume long); ",
+            "define stream UpdateStockStream (comp string, vol long); "
+        ) + INSERT + (
+            "@info(name='q2') from UpdateStockStream "
+            "select comp as symbol, vol as volume "
+            "update StockTable on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 155.6, 100]),
+            ("UpdateStockStream", ["IBM", 200]),
+        ])
+        try:
+            # price survives the partial update
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("IBM", F(155.6), 200)])
+        finally:
+            m.shutdown()
+
+    def test_update_via_table_join_values(self):
+        # updateFromTableTest6: join supplies the update row
+        app = DEFS.replace(
+            "define stream UpdateStockStream (symbol string, price float, "
+            "volume long); ",
+            "define stream UpdateStockStream (comp string, vol long); "
+        ) + INSERT + (
+            "@info(name='q2') from UpdateStockStream join StockTable "
+            "on UpdateStockStream.comp == StockTable.symbol "
+            "select symbol, vol as volume "
+            "update StockTable on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 155.6, 100]),
+            ("UpdateStockStream", ["IBM", 200]),
+        ])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("IBM", F(155.6), 200)])
+        finally:
+            m.shutdown()
+
+
+class TestSetClauseUpdate:
+    def _final(self, q2, update_row=("IBM", 100.0, 100)):
+        app = DEFS + INSERT + q2
+        m, rt = run_app(app, [("StockStream", s) for s in STOCKS]
+                        + [("UpdateStockStream", list(update_row))])
+        try:
+            return table_rows(rt)
+        finally:
+            m.shutdown()
+
+    def test_set_all_columns(self):
+        # SetUpdateInMemoryTableTestCase.updateFromTableTest1
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "set StockTable.price = price, StockTable.symbol = symbol, "
+            "StockTable.volume = volume on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(100.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+    def test_set_subset_of_columns(self):
+        # updateFromTableTest2: volume untouched
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "set StockTable.price = price, StockTable.symbol = symbol "
+            "on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(100.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+    def test_set_constant(self):
+        # updateFromTableTest3
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "set StockTable.price = 10 on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(10.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+    def test_set_from_projected_arithmetic(self):
+        # updateFromTableTest4: select price+100 as newPrice -> set
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream "
+            "select price + 100 as newPrice, symbol "
+            "update StockTable set StockTable.price = newPrice "
+            "on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(200.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+    def test_set_expression_over_projection(self):
+        # updateFromTableTest5: set price = newPrice + 100
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream "
+            "select price + 100 as newPrice, symbol "
+            "update StockTable set StockTable.price = newPrice + 100 "
+            "on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(300.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+    def test_set_unqualified_lhs(self):
+        # updateFromTableTest6: bare `set price = 100`
+        rows = self._final(
+            "@info(name='q2') from UpdateStockStream update StockTable "
+            "set price = 100 on StockTable.symbol == symbol;")
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("IBM", F(100.0), 100),
+            ("WSO2", F(57.6), 100)])
+
+
+class TestUpdateOrInsert:
+    def test_no_match_inserts(self):
+        # UpdateOrInsertTableTestCase.updateOrInsertTableTest1: GOOG
+        # update on symbol=='IBM' REPLACES the IBM row (condition hit)
+        app = DEFS + INSERT + (
+            "@info(name='q2') from UpdateStockStream "
+            "update or insert into StockTable "
+            "on StockTable.symbol=='IBM';")
+        m, rt = run_app(app, [("StockStream", s) for s in STOCKS]
+                        + [("UpdateStockStream", ["GOOG", 10.6, 100])])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("GOOG", F(10.6), 100),
+                ("WSO2", F(57.6), 100)])
+        finally:
+            m.shutdown()
+
+    def test_upsert_as_only_writer(self):
+        # updateOrInsertTableTest2: stream upserts directly; the second
+        # WSO2 row updates BOTH earlier WSO2 rows
+        app = DEFS + (
+            "@info(name='q2') from StockStream "
+            "update or insert into StockTable "
+            "on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 75.6, 100]),
+            ("StockStream", ["WSO2", 57.6, 100]),
+            ("StockStream", ["WSO2", 10.0, 100]),
+        ])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(10.0), 100), ("IBM", F(75.6), 100)])
+        finally:
+            m.shutdown()
+
+    def test_upsert_inserts_fresh_key(self):
+        # updateOrInsertTableTest5: FB row not present -> inserted
+        app = DEFS.replace(
+            "define stream UpdateStockStream (symbol string, price float, "
+            "volume long); ",
+            "define stream UpdateStockStream (comp string, vol long); "
+        ) + INSERT + (
+            "@info(name='q2') from UpdateStockStream "
+            "select comp as symbol, vol as volume "
+            "update or insert into StockTable "
+            "on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 55.6, 100]),
+            ("UpdateStockStream", ["FB", 300]),
+        ])
+        try:
+            rows = table_rows(rt, select="symbol, volume")
+            assert rows == sorted([("WSO2", 100), ("IBM", 100),
+                                   ("FB", 300)])
+        finally:
+            m.shutdown()
+
+    def test_upsert_partial_projection_inserts_defaults(self):
+        # updateOrInsertTableTest7: projected 0f price lands on both the
+        # update and the membership check
+        app = DEFS.replace(
+            "define stream UpdateStockStream (symbol string, price float, "
+            "volume long); ",
+            "define stream UpdateStockStream (comp string, vol long); "
+        ) + INSERT + (
+            "@info(name='q2') from UpdateStockStream "
+            "select comp as symbol, 0f as price, vol as volume "
+            "update or insert into StockTable "
+            "on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 155.6, 100]),
+            ("UpdateStockStream", ["IBM", 200]),
+        ])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("IBM", F(0.0), 200)])
+        finally:
+            m.shutdown()
+
+
+class TestDeleteFromTable:
+    def _final(self, q2, deletes):
+        app = DEFS + INSERT + q2
+        m, rt = run_app(app, [("StockStream", s) for s in STOCKS]
+                        + [("DeleteStockStream", d) for d in deletes])
+        try:
+            return table_rows(rt)
+        finally:
+            m.shutdown()
+
+    def test_no_delete_event_keeps_rows(self):
+        # DeleteFromTableTestCase.deleteFromTableTest0
+        rows = self._final(
+            "@info(name='q2') from DeleteStockStream delete StockTable "
+            "on symbol=='IBM';", [])
+        assert len(rows) == 3
+
+    def test_delete_condition_on_event_only(self):
+        # bare attrs in an on-condition bind to the matching EVENT
+        # (shadowing same-named table columns — _merge_table_scope):
+        # an event-only condition deletes ALL rows when it holds and
+        # nothing otherwise (the reference's deleteFromTableTest1/3
+        # only smoke-test this shape; qualified forms are pinned below)
+        rows = self._final(
+            "@info(name='q2') from DeleteStockStream delete StockTable "
+            "on symbol=='IBM';", [["IBM", 57.6, 100]])
+        assert rows == []
+        rows = self._final(
+            "@info(name='q2') from DeleteStockStream delete StockTable "
+            "on symbol=='IBM';", [["WSO2", 57.6, 100]])
+        assert len(rows) == 3
+
+    def test_delete_on_qualified_constant(self):
+        # deleteFromTableTest2
+        rows = self._final(
+            "@info(name='q2') from DeleteStockStream delete StockTable "
+            "on StockTable.symbol=='IBM';", [["WSO2", 57.6, 100]])
+        assert rows == sorted([
+            ("WSO2", F(55.6), 100), ("WSO2", F(57.6), 100)])
+
+    def test_delete_with_stream_filter(self):
+        # deleteFromTableTest5: [vol>=100] gates the delete
+        app = (
+            "define stream StockStream (symbol string, price float, vol long); "
+            "define stream DeleteStockStream (symbol string, price float, vol long); "
+            "define table StockTable (symbol string, price float, volume long); "
+            "@info(name='q1') from StockStream "
+            "select symbol, price, vol as volume insert into StockTable; "
+            "@info(name='q2') from DeleteStockStream[vol>=100] "
+            "delete StockTable on StockTable.symbol==symbol;")
+        m, rt = run_app(app, [
+            ("StockStream", ["WSO2", 55.6, 100]),
+            ("StockStream", ["IBM", 75.6, 100]),
+            ("StockStream", ["WSO2", 57.6, 100]),
+            ("DeleteStockStream", ["IBM", 57.6, 100]),
+        ])
+        try:
+            assert table_rows(rt) == sorted([
+                ("WSO2", F(55.6), 100), ("WSO2", F(57.6), 100)])
+        finally:
+            m.shutdown()
+
+    def test_delete_then_membership(self):
+        # deleteFromTableTest4
+        app = DEFS + INSERT + (
+            "define stream CheckStockStream (symbol string); "
+            "@info(name='q2') from DeleteStockStream delete StockTable "
+            "on StockTable.symbol=='IBM'; "
+            "@info(name='q3') from CheckStockStream["
+            "symbol==StockTable.symbol in StockTable] "
+            "insert into OutStream;")
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutStream", lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        try:
+            rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+            rt.get_input_handler("StockStream").send(["IBM", 55.6, 100])
+            chk = rt.get_input_handler("CheckStockStream")
+            chk.send(["IBM"])
+            chk.send(["WSO2"])
+            rt.get_input_handler("DeleteStockStream").send(["IBM", 57.6, 100])
+            chk.send(["IBM"])
+            chk.send(["WSO2"])
+            assert got == [["IBM"], ["WSO2"], ["WSO2"]]
+        finally:
+            m.shutdown()
+
+
+class TestPrimaryKeyIndexMatrix:
+    """Probe-vs-scan correctness over primary-key and indexed columns
+    (the behavioral surface of PrimaryKeyTableTestCase /
+    IndexTableTestCase: every compiled-condition plan must return the
+    same rows a full scan would)."""
+
+    APP = (
+        "define stream Ins (symbol string, price float, volume long); "
+        "define stream Probe (symbol string, price float, volume long); "
+        "@primaryKey('symbol') @index('volume') "
+        "define table T (symbol string, price float, volume long); "
+        "from Ins insert into T; "
+    )
+
+    ROWS = [
+        ["A", 10.0, 100], ["B", 20.0, 200], ["C", 30.0, 200],
+        ["D", 40.0, 300], ["E", 50.0, 400],
+    ]
+
+    CONDS = [
+        # (on-condition, expected symbols)
+        ("T.symbol == 'C'", {"C"}),
+        ("T.symbol == 'Z'", set()),
+        ("T.volume == 200", {"B", "C"}),
+        ("T.volume != 200", {"A", "D", "E"}),
+        ("T.volume > 200", {"D", "E"}),
+        ("T.volume >= 200", {"B", "C", "D", "E"}),
+        ("T.volume < 200", {"A"}),
+        ("T.volume <= 200", {"A", "B", "C"}),
+        ("T.symbol == 'C' and T.volume == 200", {"C"}),
+        ("T.symbol == 'C' and T.volume == 300", set()),
+        ("T.symbol == 'B' or T.symbol == 'D'", {"B", "D"}),
+        ("T.volume == 200 and T.price > 25.0", {"C"}),
+        ("T.price > 25.0", {"C", "D", "E"}),  # non-indexed scan
+        ("not (T.volume == 200)", {"A", "D", "E"}),
+    ]
+
+    def test_condition_matrix_on_demand(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(self.APP)
+        rt.start()
+        try:
+            for r in self.ROWS:
+                rt.get_input_handler("Ins").send(r)
+            for cond, want in self.CONDS:
+                events = rt.query(f"from T on {cond} select symbol;")
+                got = {e.data[0] for e in events}
+                assert got == want, f"cond {cond}: {got} != {want}"
+        finally:
+            m.shutdown()
+
+    def test_pk_upsert_replaces_row(self):
+        app = self.APP + (
+            "define stream Up (symbol string, price float, volume long); "
+            "from Up update or insert into T on T.symbol == symbol; ")
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        try:
+            for r in self.ROWS:
+                rt.get_input_handler("Ins").send(r)
+            rt.get_input_handler("Up").send(["C", 99.0, 999])
+            events = rt.query("from T on T.symbol == 'C' "
+                              "select symbol, price, volume;")
+            assert [tuple(e.data) for e in events] == [("C", F(99.0), 999)]
+            # the index must track the moved volume
+            events = rt.query("from T on T.volume == 999 select symbol;")
+            assert [e.data[0] for e in events] == ["C"]
+            events = rt.query("from T on T.volume == 200 select symbol;")
+            assert {e.data[0] for e in events} == {"B"}
+        finally:
+            m.shutdown()
+
+    def test_index_tracks_deletes(self):
+        app = self.APP + (
+            "define stream Del (symbol string); "
+            "from Del delete T on T.symbol == symbol; ")
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        try:
+            for r in self.ROWS:
+                rt.get_input_handler("Ins").send(r)
+            rt.get_input_handler("Del").send(["B"])
+            events = rt.query("from T on T.volume == 200 select symbol;")
+            assert {e.data[0] for e in events} == {"C"}
+        finally:
+            m.shutdown()
