@@ -514,6 +514,70 @@ class TimeRateLimiter(OutputRateLimiter):
         self._window_end = state["end"]
 
 
+class GroupByTimeRateLimiter(OutputRateLimiter):
+    """`output <first|last> every <t>` on a GROUPED query: first/last
+    PER GROUP within each period (reference: ratelimit/time/
+    FirstGroupByPerTimeOutputRateLimiter.java,
+    LastGroupByPerTimeOutputRateLimiter.java)."""
+
+    def __init__(self, ms: int, mode: str):
+        self.ms = ms
+        self.mode = mode  # first | last
+        self._seen: set = set()      # first: groups emitted this period
+        self._last: Dict = {}        # last: group -> single-row batch
+        self._window_end: Optional[int] = None
+
+    def process(self, batch: EventBatch, now: int) -> Optional[EventBatch]:
+        if self._window_end is None:
+            self._window_end = now + self.ms
+        out = self.on_time(now)
+        res: List[EventBatch] = [out] if out is not None else []
+        keys = batch.aux.get("group_keys")
+        if keys is None or len(keys) != len(batch):
+            raise SiddhiAppRuntimeError(
+                "per-group rate limiter received a batch without the "
+                "group-key side channel")
+        if self.mode == "first":
+            rows = []
+            for i, k in enumerate(keys):
+                if k not in self._seen:
+                    self._seen.add(k)
+                    rows.append(i)
+            if rows:
+                res.append(batch.take(np.asarray(rows)))
+        else:
+            for i, k in enumerate(keys):
+                self._last[k] = i  # local index; materialized below
+            for k, v in list(self._last.items()):
+                if not isinstance(v, EventBatch):
+                    self._last[k] = batch.take(np.asarray([v]))
+        return EventBatch.concat(res) if res else None
+
+    def on_time(self, now: int) -> Optional[EventBatch]:
+        if self._window_end is None or now < self._window_end:
+            return None
+        outs: List[EventBatch] = []
+        while now >= self._window_end:
+            if self.mode == "last" and self._last:
+                outs.extend(self._last.values())
+                self._last = {}
+            self._seen.clear()
+            self._window_end += self.ms
+        return EventBatch.concat(outs) if outs else None
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._window_end
+
+    def snapshot(self):
+        return {"seen": set(self._seen), "last": dict(self._last),
+                "end": self._window_end}
+
+    def restore(self, state):
+        self._seen = set(state["seen"])
+        self._last = dict(state["last"])
+        self._window_end = state["end"]
+
+
 class SnapshotRateLimiter(OutputRateLimiter):
     """`output snapshot every <t>`: periodically re-emits the latest
     output per group key (reference: ratelimit/snapshot/

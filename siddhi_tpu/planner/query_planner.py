@@ -18,6 +18,7 @@ from siddhi_tpu.core.query import (
     AggBinding,
     EventRateLimiter,
     GroupByEventRateLimiter,
+    GroupByTimeRateLimiter,
     FilterProcessor,
     InsertIntoStreamCallback,
     PassThroughRateLimiter,
@@ -666,6 +667,8 @@ class QueryPlanner:
                 return GroupByEventRateLimiter(r.events, r.type)
             return EventRateLimiter(r.events, r.type)
         if isinstance(r, TimeOutputRate):
+            if r.type in ("first", "last") and query.selector.group_by:
+                return GroupByTimeRateLimiter(r.value_ms, r.type)
             return TimeRateLimiter(r.value_ms, r.type)
         if isinstance(r, SnapshotOutputRate):
             group_names = [g.attribute for g in query.selector.group_by]

@@ -142,3 +142,93 @@ class TestRateLimitWithGroupBy:
                         ["A", 1.0, 30], ["B", 1.0, 40]])
         got = run(q, sends)
         assert sorted(map(tuple, got)) == [("A", 10), ("B", 20)]
+
+
+class TestGroupedTimeRateLimits:
+    """output first/last every T with group by — per-group emission
+    (reference: *GroupByOutputRateLimiter variants)."""
+
+    def test_last_per_group_every_second(self):
+        q = ("from S select symbol, sum(volume) as total group by symbol "
+             "output last every 1 sec insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),
+            ("S", ["B", 1.0, 5], 1100),
+            ("S", ["A", 1.0, 20], 1400),
+            ("Tick", [1], 2100),          # period ends: last per group
+            ("S", ["A", 1.0, 1], 2200),
+            ("Tick", [2], 3300),
+        ])
+        assert sorted(map(tuple, got)) == [("A", 30), ("A", 31), ("B", 5)]
+
+    def test_first_per_group_every_second(self):
+        q = ("from S select symbol, sum(volume) as total group by symbol "
+             "output first every 1 sec insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),   # first A of period 1
+            ("S", ["B", 1.0, 5], 1100),    # first B of period 1
+            ("S", ["A", 1.0, 20], 1400),   # suppressed
+            ("S", ["A", 1.0, 1], 2200),    # first A of period 2
+        ])
+        assert sorted(map(tuple, got)) == [("A", 10), ("A", 31), ("B", 5)]
+
+
+class TestRateLimitWithWindows:
+    def test_all_every_events_passes_expired_too(self):
+        # a sliding window's CURRENT+EXPIRED pairs ride the batch
+        q = ("from S#window.length(2) select symbol "
+             "output every 3 events insert into OutputStream;")
+        got = run(q, s_rows(ROWS[:4]))
+        # 4 current + 2 expired events flow; batches of 3 outputs flush
+        assert [g[0] for g in got[:3]] == ["A", "B", "C"]
+
+    def test_snapshot_over_group_by(self):
+        # snapshot limiter emits the FULL group state each period
+        q = ("from S select symbol, sum(volume) as total group by symbol "
+             "output snapshot every 1 sec insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),
+            ("S", ["B", 1.0, 5], 1200),
+            ("Tick", [1], 2100),
+            ("S", ["B", 1.0, 7], 2200),
+            ("Tick", [2], 3300),
+        ])
+        assert sorted(map(tuple, got)) == [
+            ("A", 10), ("A", 10), ("B", 5), ("B", 12)]
+
+    def test_last_every_events_on_pattern_output(self):
+        # rate limiter downstream of a pattern query
+        q = ("from every e1=S[volume > 10] -> e2=S[volume > e1.volume] "
+             "select e1.symbol as s1, e2.symbol as s2 "
+             "output last every 2 events insert into OutputStream;")
+        got = run(q, s_rows([
+            ["A", 1.0, 20], ["B", 1.0, 30],   # match (A,B)
+            ["C", 1.0, 40],                    # matches (A,C),(B,C)
+        ]))
+        # 3 matches total: limiter emits the 2nd, holds the 3rd
+        assert got == [["A", "C"]] or got == [["B", "C"]]
+
+
+class TestTimeRateLimitEdges:
+    def test_all_every_time_multiple_periods_one_gap(self):
+        # one watermark jump across several empty periods flushes once
+        q = ("from S select symbol output every 1 sec "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),
+            ("Tick", [1], 5000),
+            ("S", ["B", 1.0, 10], 5100),
+            ("Tick", [2], 6200),
+        ])
+        assert [g[0] for g in got] == ["A", "B"]
+
+    def test_first_every_time_new_period_reopens(self):
+        q = ("from S select symbol output first every 1 sec "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),   # emitted (first of period)
+            ("S", ["B", 1.0, 10], 1500),   # suppressed
+            ("S", ["C", 1.0, 10], 2500),   # new period: emitted
+            ("S", ["D", 1.0, 10], 2600),   # suppressed
+        ])
+        assert [g[0] for g in got] == ["A", "C"]

@@ -1,0 +1,230 @@
+"""Selector conformance: order-by / limit / offset matrices, isNull,
+string & boolean comparison operators, multi-key group-by, and having
+edges — the behavioral families of the reference's
+OrderByLimitTestCase.java, IsNullTestCase.java, StringCompareTestCase
+.java, BooleanCompareTestCase.java and GroupByTestCase.java
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/).  Expectations
+are computed from the documented semantics: order-by sorts each output
+chunk, limit/offset slice it, group-by keys aggregates per distinct key
+tuple.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFS = "define stream S (symbol string, price float, volume long); "
+F = lambda x: np.float32(x).item()
+
+ROWS4 = [
+    ["IBM", 20.0, 100], ["WSO2", 40.0, 200],
+    ["IBM", 30.0, 300], ["APPL", 10.0, 400],
+]
+
+
+def run(query, rows, defs=DEFS, out="OutputStream", stream="S"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + defs + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler(stream)
+        for i, r in enumerate(rows):
+            h.send(r, timestamp=1000 + i * 100)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestOrderByLimit:
+    def test_limit_without_order(self):
+        # OrderByLimitTestCase.limitTest1: first 2 of each 4-batch
+        got = run("from S#window.lengthBatch(4) select symbol, price "
+                  "limit 2 insert into OutputStream;", ROWS4)
+        assert got == [["IBM", F(20.0)], ["WSO2", F(40.0)]]
+
+    def test_order_by_symbol_limit(self):
+        # limitTest2
+        got = run("from S#window.lengthBatch(4) select symbol, price "
+                  "order by symbol limit 3 insert into OutputStream;", ROWS4)
+        assert got == [["APPL", F(10.0)], ["IBM", F(20.0)], ["IBM", F(30.0)]]
+
+    def test_order_by_price_desc(self):
+        got = run("from S#window.lengthBatch(4) select symbol, price "
+                  "order by price desc insert into OutputStream;", ROWS4)
+        assert got == [["WSO2", F(40.0)], ["IBM", F(30.0)],
+                       ["IBM", F(20.0)], ["APPL", F(10.0)]]
+
+    def test_order_by_aggregated_value(self):
+        # limitTest6-style: group-by sum ordered by the aggregate
+        got = run("from S#window.lengthBatch(4) "
+                  "select symbol, sum(price) as totalPrice group by symbol "
+                  "order by totalPrice limit 2 insert into OutputStream;",
+                  ROWS4)
+        assert got == [["APPL", 10.0], ["WSO2", 40.0]]
+
+    def test_order_by_aggregate_desc_offset(self):
+        # limitTest12: desc order, skip the top entry
+        got = run("from S#window.lengthBatch(4) "
+                  "select symbol, sum(price) as totalPrice group by symbol "
+                  "order by totalPrice desc offset 1 "
+                  "insert into OutputStream;", ROWS4)
+        assert got == [["WSO2", 40.0], ["APPL", 10.0]]
+
+    def test_multi_key_order(self):
+        # limitTest5-style: secondary sort key breaks ties
+        rows = [["B", 10.0, 2], ["A", 10.0, 1], ["C", 5.0, 3], ["D", 7.0, 4]]
+        got = run("from S#window.lengthBatch(4) select symbol, price, volume "
+                  "order by price, volume insert into OutputStream;", rows)
+        assert got == [["C", F(5.0), 3], ["D", F(7.0), 4],
+                       ["A", F(10.0), 1], ["B", F(10.0), 2]]
+
+    def test_limit_zero(self):
+        got = run("from S#window.lengthBatch(4) select symbol "
+                  "limit 0 insert into OutputStream;", ROWS4)
+        assert got == []
+
+    def test_offset_beyond_chunk(self):
+        got = run("from S#window.lengthBatch(4) select symbol "
+                  "offset 10 insert into OutputStream;", ROWS4)
+        assert got == []
+
+    def test_order_limit_per_chunk_not_global(self):
+        # each lengthBatch flush is ordered/limited independently
+        rows = ROWS4 + [["ZZZ", 1.0, 1], ["AAA", 2.0, 2],
+                        ["MMM", 3.0, 3], ["BBB", 4.0, 4]]
+        got = run("from S#window.lengthBatch(4) select symbol "
+                  "order by symbol limit 1 insert into OutputStream;", rows)
+        assert got == [["APPL"], ["AAA"]]
+
+
+class TestIsNull:
+    def test_is_null_filter_on_stream(self):
+        # IsNullTestCase: null attribute values pass `is null`
+        got = run("from S[symbol is null] select price "
+                  "insert into OutputStream;",
+                  [["IBM", 20.0, 100], [None, 30.0, 200]])
+        assert got == [[F(30.0)]]
+
+    def test_not_is_null_filter(self):
+        got = run("from S[not (symbol is null)] select symbol "
+                  "insert into OutputStream;",
+                  [["IBM", 20.0, 100], [None, 30.0, 200]])
+        assert got == [["IBM"]]
+
+    def test_null_propagates_through_projection(self):
+        got = run("from S select symbol, price insert into OutputStream;",
+                  [[None, 20.0, 100]])
+        assert got == [[None, F(20.0)]]
+
+    def test_null_comparison_never_matches(self):
+        # null compared with anything is no-match (not an error)
+        got = run("from S[symbol == 'IBM'] select price "
+                  "insert into OutputStream;",
+                  [[None, 20.0, 100], ["IBM", 30.0, 200]])
+        assert got == [[F(30.0)]]
+
+
+class TestStringBoolCompare:
+    def test_string_operators(self):
+        # StringCompareTestCase: ==, !=, >, < over strings
+        rows = [["AAA", 1.0, 1], ["BBB", 2.0, 2], ["CCC", 3.0, 3]]
+        assert run("from S[symbol == 'BBB'] select symbol "
+                   "insert into OutputStream;", rows) == [["BBB"]]
+        assert run("from S[symbol != 'BBB'] select symbol "
+                   "insert into OutputStream;", rows) == [["AAA"], ["CCC"]]
+        assert run("from S[symbol > 'AAA'] select symbol "
+                   "insert into OutputStream;", rows) == [["BBB"], ["CCC"]]
+        assert run("from S[symbol <= 'BBB'] select symbol "
+                   "insert into OutputStream;", rows) == [["AAA"], ["BBB"]]
+
+    def test_bool_attribute_compare(self):
+        defs = "define stream B (name string, ok bool); "
+        rows = [["a", True], ["b", False], ["c", True]]
+        assert run("from B[ok == true] select name "
+                   "insert into OutputStream;", rows, defs=defs,
+                   stream="B") == [["a"], ["c"]]
+        assert run("from B[ok != true] select name "
+                   "insert into OutputStream;", rows, defs=defs,
+                   stream="B") == [["b"]]
+        assert run("from B[not ok] select name "
+                   "insert into OutputStream;", rows, defs=defs,
+                   stream="B") == [["b"]]
+
+
+class TestGroupByEdges:
+    def test_multi_key_group_by(self):
+        # GroupByTestCase: two grouping keys form a composite key
+        defs = "define stream T (a string, b string, v long); "
+        rows = [["x", "1", 10], ["x", "2", 20], ["x", "1", 30],
+                ["y", "1", 40]]
+        got = run("from T select a, b, sum(v) as total group by a, b "
+                  "insert into OutputStream;", rows, defs=defs, stream="T")
+        assert got == [["x", "1", 10], ["x", "2", 20], ["x", "1", 40],
+                       ["y", "1", 40]]
+
+    def test_group_by_with_having_on_aggregate(self):
+        defs = "define stream T (a string, v long); "
+        rows = [["x", 10], ["y", 5], ["x", 10], ["y", 5]]
+        got = run("from T select a, sum(v) as total group by a "
+                  "having total > 10 insert into OutputStream;",
+                  rows, defs=defs, stream="T")
+        assert got == [["x", 20]]
+
+    def test_group_by_sliding_window_subtracts(self):
+        # per-group sums fall when events expire from a length window
+        defs = "define stream T (a string, v long); "
+        rows = [["x", 10], ["x", 20], ["x", 30]]
+        got = run("from T#window.length(2) select a, sum(v) as total "
+                  "group by a insert into OutputStream;",
+                  rows, defs=defs, stream="T")
+        assert got == [["x", 10], ["x", 30], ["x", 50]]
+
+    def test_having_references_select_alias_and_raw_attr(self):
+        defs = "define stream T (a string, v long); "
+        rows = [["x", 10], ["y", 50]]
+        got = run("from T select a, v, sum(v) as total "
+                  "having v >= 50 and total >= 60 "
+                  "insert into OutputStream;", rows, defs=defs, stream="T")
+        assert got == [["y", 50, 60]]
+
+
+class TestMathAndFunctions:
+    def test_integer_division_truncates(self):
+        # java semantics: long/long is integer division
+        defs = "define stream T (a long, b long); "
+        got = run("from T select a / b as q, a % b as r "
+                  "insert into OutputStream;", [[7, 2]], defs=defs,
+                  stream="T")
+        assert got == [[3, 1]]
+
+    def test_float_division(self):
+        defs = "define stream T (a double, b long); "
+        got = run("from T select a / b as q insert into OutputStream;",
+                  [[7.0, 2]], defs=defs, stream="T")
+        assert got == [[3.5]]
+
+    def test_coalesce_and_ifthenelse(self):
+        got = run("from S select coalesce(symbol, 'none') as s, "
+                  "ifThenElse(price > 25.0, 'hi', 'lo') as lvl "
+                  "insert into OutputStream;",
+                  [[None, 20.0, 1], ["A", 30.0, 2]])
+        assert got == [["none", "lo"], ["A", "hi"]]
+
+    def test_cast_and_convert(self):
+        # convert float->long TRUNCATES (reference
+        # ConvertFunctionExecutor uses Float.longValue())
+        got = run("from S select cast(volume, 'string') as vs, "
+                  "convert(price, 'long') as pl "
+                  "insert into OutputStream;", [["A", 20.6, 42]])
+        assert got == [["42", 20]]
+
+    def test_instance_of_checks(self):
+        got = run("from S select instanceOfString(symbol) as a, "
+                  "instanceOfFloat(symbol) as b, "
+                  "instanceOfFloat(price) as c "
+                  "insert into OutputStream;", [["A", 20.0, 1]])
+        assert got == [[True, False, True]]
