@@ -113,3 +113,123 @@ class TestShardedProduct:
                     + APP)
         finally:
             m.shutdown()
+
+
+ABSENT_APP = (
+    "define stream Txn (card string, amount double); "
+    "define stream Confirm (card string, amount double); "
+    "define stream Tick (x int); "
+    "from Tick select x insert into _T; "
+    "partition with (card of Txn, card of Confirm) begin "
+    "@info(name='q') "
+    "from e1=Txn[amount > 1000.0] -> "
+    "not Confirm[amount == e1.amount] for 2 sec "
+    "select e1.amount as amt insert into Alerts; "
+    "end;"
+)
+
+
+class TestShardedProductExtended:
+    def test_within_expiry_fuzz_matches_host(self):
+        # `within` close to the per-key event gap so arms expire often; 40 keys over 8
+        # shards, randomized amounts — sharded output must equal host
+        app = APP.replace("within 10 min", "within 2 sec")
+        rng = np.random.default_rng(17)
+        sends = []
+        t = 1000
+        for _r in range(8):
+            for k in range(40):
+                t += int(rng.integers(5, 60))
+                sends.append(([f"k{k}", float(rng.integers(50, 400))], t))
+
+        def drive(header):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(header + app)
+                got = []
+                rt.add_callback(
+                    "Alerts", lambda evs: got.extend(e.data for e in evs))
+                rt.start()
+                h = rt.get_input_handler("Txn")
+                for row, ts in sends:
+                    h.send(row, timestamp=ts)
+                rt.shutdown()
+                return sorted(map(tuple, got))
+            finally:
+                m.shutdown()
+
+        host = drive(HDR_HOST)
+        shard = drive(HDR_SHARDED)
+        assert shard == host
+        assert len(host) > 0  # the scenario actually produces matches
+
+    def test_sharded_absent_deadlines_fire(self):
+        # the jitted timer step must run shard-local over the sharded
+        # state (XLA propagates the row sharding; no collectives)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "@app:execution('tpu', partitions='64', devices='8') "
+                + ABSENT_APP)
+            got = []
+            rt.add_callback(
+                "Alerts",
+                lambda evs: got.extend((list(e.data), e.timestamp)
+                                       for e in evs))
+            rt.start()
+            t = rt.get_input_handler("Txn")
+            c = rt.get_input_handler("Confirm")
+            # 12 keys arm deadlines across shards; 4 get confirmed
+            for k in range(12):
+                t.send([f"c{k}", 2000.0 + k], timestamp=1000 + k)
+            for k in range(4):
+                c.send([f"c{k}", 2000.0 + k], timestamp=1500 + k)
+            rt.get_input_handler("Tick").send([1], timestamp=5000)
+            pr = rt.partitions.get("partition_0")
+            runtime = next(iter(pr.dense_query_runtimes.values())
+                           ).pattern_processor
+            assert isinstance(runtime, DensePatternRuntime)
+            assert runtime._sharded is not None
+            assert runtime.engine.has_deadlines
+            rt.shutdown()
+            amts = sorted(row[0] for row, _ts in got)
+            assert amts == [2000.0 + k for k in range(4, 12)]
+            # timer emissions carry the per-arm deadline timestamps
+            ts_by_amt = {row[0]: ts for row, ts in got}
+            for k in range(4, 12):
+                assert ts_by_amt[2000.0 + k] == 3000 + k
+        finally:
+            m.shutdown()
+
+    def test_purge_recycles_rows_sharded(self):
+        app = (
+            "define stream Txn (card string, amount double); "
+            "@purge(enable='true', interval='1 sec', idle.period='2 sec') "
+            "partition with (card of Txn) begin "
+            "@info(name='q') "
+            "from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount] "
+            "select a.amount as base, b.amount as bv insert into Alerts; "
+            "end;"
+        )
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "@app:execution('tpu', partitions='16', devices='8') " + app)
+            rt.start()
+            h = rt.get_input_handler("Txn")
+            # first wave: 16 keys fill capacity
+            for k in range(16):
+                h.send([f"a{k}", 150.0], timestamp=1000 + k)
+            # idle them out, then a second wave of NEW keys must fit
+            h.send(["a0", 150.0], timestamp=8000)
+            for k in range(15):
+                h.send([f"b{k}", 150.0], timestamp=8100 + k)
+            pr = rt.partitions.get("partition_0")
+            runtime = next(iter(pr.dense_query_runtimes.values())
+                           ).pattern_processor
+            assert len(runtime._key_rows) <= 16
+            rt.shutdown()
+        finally:
+            m.shutdown()
