@@ -1,0 +1,243 @@
+"""Partition conformance, part 2: per-key windows, group-by inside
+partitions, inner-stream pipelines, shared global tables, range
+partitions and purge — the behavioral families of the reference's
+partition suite (modules/siddhi-core/src/test/java/io/siddhi/core/query/
+partition/ — PartitionTestCase1/2, WindowPartitionTestCase,
+JoinPartitionTestCase, TablePartitionTestCase,
+PartitionDataPurgingTestCase).  Per-key state isolation is the contract
+under test: each key must see its OWN window/aggregator state.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFS = "define stream S (k string, v long); "
+
+
+def run(app, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def s(rows, t0=1000, dt=100):
+    return [("S", r, t0 + i * dt) for i, r in enumerate(rows)]
+
+
+class TestPartitionedWindows:
+    def test_per_key_length_window_sum(self):
+        # each key's length(2) window holds ITS OWN last two events
+        app = (DEFS +
+               "partition with (k of S) begin "
+               "@info(name='q') from S#window.length(2) "
+               "select k, sum(v) as total insert into OutputStream; end;")
+        got = run(app, s([["a", 1], ["b", 10], ["a", 2], ["b", 20],
+                          ["a", 3], ["b", 30]]))
+        assert got == [["a", 1], ["b", 10], ["a", 3], ["b", 30],
+                       ["a", 5], ["b", 50]]
+
+    def test_per_key_length_batch_flushes_independently(self):
+        app = (DEFS +
+               "partition with (k of S) begin "
+               "@info(name='q') from S#window.lengthBatch(2) "
+               "select k, sum(v) as total insert into OutputStream; end;")
+        got = run(app, s([["a", 1], ["b", 10], ["b", 20], ["a", 2],
+                          ["a", 3]]))
+        # b's batch closes at its 2nd event, before a's does
+        assert got == [["b", 30], ["a", 3]]
+
+    def test_per_key_time_batch_watermark(self):
+        app = (DEFS +
+               "define stream Tick (x int); "
+               "from Tick select x insert into _T; "
+               "partition with (k of S) begin "
+               "@info(name='q') from S#window.timeBatch(1 sec) "
+               "select k, sum(v) as total insert into OutputStream; end;")
+        got = run(app, [
+            ("S", ["a", 1], 1000),
+            ("S", ["b", 10], 1200),
+            ("S", ["a", 2], 1400),
+            ("Tick", [1], 2500),
+        ])
+        assert sorted(map(tuple, got)) == [("a", 3), ("b", 10)]
+
+    def test_per_key_group_by_inside_partition(self):
+        # group-by nested inside a partition: state per (key, group)
+        defs = "define stream T (k string, g string, v long); "
+        app = (defs +
+               "partition with (k of T) begin "
+               "@info(name='q') from T select k, g, sum(v) as total "
+               "group by g insert into OutputStream; end;")
+        sends = [("T", r, 1000 + i * 10) for i, r in enumerate(
+            [["a", "x", 1], ["b", "x", 10], ["a", "x", 2],
+             ["a", "y", 5], ["b", "x", 20]])]
+        got = run(app, sends)
+        assert got == [["a", "x", 1], ["b", "x", 10], ["a", "x", 3],
+                       ["a", "y", 5], ["b", "x", 30]]
+
+
+class TestPartitionInnerStreams:
+    def test_inner_stream_pipeline_stays_per_key(self):
+        # stage 1 aggregates per key into #P; stage 2 filters it —
+        # the inner stream is local to each key instance
+        app = (DEFS +
+               "partition with (k of S) begin "
+               "@info(name='q1') from S select k, sum(v) as total "
+               "insert into #P; "
+               "@info(name='q2') from #P[total > 10] "
+               "select k, total insert into OutputStream; end;")
+        got = run(app, s([["a", 6], ["b", 11], ["a", 6], ["b", 1]]))
+        assert got == [["b", 11], ["a", 12], ["b", 12]]
+
+    def test_inner_window_per_key(self):
+        app = (DEFS +
+               "partition with (k of S) begin "
+               "@info(name='q1') from S select k, v insert into #P; "
+               "@info(name='q2') from #P#window.length(2) "
+               "select k, sum(v) as total insert into OutputStream; end;")
+        got = run(app, s([["a", 1], ["a", 2], ["a", 3], ["b", 10]]))
+        assert got == [["a", 1], ["a", 3], ["a", 5], ["b", 10]]
+
+
+class TestPartitionedTables:
+    def test_global_table_shared_across_keys(self):
+        # a table defined OUTSIDE the partition is one shared store
+        app = (DEFS +
+               "define table T (k string, v long); "
+               "partition with (k of S) begin "
+               "@info(name='q1') from S select k, v insert into T; end;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send(["a", 1], timestamp=1000)
+            h.send(["b", 2], timestamp=1100)
+            h.send(["a", 3], timestamp=1200)
+            rows = sorted(tuple(e.data) for e in rt.query(
+                "from T select k, v;"))
+            assert rows == [("a", 1), ("a", 3), ("b", 2)]
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_partitioned_query_joins_global_table(self):
+        app = (DEFS +
+               "define stream Boot (k string, lim long); "
+               "define table T (k string, lim long); "
+               "from Boot insert into T; "
+               "partition with (k of S) begin "
+               "@info(name='q') from S join T on S.k == T.k and S.v > T.lim "
+               "select S.k as k, S.v as v insert into OutputStream; end;")
+        got = run(app, [
+            ("Boot", ["a", 5], 500),
+            ("Boot", ["b", 50], 600),
+            ("S", ["a", 10], 1000),   # 10 > 5: out
+            ("S", ["b", 10], 1100),   # 10 < 50: no
+            ("S", ["b", 60], 1200),   # 60 > 50: out
+        ])
+        assert got == [["a", 10], ["b", 60]]
+
+
+class TestRangePartitions:
+    APP = (DEFS +
+           "partition with (v < 10 as 'small' or v < 100 as 'mid' or "
+           "v >= 100 as 'big' of S) begin "
+           "@info(name='q') from S select k, count() as n "
+           "insert into OutputStream; end;")
+
+    def test_range_buckets_have_independent_state(self):
+        got = run(self.APP, s([["a", 5], ["b", 50], ["c", 500],
+                               ["d", 6], ["e", 600]]))
+        # per-range count() state: small 1,2; mid 1; big 1,2
+        assert got == [["a", 1], ["b", 1], ["c", 1], ["d", 2], ["e", 2]]
+
+    def test_first_matching_range_wins(self):
+        # v=5 matches both 'small' and 'mid' conditions; the FIRST
+        # declared range claims it (reference RangePartitionExecutor
+        # evaluates in declaration order)
+        got = run(self.APP, s([["a", 5], ["b", 5]]))
+        assert got == [["a", 1], ["b", 2]]
+
+
+class TestPartitionPurge:
+    def test_purged_key_state_resets(self):
+        app = (DEFS +
+               "@purge(enable='true', interval='1 sec', "
+               "idle.period='2 sec') "
+               "partition with (k of S) begin "
+               "@info(name='q') from S select k, sum(v) as total "
+               "insert into OutputStream; end;")
+        got = run(app, [
+            ("S", ["a", 5], 1000),
+            ("S", ["b", 1], 1100),
+            ("S", ["b", 1], 5000),   # watermark: BOTH keys idle > 2s
+            ("S", ["a", 7], 5100),   # fresh instances: sums restart
+        ])
+        assert got == [["a", 5], ["b", 1], ["b", 1], ["a", 7]]
+
+    def test_active_key_survives_purge(self):
+        app = (DEFS +
+               "@purge(enable='true', interval='1 sec', "
+               "idle.period='10 sec') "
+               "partition with (k of S) begin "
+               "@info(name='q') from S select k, sum(v) as total "
+               "insert into OutputStream; end;")
+        got = run(app, [
+            ("S", ["a", 5], 1000),
+            ("S", ["a", 7], 5000),   # within idle.period: state kept
+        ])
+        assert got == [["a", 5], ["a", 12]]
+
+
+class TestPartitionedPatternsHostVsDense:
+    APP_BODY = (
+        "define stream Txn (card string, amount double); "
+        "partition with (card of Txn) begin "
+        "@info(name='q') from every a=Txn[amount > 100.0] -> "
+        "b=Txn[amount > a.amount] "
+        "select a.amount as base, b.amount as bv "
+        "insert into Alerts; end;"
+    )
+
+    def test_interleaved_keys_differential(self):
+        sends = []
+        rng = np.random.default_rng(5)
+        t = 1000
+        for _ in range(60):
+            k = f"c{int(rng.integers(0, 6))}"
+            t += int(rng.integers(1, 40))
+            sends.append((k, float(rng.integers(50, 400)), t))
+
+        def drive(header):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(header + self.APP_BODY)
+                got = []
+                rt.add_callback(
+                    "Alerts", lambda evs: got.extend(e.data for e in evs))
+                rt.start()
+                h = rt.get_input_handler("Txn")
+                for k, a, ts in sends:
+                    h.send([k, a], timestamp=ts)
+                rt.shutdown()
+                return sorted(map(tuple, got))
+            finally:
+                m.shutdown()
+
+        host = drive("@app:playback ")
+        dense = drive("@app:playback @app:execution('tpu', "
+                      "partitions='16') ")
+        assert dense == host
+        assert len(host) > 0

@@ -234,3 +234,32 @@ class TestNamedWindowSharing:
             assert [g[0] for g in got2] == [1, 2, 2]
         finally:
             m.shutdown()
+
+
+class TestExternalTimeBatchReference:
+    def test_batches_split_at_external_boundaries(self):
+        # ExternalTimeBatchWindowTestCase.test1: batches [10s,15s),
+        # [15s,20s), [20s,25s) flush when an event crosses the boundary
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "define stream I (currentTime long, value int); "
+                "@info(name='q') from "
+                "I#window.externalTimeBatch(currentTime, 5 sec) "
+                "select value insert into O;")
+            chunks = []
+            rt.add_callback(
+                "O", lambda evs: chunks.append([e.data[0] for e in evs]))
+            rt.start()
+            h = rt.get_input_handler("I")
+            for t, v in [(10000, 1), (11000, 2), (12000, 3), (13000, 4),
+                         (14000, 5), (15000, 6), (16500, 7), (17000, 8),
+                         (18000, 9), (19000, 10), (20000, 11), (20500, 12),
+                         (22000, 13), (25000, 14)]:
+                h.send([t, v], timestamp=t)
+            rt.shutdown()
+            assert chunks == [[1, 2, 3, 4, 5], [6, 7, 8, 9, 10],
+                              [11, 12, 13]]
+        finally:
+            m.shutdown()
