@@ -532,6 +532,10 @@ class GroupByTimeRateLimiter(OutputRateLimiter):
             self._window_end = now + self.ms
         out = self.on_time(now)
         res: List[EventBatch] = [out] if out is not None else []
+        if len(batch) == 0:
+            # having/batch-window flushes can hand over empty outputs,
+            # which legitimately carry no group-key side channel
+            return EventBatch.concat(res) if res else None
         keys = batch.aux.get("group_keys")
         if keys is None or len(keys) != len(batch):
             raise SiddhiAppRuntimeError(

@@ -1664,17 +1664,8 @@ def compile_pattern(
     builder = NFABuilder(st, resolve)
     nodes = builder.build()
     if every_start is None:
+        # group-scoped `every` is rejected by DensePatternEngine.__init__
         every_start = any(n.rearm_to is not None for n in nodes)
-        for n in nodes:
-            if n.rearm_to is not None and not (n.pos == 0 and n.rearm_to == 0):
-                # the dense standing-virgin models `every` only when the
-                # re-arm fires at node 0's completion (`every e1 -> ...`);
-                # group-every (`every (e1->e2) -> ...`) re-arms at GROUP
-                # completion — one arm at a time, which a per-event virgin
-                # would over-arm (WithinPatternTestCase.testQuery4/6)
-                raise SiddhiAppCreationError(
-                    "dense path: group-scoped `every` re-arms at group "
-                    "completion — host engine used")
 
     select_vars = []
     select_names = []

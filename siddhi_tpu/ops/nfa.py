@@ -675,11 +675,7 @@ class PatternProcessor:
                 else:
                     seen_pos.add(i.pos)
             self.instances = [i for i in self.instances if i.alive]
-        if (
-            self.mode == "sequence"
-            and self.has_every
-            and not (self.matched_once and not self.has_every)
-        ):
+        if self.mode == "sequence" and self.has_every:
             # only `every` sequences re-arm the start per event; a
             # non-every sequence arms once and dies with its arm
             # (reference: init() re-arms only when

@@ -232,3 +232,18 @@ class TestTimeRateLimitEdges:
             ("S", ["D", 1.0, 10], 2600),   # suppressed
         ])
         assert [g[0] for g in got] == ["A", "C"]
+
+
+class TestGroupedLimiterEmptyBatches:
+    def test_having_filtered_empty_output_does_not_crash(self):
+        # a having clause that rejects every row hands the limiter an
+        # EMPTY batch with no group-key side channel — must be a no-op
+        q = ("from S select symbol, price group by symbol "
+             "having price > 100.0 output first every 1 sec "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("S", ["A", 1.0, 10], 1000),    # filtered by having
+            ("S", ["B", 200.0, 5], 1100),   # passes
+            ("S", ["C", 2.0, 5], 1200),     # filtered
+        ])
+        assert got == [["B", 200.0]]
