@@ -472,18 +472,16 @@ class DensePatternRuntime:
         self._rebuild_key_index()
         self._wake_dirty = True
 
-    def _part_ids(self, batch: EventBatch) -> np.ndarray:
-        if self.key_fn is None:
-            return np.zeros(len(batch), dtype=np.int32)
-        return self.intern_keys(self.key_fn(batch))
-
     # -- event path ----------------------------------------------------------
 
     def process_stream_batch(self, stream_key: str, batch: EventBatch,
-                             part: Optional[np.ndarray] = None):
+                             part: Optional[np.ndarray] = None,
+                             keys=None):
         """Advance the NFA with a junction batch.  ``part`` overrides the
         partition-row assignment (the partitioned receiver computes it
-        from the partition executor + intern_keys)."""
+        from the partition executor + intern_keys); ``keys`` carries the
+        raw partition-key values aligned with the batch so aggregating
+        selectors can keep per-key state (aux side channel)."""
         cur = batch.only(ev.CURRENT)
         n = len(cur)
         if n == 0:
@@ -498,7 +496,12 @@ class DensePatternRuntime:
             # bit-exact hi/lo pairs itself (prepare_cols)
             cols[a] = np.asarray(col)
         if part is None:
-            part = self._part_ids(cur)
+            if self.key_fn is None:
+                part = np.zeros(len(cur), dtype=np.int32)
+            else:
+                if keys is None:
+                    keys = self.key_fn(cur)
+                part = self.intern_keys(keys)
         ts = np.asarray(cur.timestamps, dtype=np.int64)
         if len(ts):
             np.maximum.at(self._row_last_used, part, ts)
@@ -523,6 +526,8 @@ class DensePatternRuntime:
             self.out_stream_id, names, out_cols,
             ts[ev_idx], np.full(len(ev_idx), ev.CURRENT, dtype=np.int8),
         )
+        if keys is not None:
+            mb.aux["partition_keys"] = [keys[int(i)] for i in ev_idx]
         self.emit_cb(mb)
 
     # -- instance-capacity overflow ------------------------------------------

@@ -92,13 +92,17 @@ class EventBatch:
     def size(self) -> int:
         return len(self.timestamps)
 
+    # per-row aux side channels that row selections must keep aligned
+    _ROW_AUX = ("group_keys", "partition_keys")
+
     def _carry_group_keys(self, out: "EventBatch", sel) -> "EventBatch":
-        gk = self.aux.get("group_keys")
-        if gk is not None and len(gk) == len(self):
-            if isinstance(sel, np.ndarray) and sel.dtype == bool:
-                out.aux["group_keys"] = [k for k, m in zip(gk, sel) if m]
-            else:
-                out.aux["group_keys"] = [gk[int(i)] for i in sel]
+        for name in self._ROW_AUX:
+            gk = self.aux.get(name)
+            if gk is not None and len(gk) == len(self):
+                if isinstance(sel, np.ndarray) and sel.dtype == bool:
+                    out.aux[name] = [k for k, m in zip(gk, sel) if m]
+                else:
+                    out.aux[name] = [gk[int(i)] for i in sel]
         return out
 
     def mask(self, m: np.ndarray) -> "EventBatch":
@@ -162,11 +166,12 @@ class EventBatch:
             np.concatenate([b.timestamps for b in batches]),
             np.concatenate([b.types for b in batches]),
         )
-        if all(
-            b.aux.get("group_keys") is not None and len(b.aux["group_keys"]) == len(b)
-            for b in batches
-        ):
-            out.aux["group_keys"] = [k for b in batches for k in b.aux["group_keys"]]
+        for name in EventBatch._ROW_AUX:
+            if all(
+                b.aux.get(name) is not None and len(b.aux[name]) == len(b)
+                for b in batches
+            ):
+                out.aux[name] = [k for b in batches for k in b.aux[name]]
         return out
 
     def __repr__(self):

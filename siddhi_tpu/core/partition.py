@@ -294,7 +294,7 @@ class DensePartitionReceiver:
             keys = np.asarray(keys.tolist())
         for rt in self.runtimes:
             part = rt.intern_keys(keys)
-            rt.process_stream_batch(self.stream_id, cur, part=part)
+            rt.process_stream_batch(self.stream_id, cur, part=part, keys=keys)
 
 
 class PartitionStreamReceiver:

@@ -143,6 +143,12 @@ class QuerySelector:
         self.batch_mode = batch_mode
         # group key -> {agg index -> state dict}
         self.group_states: Dict = {}
+        # partitioned dense patterns set this True: each incoming match
+        # row carries its partition key (aux["partition_keys"]), which is
+        # prepended to the group id so ONE shared selector keeps per-key
+        # aggregation state — the dense analog of the host's per-key
+        # selector instances (PartitionStateHolder + GROUP_BY_KEY)
+        self.partition_axis = False
 
     # -- state plumbing (snapshot contract) ---------------------------------
 
@@ -154,19 +160,24 @@ class QuerySelector:
 
     # -- processing ---------------------------------------------------------
 
-    def _group_ids(self, env, n) -> List:
+    def _group_ids(self, env, n, pkeys=None) -> List:
         if not self.group_keys:
-            return [None] * n
-        key_cols = [np.broadcast_to(np.asarray(k.fn(env)), (n,)) for k in self.group_keys]
-        if len(key_cols) == 1:
-            col = key_cols[0]
-            return [col[i].item() if isinstance(col[i], np.generic) else col[i] for i in range(n)]
-        return [
-            tuple(
-                c[i].item() if isinstance(c[i], np.generic) else c[i] for c in key_cols
-            )
-            for i in range(n)
-        ]
+            base = [None] * n
+        else:
+            key_cols = [np.broadcast_to(np.asarray(k.fn(env)), (n,)) for k in self.group_keys]
+            if len(key_cols) == 1:
+                col = key_cols[0]
+                base = [col[i].item() if isinstance(col[i], np.generic) else col[i] for i in range(n)]
+            else:
+                base = [
+                    tuple(
+                        c[i].item() if isinstance(c[i], np.generic) else c[i] for c in key_cols
+                    )
+                    for i in range(n)
+                ]
+        if pkeys is None:
+            return base
+        return [(pk, k) for pk, k in zip(pkeys, base)]
 
     def _agg_outputs(self, env, n, keys, is_remove: bool) -> Dict[str, np.ndarray]:
         """Segmented per-group aggregation preserving arrival order."""
@@ -230,7 +241,14 @@ class QuerySelector:
     def _process_run(self, run: EventBatch, rtype: int) -> EventBatch:
         n = len(run)
         env = build_env(run)
-        keys = self._group_ids(env, n)
+        pkeys = None
+        if self.partition_axis:
+            pkeys = run.aux.get("partition_keys")
+            if pkeys is None or len(pkeys) != n:
+                raise SiddhiAppRuntimeError(
+                    "partition-axis selector received rows without the "
+                    "partition-key side channel")
+        keys = self._group_ids(env, n, pkeys)
         env.update(self._agg_outputs(env, n, keys, is_remove=(rtype == ev.EXPIRED)))
         if self.items is None:
             out_cols = {nm: run.columns[nm] for nm in self.output_attribute_names}

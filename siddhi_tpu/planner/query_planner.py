@@ -434,15 +434,15 @@ class QueryPlanner:
             # matches are sparse, so selector cost is negligible next to
             # the jitted NFA step (reference analog: QuerySelector over
             # StateEvent chunks, QuerySelector.java:76-99)
-            if key_fn is not None or n_partitions > 1:
-                # a single shared QuerySelector would pool aggregation
-                # state ACROSS partition keys; the host form keeps
-                # per-key selector state, so partitioned aggregating
-                # patterns stay on per-key host instances until the
-                # selector grows a partition-key group axis
+            partitioned = key_fn is not None or n_partitions > 1
+            if partitioned and (sel.order_by or sel.limit is not None
+                                or sel.offset is not None):
+                # order-by/limit slice each output chunk; dense chunks
+                # mix partition keys, which would slice ACROSS keys —
+                # the host form slices per key instance
                 raise SiddhiAppCreationError(
-                    "dense path: partitioned aggregating pattern "
-                    "selectors need per-key selector state — host "
+                    "dense path: partitioned aggregating selectors with "
+                    "order by/limit need per-key chunks — host "
                     "instances used")
             from siddhi_tpu.ops.nfa import NFABuilder, PatternScope
 
@@ -466,6 +466,17 @@ class QueryPlanner:
                 n_instances=self.app.app_context.tpu_instances,
                 select_override=(select_vars, select_names),
                 builder=builder)
+            if partitioned:
+                if getattr(engine, "has_deadlines", False):
+                    # timer-fired matches carry no partition-key side
+                    # channel (no triggering batch) — keep absent +
+                    # aggregating + partitioned on host instances
+                    raise SiddhiAppCreationError(
+                        "dense path: partitioned aggregating absent "
+                        "patterns — host instances used")
+                # ONE shared selector keeps per-(key, group) state via
+                # the partition-key side channel on match rows
+                selector.partition_axis = True
         else:
             engine = build_dense_engine(
                 query, st, self.app.resolve_stream_definition, n_partitions,

@@ -153,10 +153,10 @@ class TestDensePath:
         m2.shutdown()
         assert dense == host and len(host) > 0
 
-    def test_partitioned_aggregating_selector_stays_on_host(self, manager):
-        """A partitioned aggregating pattern needs PER-KEY selector
-        state; one shared dense selector would pool sums across keys —
-        so it falls back to host instances and matches host output."""
+    def test_partitioned_aggregating_selector_per_key_sums(self, manager):
+        """Round-4: the partitioned aggregating form runs dense with ONE
+        shared selector keyed by the partition-key side channel — sums
+        must stay per key, never pooled (host parity)."""
         app = (
             "define stream Txn (card string, amount double); "
             "partition with (card of Txn) begin "
@@ -352,3 +352,62 @@ class TestReviewRegressions:
         h.send(["e", 250.0], timestamp=21_500)
         assert [150.0, 250.0] in got
         rt.shutdown()
+
+
+class TestPartitionedAggregatingSelector:
+    """Round-4: partitioned aggregating pattern selectors run dense with
+    ONE shared QuerySelector keeping per-(key, group) state via the
+    partition-key side channel (host analog: per-key selector
+    instances)."""
+
+    APP_BODY = (
+        "define stream Txn (card string, amount double); "
+        "partition with (card of Txn) begin "
+        "@info(name='q') from every a=Txn[amount > 100.0] -> "
+        "b=Txn[amount > a.amount] "
+        "select count() as n, sum(b.amount) as total "
+        "having n >= 1 insert into Alerts; "
+        "end;"
+    )
+
+    def _drive(self, header, sends):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(header + self.APP_BODY)
+            got = []
+            rt.add_callback(
+                "Alerts", lambda evs: got.extend(list(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("Txn")
+            for row, ts in sends:
+                h.send(row, timestamp=ts)
+            pr = rt.partitions.get("partition_0")
+            runtime = (next(iter(pr.dense_query_runtimes.values()))
+                       .pattern_processor
+                       if pr is not None and getattr(pr, "is_dense", False)
+                       else None)
+            rt.shutdown()
+            return got, runtime
+        finally:
+            m.shutdown()
+
+    def test_per_key_aggregation_matches_host(self):
+        rng = np.random.default_rng(23)
+        sends = []
+        t = 1000
+        for _ in range(50):
+            k = f"c{int(rng.integers(0, 5))}"
+            t += int(rng.integers(1, 30))
+            sends.append(([k, float(rng.integers(50, 400))], t))
+        host, hproc = self._drive("@app:playback ", sends)
+        dense, dproc = self._drive(
+            "@app:playback @app:execution('tpu', partitions='16') ", sends)
+        assert hproc is None
+        assert isinstance(dproc, DensePatternRuntime)
+        assert dproc.step_invocations > 0
+        # equality against the host's PER-KEY selector instances proves
+        # the shared selector isolates state per partition key (pooled
+        # counts/sums would diverge immediately)
+        assert dense == host
+        assert len(host) > 0
+        assert max(n for n, _t in dense) > 1  # some key aggregated twice
