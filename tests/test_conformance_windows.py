@@ -263,3 +263,100 @@ class TestExternalTimeBatchReference:
                               [11, 12, 13]]
         finally:
             m.shutdown()
+
+
+class TestWindowEdgeMatrix:
+    """Edge semantics of the trickier windows: session gaps, sort
+    eviction, frequent/lossyFrequent approximate eviction, delay, and
+    timeLength interplay (reference: query/processor/stream/window/*)."""
+
+    def _run(self, query, sends, defs=None, out="O"):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                + (defs or "define stream S (k string, v long); ")
+                + "define stream Tick (x int); "
+                  "from Tick select x insert into _T; "
+                + query)
+            got = []
+            rt.add_callback(out, lambda evs: got.extend(
+                (list(e.data), int(e.timestamp)) for e in evs))
+            rt.start()
+            for stream, row, ts in sends:
+                rt.get_input_handler(stream).send(row, timestamp=ts)
+            rt.shutdown()
+            return got
+        finally:
+            m.shutdown()
+
+    def test_session_window_gap_closes_session(self):
+        got = self._run(
+            "@info(name='q') from S#window.session(1 sec, k) "
+            "select k, sum(v) as total insert into O;",
+            [("S", ["a", 1], 1000),
+             ("S", ["a", 2], 1400),
+             ("Tick", [1], 3000),     # gap > 1s: a's session closes
+             ("S", ["a", 5], 3200)])  # new session
+        # running sums while the session accumulates, reset after close
+        vals = [row for row, _ in got]
+        assert vals[0] == ["a", 1] and vals[1] == ["a", 3]
+        assert vals[-1] == ["a", 5]
+
+    def test_session_key_scopes_expiry_not_aggregation(self):
+        # the session KEY groups events into sessions for gap expiry;
+        # a selector without group-by still sums ALL live events
+        got = self._run(
+            "@info(name='q') from S#window.session(1 sec, k) "
+            "select k, sum(v) as total insert into O;",
+            [("S", ["a", 1], 1000),
+             ("S", ["b", 10], 1100),
+             ("S", ["a", 2], 1500)])
+        vals = [row for row, _ in got]
+        assert vals == [["a", 1], ["b", 11], ["a", 13]]
+
+    def test_sort_window_evicts_extreme(self):
+        # sort(2, v, 'asc') keeps the 2 SMALLEST v values; the CURRENT
+        # event's row shows the pre-eviction sum (the EXPIRED eviction
+        # follows it in the same chunk, reference chunk ordering)
+        got = self._run(
+            "@info(name='q') from S#window.sort(2, v, 'asc') "
+            "select k, sum(v) as total insert into O;",
+            [("S", ["a", 5], 1000),
+             ("S", ["b", 1], 1100),
+             ("S", ["c", 9], 1200),   # evicted in the same chunk
+             ("S", ["d", 2], 1300)])  # evicts 5 -> buffer {1, 2}
+        vals = [row for row, _ in got]
+        assert vals == [["a", 5], ["b", 6], ["c", 15], ["d", 8]]
+
+    def test_frequent_window_keeps_top_keys(self):
+        got = self._run(
+            "@info(name='q') from S#window.frequent(2, k) "
+            "select k, count() as n insert into O;",
+            [("S", ["a", 1], 1000),
+             ("S", ["a", 1], 1100),
+             ("S", ["b", 1], 1200),
+             ("S", ["a", 1], 1300)])
+        # two distinct frequent slots; 'a' stays counted throughout
+        vals = [row for row, _ in got]
+        assert vals[-1][0] == "a"
+
+    def test_delay_window_emits_after_interval(self):
+        got = self._run(
+            "@info(name='q') from S#window.delay(1 sec) "
+            "select k, v insert into O;",
+            [("S", ["a", 1], 1000),
+             ("Tick", [1], 2500)])
+        # the delayed event surfaces once the watermark passes 2000,
+        # keeping its ORIGINAL timestamp
+        assert got == [(["a", 1], 1000)]
+
+    def test_time_length_caps_both_axes(self):
+        got = self._run(
+            "@info(name='q') from S#window.timeLength(1 sec, 2) "
+            "select sum(v) as total insert into O;",
+            [("S", ["a", 1], 1000),
+             ("S", ["b", 2], 1100),
+             ("S", ["c", 4], 1200)])  # length cap 2: 'a' evicted
+        vals = [row for row, _ in got]
+        assert vals == [[1], [3], [6]]
