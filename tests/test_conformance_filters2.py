@@ -1,0 +1,117 @@
+"""Filter conformance, part 2: the per-type x per-operator comparison
+matrix.  The reference implements one generated executor class per
+(type, type, operator) combination (core/executor/condition/compare/ —
+e.g. GreaterThanCompareConditionExpressionExecutorFloatDouble); this
+matrix pins the same per-type exactness through the generic compiled
+expressions: every numeric type pair, string and bool comparisons,
+cross-type promotion, and boundary values (float32 precision edge,
+int64 magnitudes).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFS = ("define stream S (i int, l long, f float, d double, "
+        "s string, b bool); ")
+
+ROW = {"i": 5, "l": 5_000_000_000, "f": 2.5, "d": 2.5,
+       "s": "mm", "b": True}
+
+
+def matches(cond, row=None):
+    """Returns True when the single sent row passes [cond]."""
+    r = dict(ROW, **(row or {}))
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            DEFS + f"@info(name='q') from S[{cond}] select i insert into O;")
+        got = []
+        rt.add_callback("O", lambda evs: got.extend(evs))
+        rt.start()
+        rt.get_input_handler("S").send(
+            [r["i"], r["l"], r["f"], r["d"], r["s"], r["b"]])
+        rt.shutdown()
+        return len(got) == 1
+    finally:
+        m.shutdown()
+
+
+OPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+# (attr, live value, [probe constants])
+NUMERIC_CASES = [
+    ("i", 5, ["4", "5", "6"]),
+    ("l", 5_000_000_000, ["4999999999", "5000000000", "5000000001"]),
+    ("f", 2.5, ["2.0", "2.5", "3.0"]),
+    ("d", 2.5, ["2.0", "2.5", "3.0"]),
+]
+
+
+class TestCompareMatrix:
+    @pytest.mark.parametrize("attr,val,probes",
+                             NUMERIC_CASES,
+                             ids=[c[0] for c in NUMERIC_CASES])
+    def test_numeric_attr_vs_constant(self, attr, val, probes):
+        for op, fn in OPS.items():
+            for p in probes:
+                want = fn(val, float(p) if "." in p else int(p))
+                got = matches(f"{attr} {op} {p}")
+                assert got == want, f"{attr} {op} {p}: {got} != {want}"
+
+    def test_cross_type_attr_pairs(self):
+        # i(5) vs f(2.5), l vs d, i vs l — promotion must be numeric
+        assert matches("i > f")
+        assert not matches("i < f")
+        assert matches("l > d")
+        assert matches("l > i")
+        assert matches("i == l", {"l": 5})
+        assert matches("f == d")
+
+    def test_string_compare_full_operator_set(self):
+        for op, fn in OPS.items():
+            for probe in ("ll", "mm", "nn"):
+                want = fn("mm", probe)
+                got = matches(f"s {op} '{probe}'")
+                assert got == want, f"s {op} '{probe}'"
+
+    def test_bool_compare(self):
+        assert matches("b == true")
+        assert not matches("b == false")
+        assert matches("b != false")
+        assert not matches("b", {"b": False})
+
+    def test_long_precision_above_float32(self):
+        # 2^24 + 1 vs 2^24: float32 would collapse these
+        assert matches("l == 16777217", {"l": 16777217})
+        assert not matches("l == 16777216", {"l": 16777217})
+        assert matches("l > 16777216", {"l": 16777217})
+
+    def test_negative_and_zero_boundaries(self):
+        assert matches("i < 0", {"i": -1})
+        assert not matches("i < 0", {"i": 0})
+        assert matches("i <= 0", {"i": 0})
+        assert matches("d < 0.0", {"d": -0.5})
+        assert matches("d == 0.0", {"d": 0.0})
+
+    def test_logical_combinations(self):
+        assert matches("i == 5 and d == 2.5")
+        assert not matches("i == 5 and d == 9.9")
+        assert matches("i == 9 or d == 2.5")
+        assert matches("not (i == 9)")
+        assert matches("(i > 4 and i < 6) or b == false")
+
+    def test_arithmetic_in_condition(self):
+        assert matches("i + 1 == 6")
+        assert matches("i * 2 > 9")
+        assert matches("d / 2.0 == 1.25")
+        assert matches("i - 10 < 0")
+        assert matches("l % 7 == " + str(5_000_000_000 % 7))
