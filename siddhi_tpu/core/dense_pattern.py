@@ -253,6 +253,9 @@ class DensePatternRuntime:
         # recompute (one device reduce + scalar D2H) only then
         self._wake_cache = None
         self._wake_dirty = True
+        # partitioned aggregating form: notified with purged key values
+        # so the shared selector can drop their per-key state
+        self.on_purge_keys = None
         # instance-capacity overflow surfacing: dropped pending instances
         # are counted on device; poll cheaply (one D2H per _OVF_POLL
         # steps) and warn when the count grows — a dense-mode match set
@@ -471,6 +474,10 @@ class DensePatternRuntime:
             self._free_rows.append(r)
         self._rebuild_key_index()
         self._wake_dirty = True
+        if self.on_purge_keys is not None:
+            # partition-axis selectors drop the purged keys' aggregation
+            # state too (host analog: the whole per-key instance dies)
+            self.on_purge_keys([k for k, _r in idle])
 
     # -- event path ----------------------------------------------------------
 

@@ -160,6 +160,17 @@ class QuerySelector:
 
     # -- processing ---------------------------------------------------------
 
+    def drop_partition_keys(self, keys) -> None:
+        """Discard per-key aggregation state for purged partition keys
+        (partition-axis selectors; host analog: the per-key instance —
+        selector included — is destroyed on idle purge)."""
+        doomed = set(keys)
+        self.group_states = {
+            gid: st for gid, st in self.group_states.items()
+            if not (isinstance(gid, tuple) and len(gid) == 2
+                    and gid[0] in doomed)
+        }
+
     def _group_ids(self, env, n, pkeys=None) -> List:
         if not self.group_keys:
             base = [None] * n

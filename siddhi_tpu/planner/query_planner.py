@@ -424,6 +424,14 @@ class QueryPlanner:
 
         if n_partitions is None:
             n_partitions = 1 if key_fn is None else self.app.app_context.tpu_partitions
+        partitioned = key_fn is not None or n_partitions > 1
+        if partitioned and query.output_rate is not None:
+            # the host partitioned form gives each key instance its OWN
+            # rate limiter; one shared limiter would pool emission
+            # windows across keys
+            raise SiddhiAppCreationError(
+                "dense path: partitioned queries with output rate limits "
+                "need per-key limiters — host instances used")
 
         sel = query.selector
         if sel.group_by or sel.having is not None or self._has_aggregators(sel):
@@ -434,7 +442,6 @@ class QueryPlanner:
             # matches are sparse, so selector cost is negligible next to
             # the jitted NFA step (reference analog: QuerySelector over
             # StateEvent chunks, QuerySelector.java:76-99)
-            partitioned = key_fn is not None or n_partitions > 1
             if partitioned and (sel.order_by or sel.limit is not None
                                 or sel.offset is not None):
                 # order-by/limit slice each output chunk; dense chunks
@@ -521,6 +528,10 @@ class QueryPlanner:
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
             key_fn=key_fn, mesh=mesh,
         )
+        if getattr(selector, "partition_axis", False):
+            # idle-key purges must also drop the shared selector's
+            # per-key aggregation state (host: the instance dies whole)
+            runtime.on_purge_keys = selector.drop_partition_keys
         qr.pattern_processor = runtime
         if subscribe:
             for sk in engine.stream_keys:

@@ -411,3 +411,59 @@ class TestPartitionedAggregatingSelector:
         assert dense == host
         assert len(host) > 0
         assert max(n for n, _t in dense) > 1  # some key aggregated twice
+
+
+class TestPartitionedAggregatingPurge:
+    def test_purged_key_selector_state_resets(self):
+        # idle purge must reset a key's AGGREGATION state too: after the
+        # purge, count() restarts at 1 exactly like the host per-key
+        # instance form (review finding r4)
+        app = (
+            "@app:playback "
+            "define stream Txn (card string, amount double); "
+            "@purge(enable='true', interval='1 sec', idle.period='2 sec') "
+            "partition with (card of Txn) begin "
+            "@info(name='q') from every a=Txn[amount > 100.0] -> "
+            "b=Txn[amount > a.amount] "
+            "select count() as n insert into Alerts; "
+            "end;"
+        )
+        sends = [
+            (["c1", 150.0], 1000), (["c1", 200.0], 1100),   # match: n=1
+            (["c1", 150.0], 6000),                          # purged; re-arm
+            (["c1", 200.0], 6100),                          # match: n=1 again
+        ]
+
+        def drive(header):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(header + app)
+                got = []
+                rt.add_callback(
+                    "Alerts", lambda evs: got.extend(list(e.data) for e in evs))
+                rt.start()
+                h = rt.get_input_handler("Txn")
+                for row, ts in sends:
+                    h.send(row, timestamp=ts)
+                rt.shutdown()
+                return got
+            finally:
+                m.shutdown()
+
+        host = drive("")
+        dense = drive("@app:execution('tpu', partitions='16') ")
+        assert dense == host == [[1], [1]]
+
+    def test_partitioned_rate_limit_falls_back(self, manager):
+        # per-key limiters cannot share one dense limiter — host used
+        app = (
+            "@app:execution('tpu', partitions='16') "
+            "define stream Txn (card string, amount double); "
+            "partition with (card of Txn) begin "
+            "@info(name='q') from every a=Txn[amount > 100.0] -> "
+            "b=Txn[amount > a.amount] "
+            "select a.amount as av output every 2 events "
+            "insert into Alerts; end;")
+        rt = manager.create_siddhi_app_runtime(app)
+        pr = rt.partitions.get("partition_0")
+        assert pr is not None and not getattr(pr, "is_dense", False)
