@@ -190,9 +190,17 @@ class SiddhiAppRuntime:
             f"no stream or query named '{target}' in app '{self.name}'"
         )
 
+    def add_exception_listener(self, listener) -> None:
+        """Register a runtime exception listener invoked with every
+        error the engine logs instead of raising — @OnError LOG mode,
+        sink publish failures, scheduler task errors (reference:
+        SiddhiAppRuntimeImpl.handleRuntimeExceptionWith:827)."""
+        self.app_context.exception_listeners.append(listener)
+
     # Java-style aliases for drop-in familiarity
     addCallback = add_callback
     getInputHandler = get_input_handler
+    handleRuntimeExceptionWith = add_exception_listener
 
     # -- statistics ---------------------------------------------------------
 

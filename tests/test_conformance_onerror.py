@@ -1,0 +1,119 @@
+"""Failure-routing conformance: @OnError LOG/STREAM fault streams,
+exception listeners, and error isolation between receivers — the
+behavioral contract of the reference's StreamJunction.handleError
+(stream/StreamJunction.java:368-430) and fault-stream definitions
+(`!streamName` consuming queries, SiddhiAppParser.java:364-368).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def boom(v):
+    raise RuntimeError("boom")
+
+
+class TestOnErrorStream:
+    APP = (
+        "@OnError(action='STREAM') "
+        "define stream S (k string, v double); "
+        "@info(name='q') from S select k, custom:boom(v) as x "
+        "insert into O; "
+        "@info(name='qf') from !S select k, v insert into FaultOut; "
+    )
+
+    def _manager(self):
+        m = SiddhiManager()
+        m.set_extension("custom:boom", boom, kind="function")
+        return m
+
+    def test_failing_event_routes_to_fault_stream(self):
+        m = self._manager()
+        try:
+            rt = m.create_siddhi_app_runtime(self.APP)
+            ok, fault = [], []
+            rt.add_callback("O", lambda evs: ok.extend(list(e.data) for e in evs))
+            rt.add_callback("FaultOut",
+                            lambda evs: fault.extend(list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("S").send(["a", 1.0])
+            rt.shutdown()
+            assert ok == []
+            assert fault == [["a", 1.0]]  # original payload preserved
+        finally:
+            m.shutdown()
+
+    def test_fault_stream_exposes_error_column(self):
+        app = self.APP.replace(
+            "from !S select k, v insert into FaultOut;",
+            "from !S select k, _error insert into FaultOut;")
+        m = self._manager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            fault = []
+            rt.add_callback("FaultOut",
+                            lambda evs: fault.extend(list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("S").send(["a", 1.0])
+            rt.shutdown()
+            assert len(fault) == 1
+            k, err = fault[0]
+            assert k == "a" and isinstance(err, RuntimeError)
+        finally:
+            m.shutdown()
+
+    def test_healthy_queries_unaffected_by_failing_sibling(self):
+        app = self.APP + "@info(name='q2') from S select v insert into OK2; "
+        m = self._manager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            ok2 = []
+            rt.add_callback("OK2", lambda evs: ok2.extend(list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("S").send(["a", 7.0])
+            rt.shutdown()
+            assert ok2 == [[7.0]]  # the sibling query still ran
+        finally:
+            m.shutdown()
+
+
+class TestOnErrorLog:
+    def test_log_mode_notifies_exception_listeners(self):
+        app = (
+            "define stream S (k string, v double); "
+            "@info(name='q') from S select custom:boom(v) as x "
+            "insert into O; ")
+        m = SiddhiManager()
+        m.set_extension("custom:boom", boom, kind="function")
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            seen = []
+            rt.add_exception_listener(seen.append)
+            rt.start()
+            rt.get_input_handler("S").send(["a", 1.0])
+            rt.shutdown()
+            assert len(seen) == 1 and isinstance(seen[0], RuntimeError)
+        finally:
+            m.shutdown()
+
+    def test_processing_continues_after_logged_error(self):
+        app = (
+            "define stream S (k string, v double); "
+            "@info(name='q') from S[v > 0.0] "
+            "select custom:boom(v) as x insert into O; "
+            "@info(name='q2') from S select v insert into OK2; ")
+        m = SiddhiManager()
+        m.set_extension("custom:boom", boom, kind="function")
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            ok2 = []
+            rt.add_callback("OK2", lambda evs: ok2.extend(list(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send(["a", 1.0])
+            h.send(["b", 2.0])
+            rt.shutdown()
+            assert ok2 == [[1.0], [2.0]]
+        finally:
+            m.shutdown()
