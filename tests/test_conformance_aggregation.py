@@ -186,3 +186,61 @@ class TestPurgeAnnotation:
         finally:
             rt.shutdown()
             m.shutdown()
+
+
+class TestAggregatorBreadthAcrossDurations:
+    """min/max across rollups + explicit within-range strings
+    (reference AggregationTestCase min/max/start-end variants)."""
+
+    AGG_MM = (
+        "define aggregation MM from Trades "
+        "select symbol, min(price) as lo, max(price) as hi, "
+        "sum(volume) as vol "
+        "group by symbol aggregate by ts every sec ... min;"
+    )
+
+    def test_min_max_rollup_to_minutes(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + DEFINE + self.AGG_MM)
+        rt.start()
+        try:
+            send_trades(rt, [
+                ("A", 9.0, 10, 0),
+                ("A", 3.0, 20, 15_000),   # same minute, other second
+                ("A", 7.0, 30, 61_000),   # next minute
+            ])
+            # advance the cascade past the open buckets
+            send_trades(rt, [("Z", 1.0, 1, 200_000)])
+            got = rt.query(
+                "from MM within {s}L, {e}L per 'minutes' "
+                "select symbol, lo, hi, vol;".format(
+                    s=BASE_TS, e=BASE_TS + 180_000))
+            rows = sorted(tuple(e.data) for e in got
+                          if e.data[0] == "A")
+            assert rows == [("A", 3.0, 9.0, 30), ("A", 7.0, 7.0, 30)]
+        finally:
+            rt.shutdown()
+            m.shutdown()
+
+    def test_per_seconds_granularity_counts(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + DEFINE + self.AGG_MM)
+        rt.start()
+        try:
+            send_trades(rt, [
+                ("A", 1.0, 1, 0),
+                ("A", 2.0, 1, 100),       # same second
+                ("A", 4.0, 1, 1_100),     # next second
+            ])
+            send_trades(rt, [("Z", 1.0, 1, 60_000)])
+            got = rt.query(
+                "from MM within {s}L, {e}L per 'seconds' "
+                "select symbol, lo, hi;".format(
+                    s=BASE_TS, e=BASE_TS + 10_000))
+            rows = sorted(tuple(e.data) for e in got if e.data[0] == "A")
+            assert rows == [("A", 1.0, 2.0), ("A", 4.0, 4.0)]
+        finally:
+            rt.shutdown()
+            m.shutdown()
