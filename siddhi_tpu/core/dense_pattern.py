@@ -262,6 +262,7 @@ class DensePatternRuntime:
         # is bit-exact exactly while this stays zero
         self._ovf_warned = 0
         self._key_rows: Dict = {}
+        self._row_keys: Dict = {}  # reverse map: engine row -> key value
         self._next_row = 0
         self._free_rows: List[int] = []
         # sorted-key index backing the vectorized intern: _key_arr is the
@@ -301,6 +302,15 @@ class DensePatternRuntime:
             return rows
         pps = self.parts_per_shard
         return (rows // pps) * (pps + 1) + (rows % pps)
+
+    def _logical_rows(self, phys: np.ndarray) -> np.ndarray:
+        """Physical state-array rows -> logical partition ids (inverse
+        of _phys_rows; scratch rows never carry armed deadlines, so
+        timer-fired rows are always real partitions)."""
+        if self._sharded is None:
+            return phys
+        rps = self.parts_per_shard + 1
+        return (phys // rps) * self.parts_per_shard + (phys % rps)
 
     def intern_keys(self, keys) -> np.ndarray:
         """Partition-key values -> dense engine row ids (stable until the
@@ -373,6 +383,8 @@ class DensePatternRuntime:
             urows[new_idx] = row_ids
             self._key_rows.update(
                 zip(uniq[new_idx].tolist(), row_ids.tolist()))
+            self._row_keys.update(
+                zip(row_ids.tolist(), uniq[new_idx].tolist()))
             # merge the (sorted) new keys into the sorted index with an
             # O(K+U) two-way merge (a full argsort of ~1M keys per batch
             # would dominate the step); dtype promotes explicitly so
@@ -421,6 +433,7 @@ class DensePatternRuntime:
                         f"@app:execution('tpu', partitions='N') or enable "
                         "@purge on the partition)")
                 rows[k] = row
+                self._row_keys[row] = k
             out[i] = row
         return out
 
@@ -471,6 +484,7 @@ class DensePatternRuntime:
         self.state = state
         for k, r in idle:
             del self._key_rows[k]
+            self._row_keys.pop(r, None)
             self._free_rows.append(r)
         self._rebuild_key_index()
         self._wake_dirty = True
@@ -634,6 +648,7 @@ class DensePatternRuntime:
                 k: jnp.asarray(v) for k, v in state["dense_state"].items()}
         self.engine.base_ts = state["base_ts"]
         self._key_rows = dict(state["key_rows"])
+        self._row_keys = {r: k for k, r in self._key_rows.items()}
         self._next_row = state.get("next_row", len(self._key_rows))
         self._free_rows = list(state.get("free_rows", []))
         rlu = state.get("row_last_used")
@@ -657,7 +672,7 @@ class DensePatternRuntime:
         if fired is None:
             return
         self.time_fires += 1
-        out, fire_ts, _rows = fired
+        out, fire_ts, rows = fired
         names = eng.output_names
         out_cols = {
             name: out[:, oi].astype(self._out_dtypes[oi])
@@ -667,6 +682,12 @@ class DensePatternRuntime:
             self.out_stream_id, names, out_cols,
             fire_ts, np.full(len(fire_ts), ev.CURRENT, dtype=np.int8),
         )
+        if self._row_keys:
+            # partitioned form: timer matches carry their partition key
+            # (reverse row->key map; partition-axis selectors need it)
+            logical = self._logical_rows(np.asarray(rows))
+            mb.aux["partition_keys"] = [
+                self._row_keys.get(int(r)) for r in logical]
         self.emit_cb(mb)
 
     def next_wakeup(self):

@@ -474,15 +474,10 @@ class QueryPlanner:
                 select_override=(select_vars, select_names),
                 builder=builder)
             if partitioned:
-                if getattr(engine, "has_deadlines", False):
-                    # timer-fired matches carry no partition-key side
-                    # channel (no triggering batch) — keep absent +
-                    # aggregating + partitioned on host instances
-                    raise SiddhiAppCreationError(
-                        "dense path: partitioned aggregating absent "
-                        "patterns — host instances used")
                 # ONE shared selector keeps per-(key, group) state via
-                # the partition-key side channel on match rows
+                # the partition-key side channel on match rows (timer
+                # matches map engine rows back through the runtime's
+                # reverse row->key map)
                 selector.partition_axis = True
         else:
             engine = build_dense_engine(

@@ -397,3 +397,59 @@ class TestAbsentSnapshotDense:
             assert got == [([F56], 2000)]
         finally:
             m.shutdown()
+
+
+class TestPartitionedAggregatingAbsent:
+    """Absent + aggregating selector + partitioned, all dense: timer
+    matches map engine rows back to their partition keys so the shared
+    partition-axis selector aggregates per key."""
+
+    APP = (
+        STREAMS + TICK_SINK +
+        "partition with (symbol of Stream1, symbol of Stream2) begin "
+        "@info(name='q') from every e1=Stream1[price>20] -> "
+        "not Stream2[price>e1.price] for 1 sec "
+        "select count() as n insert into OutputStream; "
+        "end;"
+    )
+
+    def _drive(self, header, sends):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback " + header + self.APP)
+            got = []
+            rt.add_callback(
+                "OutputStream",
+                lambda evs: got.extend(list(e.data) for e in evs))
+            rt.start()
+            for stream, row, ts in sends:
+                rt.get_input_handler(stream).send(row, timestamp=ts)
+            pr = rt.partitions.get("partition_0")
+            runtime = (next(iter(pr.dense_query_runtimes.values()))
+                       .pattern_processor
+                       if pr is not None and getattr(pr, "is_dense", False)
+                       else None)
+            rt.shutdown()
+            return got, runtime
+        finally:
+            m.shutdown()
+
+    def test_per_key_counts_from_timer_matches(self):
+        sends = [
+            ("Stream1", ["a", 30.0, 1], 1000),   # a deadline 2000
+            ("Stream1", ["b", 40.0, 1], 1200),   # b deadline 2200
+            ("Stream2", ["b", 50.0, 1], 1500),   # kills b's arm
+            ("Tick", [1], 3000),                  # fires a
+            ("Stream1", ["a", 35.0, 1], 3500),   # a deadline 4500
+            ("Tick", [2], 5000),                  # fires a again
+        ]
+        host, hproc = self._drive("", sends)
+        dense, dproc = self._drive(
+            "@app:execution('tpu', partitions='16') ", sends)
+        assert hproc is None
+        assert isinstance(dproc, DensePatternRuntime)
+        assert dproc.engine.has_deadlines
+        assert dproc.time_fires >= 2
+        # per-key count: a fires twice (n=1, n=2); b never fires
+        assert dense == host == [[1], [2]]
