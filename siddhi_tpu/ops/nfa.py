@@ -658,8 +658,17 @@ class PatternProcessor:
             # dual-pending path but which could not use the event itself
             # dies (reference: resetState clears all pendings each event;
             # only addState'd instances survive — the peak-detection
-            # corpus SequenceTestCase.testQuery20 pins the restart)
-            if self.mode == "sequence" and not captured and not was_virgin and inst.alive:
+            # corpus SequenceTestCase.testQuery20 pins the restart).
+            # Arms WAITING at an absent node are immune: the waiting
+            # state consumes no events, and only a filter-matching
+            # absent-stream event (the violation above) or the timer may
+            # resolve it (AbsentSequenceTestCase.testQueryAbsent4/13)
+            at_absent = (
+                inst.pos < len(self.nodes)
+                and self.nodes[inst.pos].kind == "absent"
+            )
+            if (self.mode == "sequence" and not captured and not was_virgin
+                    and inst.alive and not at_absent):
                 inst.alive = False
 
         self.instances = [i for i in self.instances if i.alive]
@@ -704,6 +713,25 @@ class PatternProcessor:
                 if self._end_reachable(node.pos + 1) and node.pos not in inst.emitted_at_node:
                     inst.emitted_at_node.add(node.pos)
                     self._pend_match(inst, ts)
+                # an open count forwards ONCE into a following absent
+                # node at min-satisfaction (reference
+                # processMinCountReached / SEQUENCE addState), with
+                # SHARED capture lists so later captures are visible
+                # when the deadline fires
+                # (AbsentSequenceTestCase.testQueryAbsent36)
+                open_count = (
+                    node.max_count == ANY or node.max_count > node.min_count
+                )
+                if (
+                    open_count
+                    and node.pos + 1 < len(self.nodes)
+                    and self.nodes[node.pos + 1].kind == "absent"
+                ):
+                    fwd = Instance(node.pos + 1, ts)
+                    fwd.captured = inst.captured  # shared, not copied
+                    fwd.first_ts = inst.first_ts
+                    self._enter_node(fwd, node.pos + 1, ts)
+                    self.instances.append(fwd)
             if node.max_count != ANY and inst.count >= node.max_count:
                 # node full: move on (enter may cascade emits for min-0 tails)
                 self._enter_node(inst, node.pos + 1, ts)

@@ -1,0 +1,230 @@
+"""Sequence-absent conformance, ported from the reference's
+AbsentSequenceTestCase.java (modules/siddhi-core/src/test/java/io/
+siddhi/core/query/sequence/absent/): `not X for t` inside strict
+sequences — trailing, leading and mid-chain absence, interaction with
+logical nodes and Kleene counts.  Thread.sleep gaps become playback
+timestamp gaps; expectations are the reference's event counts/rows.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STREAMS = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+    "define stream Stream3 (symbol string, price float, volume int); "
+    "define stream Stream4 (symbol string, price float, volume int); "
+    "define stream Tick (x int); "
+)
+TICK_SINK = "from Tick select x insert into IgnoredTicks; "
+
+
+def run(query, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + STREAMS + TICK_SINK + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestTrailingAbsentSequence:
+    Q = ("@info(name='q') from e1=Stream1[price>20], "
+         "not Stream2[price>e1.price] for 1 sec "
+         "select e1.symbol as symbol1 insert into OutputStream;")
+
+    def test_fires_when_nothing_arrives(self):
+        # testQueryAbsent1
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_late_event_does_not_cancel(self):
+        # testQueryAbsent2: Stream2 after the window
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 2100),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_matching_event_within_window_cancels(self):
+        # testQueryAbsent3
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 1100),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+    def test_non_matching_event_keeps_waiting(self):
+        # testQueryAbsent4: filter fails (50.7 < 55.6) — still fires
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 50.7, 100], 1100),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_kleene_plus_then_absent(self):
+        # testQueryAbsent36: e1+ keeps collecting, then absence fires
+        q = ("@info(name='q') from e1=Stream1[price>10]+, "
+             "not Stream2[price>20] for 1 sec "
+             "select e1[0].symbol as s0, e1[1].symbol as s1, "
+             "e1[2].symbol as s2, e1[3].symbol as s3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["ORACLE", 25.0, 100], 1000),
+            ("Stream1", ["WSO2", 35.0, 100], 1100),
+            ("Stream1", ["IBM", 45.0, 100], 1200),
+            ("Tick", [1], 2500),
+        ])
+        assert len(got) == 1
+
+
+class TestLeadingAbsentSequence:
+    Q = ("@info(name='q') from not Stream1[price>20] for 1 sec, "
+         "e2=Stream2[price>30] "
+         "select e2.symbol as symbol insert into OutputStream;")
+
+    def test_fires_after_silent_window(self):
+        # testQueryAbsent5: nothing on Stream1 for 1s, then e2
+        got = run(self.Q, [
+            ("Tick", [1], 2200),
+            ("Stream2", ["IBM", 58.7, 100], 2300),
+        ])
+        assert got == [["IBM"]]
+
+    def test_event_during_window_blocks(self):
+        # testQueryAbsent8-style: matching Stream1 inside the window
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1100),
+            ("Stream2", ["IBM", 58.7, 100], 1200),
+        ])
+        assert got == []
+
+    def test_e2_before_window_elapses_blocks(self):
+        # testQueryAbsent27: e2 arrives before the 1s silence completes
+        got = run(self.Q, [
+            ("Stream2", ["IBM", 58.7, 100], 500),
+        ])
+        assert got == []
+
+    def test_non_matching_stream1_event_ok(self):
+        # testQueryAbsent17: a Stream1 event FAILING the filter arrives
+        # DURING the silence window (deadline = start + 1s = 1000) and
+        # doesn't violate the absence
+        got = run(self.Q.replace("price>20", "price>10"), [
+            ("Stream1", ["WSO2", 5.6, 100], 500),
+            ("Stream2", ["IBM", 58.7, 100], 1100),
+        ])
+        assert got == [["IBM"]]
+
+    def test_sequence_not_restarted_once_blocked(self):
+        # testQueryAbsent6: violation during the first window kills the
+        # non-every sequence permanently
+        got = run(self.Q.replace("price>20", "price>10"), [
+            ("Stream1", ["WSO2", 59.6, 100], 1100),
+            ("Stream2", ["IBM", 58.7, 100], 3200),
+        ])
+        assert got == []
+
+
+class TestMidChainAbsentSequence:
+    Q = ("@info(name='q') from e1=Stream1[price>10], "
+         "not Stream2[price>20] for 1 sec, e3=Stream3[price>30] "
+         "select e1.symbol as symbol1, e3.symbol as symbol3 "
+         "insert into OutputStream;")
+
+    def test_waits_out_window_then_third(self):
+        # testQueryAbsent12
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.6, 100], 1000),
+            ("Tick", [1], 2100),
+            ("Stream3", ["GOOGLE", 55.7, 100], 2200),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_non_matching_absent_event_keeps_chain(self):
+        # testQueryAbsent13
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.6, 100], 1000),
+            ("Stream2", ["IBM", 8.7, 100], 1100),
+            ("Tick", [1], 2200),
+            ("Stream3", ["GOOGLE", 55.7, 100], 2300),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_violation_kills_chain(self):
+        # testQueryAbsent14/38
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.6, 100], 1000),
+            ("Stream2", ["IBM", 28.7, 100], 1100),
+            ("Tick", [1], 2300),
+            ("Stream3", ["GOOGLE", 55.7, 100], 2400),
+        ])
+        assert got == []
+
+    def test_absent_then_logical_and(self):
+        # testQueryAbsent28
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "not Stream2[price>20] for 1 sec, "
+             "e2=Stream3[price>30] and e3=Stream4[price>40] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["IBM", 18.7, 100], 1000),
+            ("Tick", [1], 2200),
+            ("Stream3", ["WSO2", 35.0, 100], 2300),
+            ("Stream4", ["GOOGLE", 56.86, 100], 2400),
+        ])
+        assert got == [["IBM", "WSO2", "GOOGLE"]]
+
+    def test_absent_then_logical_or_either_side(self):
+        # testQueryAbsent30/31
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "not Stream2[price>20] for 1 sec, "
+             "e2=Stream3[price>30] or e3=Stream4[price>40] "
+             "select e1.symbol as symbol1, e2.symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["IBM", 18.7, 100], 1000),
+            ("Tick", [1], 2200),
+            ("Stream3", ["WSO2", 35.0, 100], 2300),
+        ])
+        assert got == [["IBM", "WSO2", None]]
+        got = run(q, [
+            ("Stream1", ["IBM", 18.7, 100], 1000),
+            ("Tick", [1], 2200),
+            ("Stream4", ["GOOGLE", 56.86, 100], 2300),
+        ])
+        assert got == [["IBM", None, "GOOGLE"]]
+
+    def test_trailing_absent_after_three_states(self):
+        # testQueryAbsent19/20
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "e2=Stream2[price>20], e3=Stream3[price>30], "
+             "not Stream4[price>40] for 1 sec "
+             "select e1.symbol as symbol1, e2.symbol as symbol2, "
+             "e3.symbol as symbol3 insert into OutputStream;")
+        base = [
+            ("Stream1", ["WSO2", 15.6, 100], 1000),
+            ("Stream2", ["IBM", 28.7, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.7, 100], 1200),
+        ]
+        got = run(q, base + [("Tick", [1], 2500)])
+        assert got == [["WSO2", "IBM", "GOOGLE"]]
+        got = run(q, base + [
+            ("Stream4", ["ORACLE", 44.7, 100], 1300),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
