@@ -383,6 +383,13 @@ class DensePatternEngine:
                     "overflow the int32 relative-time deadline — host "
                     "engine used")
             if n.kind == "logical":
+                if n.logical_op == "or":
+                    # the or-absent race (violation disables one branch,
+                    # deadline completes with null present sides) stays
+                    # on the host engine
+                    raise SiddhiAppCreationError(
+                        "dense NFA: 'or' with an absent side needs the "
+                        "host engine")
                 present_keys = {sp.stream_key for sp in n.specs
                                 if not sp.is_absent}
                 absent_keys = {sp.stream_key for sp in n.specs

@@ -385,8 +385,14 @@ class NFABuilder:
                 if isinstance(side, CountStateElement):
                     raise SiddhiAppCreationError("count states inside logical and/or are not supported")
                 sides.append(self._make_spec(side))
-            if el.operator == "or" and any(s.is_absent for s in sides):
-                raise SiddhiAppCreationError("'or' with absent states is not supported yet")
+            if el.operator == "or" and any(
+                s.is_absent and s.waiting_ms is None for s in sides
+            ):
+                # `not B or C` without a 'for' window can never complete
+                # via the absent branch; the reference only supports the
+                # timed race (`not B for t or C`)
+                raise SiddhiAppCreationError(
+                    "'or' with an absent state needs a 'for' duration")
             return Node(pos=pos, kind="logical", specs=sides, logical_op=el.operator)
         if isinstance(el, AbsentStreamStateElement):
             spec = self._make_spec(el)
@@ -651,7 +657,18 @@ class PatternProcessor:
                         and s.stream_key == stream_key
                         and self._filter_pass(s, inst, row, ts)
                     ):
-                        inst.alive = False
+                        if (
+                            node.kind == "logical"
+                            and node.logical_op == "or"
+                            and any(not sp.is_absent for sp in node.specs)
+                        ):
+                            # `not B for t or C`: B only disables the
+                            # absent branch — C may still win the race
+                            # (LogicalAbsentPatternTestCase.
+                            # testQueryAbsent15/16)
+                            inst.violated = True
+                        else:
+                            inst.alive = False
                         used = True
             # strict continuity for sequences: only a CAPTURE keeps an
             # instance alive — an arm whose clone advanced via the
@@ -892,8 +909,19 @@ class PatternProcessor:
                     self._pend_match(inst, fire_ts)
                 else:
                     self._enter_node(inst, node.pos + 1, fire_ts)
-            elif node.kind == "logical" and self._logical_complete(node, inst):
-                self._complete_logical(inst, node, fire_ts)
+            elif node.kind == "logical":
+                if self._logical_complete(node, inst):
+                    self._complete_logical(inst, node, fire_ts)
+                elif (
+                    node.logical_op == "or"
+                    and not inst.violated
+                    and any(s.is_absent for s in node.specs)
+                ):
+                    # `not B for t or C`: the absence window passed
+                    # unviolated before any present side matched — the
+                    # absent branch wins with null present captures
+                    # (LogicalAbsentPatternTestCase.testQueryAbsent13)
+                    self._complete_logical(inst, node, fire_ts)
         self._flush_matches()
 
     def next_wakeup(self) -> Optional[int]:

@@ -1,0 +1,190 @@
+"""Logical-absent pattern conformance, ported from the reference's
+LogicalAbsentPatternTestCase.java (modules/siddhi-core/src/test/java/
+io/siddhi/core/query/pattern/absent/): `and not` / `or not` with and
+without `for` windows — including the or-race where a violation only
+disables the absent branch and an unviolated window completes with
+null present captures.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STREAMS = (
+    "define stream Stream1 (symbol string, price float, volume int); "
+    "define stream Stream2 (symbol string, price float, volume int); "
+    "define stream Stream3 (symbol string, price float, volume int); "
+    "define stream Tick (x int); "
+)
+TICK_SINK = "from Tick select x insert into IgnoredTicks; "
+
+
+def run(query, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + STREAMS + TICK_SINK + query)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        for stream, row, ts in sends:
+            rt.get_input_handler(stream).send(row, timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+class TestAndNotUntimed:
+    Q = ("@info(name='q') from e1=Stream1[price>10] -> "
+         "not Stream2[price>20] and e3=Stream3[price>30] "
+         "select e1.symbol as symbol1, e3.symbol as symbol3 "
+         "insert into OutputStream;")
+
+    def test_completes_on_present_side(self):
+        # testQueryAbsent1
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_absent_event_blocks(self):
+        # testQueryAbsent2
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+        ])
+        assert got == []
+
+    def test_leading_and_not(self):
+        # testQueryAbsent3/4
+        q = ("@info(name='q') from not Stream1[price>10] and "
+             "e2=Stream2[price>20] -> e3=Stream3[price>30] "
+             "select e2.symbol as symbol2, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream2", ["IBM", 25.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["IBM", "GOOGLE"]]
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+        ])
+        assert got == []
+
+
+class TestAndNotTimed:
+    Q = ("@info(name='q') from e1=Stream1[price>10] -> "
+         "not Stream2[price>20] for 1 sec and e3=Stream3[price>30] "
+         "select e1.symbol as symbol1, e3.symbol as symbol3 "
+         "insert into OutputStream;")
+
+    def test_present_after_window_completes(self):
+        # testQueryAbsent5
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 2200),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_present_inside_window_defers_to_deadline(self):
+        # testQueryAbsent5_1
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1500),
+            ("Tick", [1], 2700),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_violation_blocks_and(self):
+        # testQueryAbsent7
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+            ("Tick", [1], 3500),
+        ])
+        assert got == []
+
+
+class TestOrNotTimed:
+    Q = ("@info(name='q') from e1=Stream1[price>10] -> "
+         "not Stream2[price>20] for 1 sec or e3=Stream3[price>30] "
+         "select e1.symbol as symbol1, e3.symbol as symbol3 "
+         "insert into OutputStream;")
+
+    def test_present_side_wins_inside_window(self):
+        # testQueryAbsent11
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_absent_branch_wins_on_silence(self):
+        # testQueryAbsent13: deadline passes with no e3 — null capture
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [["WSO2", None]]
+
+    def test_no_fire_before_deadline(self):
+        # testQueryAbsent14
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Tick", [1], 1100),
+        ])
+        assert got == []
+
+    def test_violation_leaves_present_branch_alive(self):
+        # testQueryAbsent15: B disables the absent branch; C still wins
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+            ("Tick", [1], 3500),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_violation_then_silence_never_fires(self):
+        # testQueryAbsent16
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+    def test_dense_mode_falls_back_and_matches(self):
+        # or-absent stays on the host engine under execution('tpu');
+        # output must be identical
+        from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+        sends = [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Tick", [1], 2500),
+        ]
+        host = run(self.Q, sends)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') "
+                + STREAMS + TICK_SINK + self.Q)
+            got = []
+            rt.add_callback(
+                "OutputStream",
+                lambda evs: got.extend(list(e.data) for e in evs))
+            rt.start()
+            for stream, row, ts in sends:
+                rt.get_input_handler(stream).send(row, timestamp=ts)
+            proc = rt.query_runtimes["q"].pattern_processor
+            assert not isinstance(proc, DensePatternRuntime)
+            rt.shutdown()
+            assert got == host == [["WSO2", None]]
+        finally:
+            m.shutdown()
