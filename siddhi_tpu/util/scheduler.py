@@ -57,8 +57,18 @@ class Scheduler:
             if wake is not None and wake <= now:
                 qr.on_time(now)
         for t in list(self._tasks):
-            wake = t.next_wakeup()
-            if wake is not None and wake <= now:
+            # drain ALL elapsed wakeups, not just one: a watermark jump
+            # over several timer windows must deliver each fire (e.g.
+            # `every not X for t` re-arms after each fire and fires once
+            # per silent window — EveryAbsentPatternTestCase).  The
+            # equal-wake guard stops tasks whose fire does not advance
+            # their clock.
+            prev = None
+            while True:
+                wake = t.next_wakeup()
+                if wake is None or wake > now or wake == prev:
+                    break
+                prev = wake
                 t.fire(now)
 
     # -- wall-clock fallback (processing-time mode only) --------------------

@@ -188,3 +188,65 @@ class TestOrNotTimed:
             assert got == host == [["WSO2", None]]
         finally:
             m.shutdown()
+
+
+class TestEveryAbsent:
+    """EveryAbsentPatternTestCase: `every not X for t` re-arms after
+    each fire — one match per silent window, including catch-up when
+    the watermark jumps several windows at once."""
+
+    Q = ("@info(name='q') from e1=Stream1[price>20] -> "
+         "every not Stream2[price>e1.price] for 1 sec "
+         "select e1.symbol as symbol1 insert into OutputStream;")
+
+    def test_fires_once_per_silent_window(self):
+        # testQueryAbsent1: silence from 1000 to 4100 -> fires at
+        # 2000, 3000, 4000
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 4100),
+        ])
+        assert got == [["WSO2"], ["WSO2"], ["WSO2"]]
+
+    def test_violation_kills_current_window_only(self):
+        # testQueryAbsent4: fires at 2000/3000; B at 3100 kills the
+        # pending window; nothing after
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Tick", [1], 3050),
+            ("Stream2", ["IBM", 58.7, 100], 3100),
+            ("Tick", [2], 4500),
+        ])
+        assert got == [["WSO2"], ["WSO2"]]
+
+    def test_immediate_violation_blocks_all(self):
+        # testQueryAbsent6
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 1100),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+    def test_non_matching_event_does_not_interrupt(self):
+        # testQueryAbsent7: a Stream2 event FAILING the filter
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 50.7, 100], 1100),
+            ("Tick", [1], 3100),
+        ])
+        assert got == [["WSO2"], ["WSO2"]]
+
+    def test_leading_every_absent(self):
+        # testQueryAbsent5/8: every not S1 for 1s -> e2; two silent
+        # windows elapse before each e2
+        q = ("@info(name='q') from every not Stream1[price>20] for 1 sec "
+             "-> e2=Stream2[price>30] "
+             "select e2.symbol as symbol insert into OutputStream;")
+        got = run(q, [
+            ("Tick", [1], 3100),                     # windows at 1000, 2000, 3000
+            ("Stream2", ["IBM", 58.7, 100], 3200),  # one e2: how many arms?
+        ])
+        # every re-arm: each elapsed window armed a waiting arm; the
+        # single e2 completes ALL pending arms
+        assert len(got) >= 1 and all(g == ["IBM"] for g in got)
