@@ -228,3 +228,76 @@ class TestMidChainAbsentSequence:
             ("Tick", [1], 2500),
         ])
         assert got == []
+
+
+class TestEveryAbsentSequence:
+    """EveryAbsentSequenceTestCase: `every not X for t` leading a strict
+    sequence — re-arming silence windows feeding the next state."""
+
+    Q = ("@info(name='q') from every not Stream1[price>20] for 1 sec, "
+         "e2=Stream2[price>30] "
+         "select e2.symbol as symbol insert into OutputStream;")
+
+    def test_two_matches_across_rearm(self):
+        # testQueryAbsent2: silence windows complete before each e2
+        got = run(self.Q, [
+            ("Tick", [1], 2200),
+            ("Stream2", ["IBM", 58.7, 100], 2300),
+            ("Tick", [2], 3500),
+            ("Stream2", ["WSO2", 68.7, 100], 3600),
+        ])
+        assert got == [["IBM"], ["WSO2"]]
+
+    def test_violation_then_silent_window_recovers(self):
+        # testQueryAbsent3: the every re-arms after the violated window
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 59.6, 100], 1000),
+            ("Tick", [1], 3100),
+            ("Stream2", ["IBM", 58.7, 100], 3200),
+        ])
+        assert got == [["IBM"]]
+
+    def test_continuous_violations_block(self):
+        # testQueryAbsent4: a matching Stream1 event every 500ms keeps
+        # every window violated
+        got = run(self.Q.replace("price>20", "price>10"), [
+            ("Stream1", ["WSO2", 25.6, 100], 1000),
+            ("Stream1", ["WSO2", 25.6, 100], 1500),
+            ("Stream1", ["WSO2", 25.6, 100], 2000),
+            ("Stream2", ["IBM", 58.7, 100], 2500),
+        ])
+        assert got == []
+
+    def test_e2_before_any_window_completes_blocks(self):
+        # testQueryAbsent5-style
+        got = run(self.Q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 58.7, 100], 1100),
+        ])
+        assert got == []
+
+    def test_three_state_after_silence(self):
+        # testQueryAbsent8
+        q = ("@info(name='q') from every not Stream1[price>10] for 1 sec, "
+             "e2=Stream2[price>20], e3=Stream3[price>30] "
+             "select e2.symbol as symbol2, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Tick", [1], 2100),
+            ("Stream2", ["IBM", 28.7, 100], 2200),
+            ("Stream3", ["GOOGLE", 55.7, 100], 2300),
+        ])
+        assert got == [["IBM", "GOOGLE"]]
+
+    def test_violation_mid_chain_blocks(self):
+        # testQueryAbsent7: Stream1 violates during the leading window
+        q = ("@info(name='q') from every not Stream1[price>10] for 1 sec, "
+             "e2=Stream2[price>20], e3=Stream3[price>30] "
+             "select e2.symbol as symbol2, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.6, 100], 1000),
+            ("Stream2", ["IBM", 28.7, 100], 1100),
+            ("Stream3", ["GOOGLE", 55.7, 100], 1200),
+        ])
+        assert got == []
