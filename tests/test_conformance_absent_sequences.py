@@ -301,3 +301,87 @@ class TestEveryAbsentSequence:
             ("Stream3", ["GOOGLE", 55.7, 100], 1200),
         ])
         assert got == []
+
+
+class TestLogicalAbsentSequence:
+    """LogicalAbsentSequenceTestCase: and-not / or-not nodes inside
+    strict sequences (untimed and timed)."""
+
+    def test_and_not_untimed(self):
+        # testQueryAbsent1/2
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "not Stream2[price>20] and e3=Stream3[price>30] "
+             "select e1.symbol as symbol1, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+        ])
+        assert got == []
+
+    def test_leading_and_not_untimed(self):
+        # testQueryAbsent3/4
+        q = ("@info(name='q') from not Stream1[price>10] and "
+             "e2=Stream2[price>20], e3=Stream3[price>30] "
+             "select e2.symbol as symbol2, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream2", ["IBM", 25.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["IBM", "GOOGLE"]]
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream2", ["IBM", 25.0, 100], 1100),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1200),
+        ])
+        assert got == []
+
+    def test_and_not_timed_waits_window(self):
+        # testQueryAbsent5/6
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "not Stream2[price>20] for 1 sec and e3=Stream3[price>30] "
+             "select e1.symbol as symbol1, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 2200),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
+
+    def test_leading_and_not_timed(self):
+        # testQueryAbsent8/9: silence must elapse BEFORE e2
+        q = ("@info(name='q') from not Stream1[price>10] for 1 sec and "
+             "e2=Stream2[price>20], e3=Stream3[price>30] "
+             "select e2.symbol as symbol2, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Tick", [1], 2100),
+            ("Stream2", ["IBM", 25.0, 100], 2200),
+            ("Stream3", ["GOOGLE", 35.0, 100], 2300),
+        ])
+        assert got == [["IBM", "GOOGLE"]]
+        # e2 inside the window: e3 kills the incomplete arm
+        got = run(q, [
+            ("Stream2", ["IBM", 25.0, 100], 500),
+            ("Stream3", ["GOOGLE", 35.0, 100], 600),
+        ])
+        assert got == []
+
+    def test_or_not_timed_present_wins(self):
+        # testQueryAbsent11/12
+        q = ("@info(name='q') from e1=Stream1[price>10], "
+             "not Stream2[price>20] for 1 sec or e3=Stream3[price>30] "
+             "select e1.symbol as symbol1, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 15.0, 100], 1000),
+            ("Stream3", ["GOOGLE", 35.0, 100], 1100),
+        ])
+        assert got == [["WSO2", "GOOGLE"]]
