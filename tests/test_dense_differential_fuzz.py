@@ -128,6 +128,16 @@ SHAPES = {
         "@info(name='q') from every a=S[v > 10.0] -> b=S[v > a.v] "
         "within 3 sec select a.v as av, sum(b.v) as t "
         "group by a.v having t > 20.0 insert into Alerts;"),
+    # absent deadlines fire from the jitted timer step; the randomized
+    # stream's watermark advances drive both engines' schedulers
+    "trailing_absent": (
+        "@info(name='q') from every a=S[v > 12.0] -> "
+        "not S[v > a.v] for 500 millisec "
+        "select a.v as av insert into Alerts;"),
+    "mid_chain_absent": (
+        "@info(name='q') from every a=S[v > 14.0] -> "
+        "not S[v > a.v] for 400 millisec -> c=S[v < 5.0] "
+        "select a.v as av, c.v as cv insert into Alerts;"),
 }
 
 
