@@ -251,7 +251,36 @@ def bench_host_baseline():
         m.shutdown()
 
 
+def _probe_backend(timeout_s: int = 120) -> bool:
+    """Initialize the jax backend in a SUBPROCESS with a timeout: the
+    tunneled axon device can go down in a way that hangs backend init
+    forever (observed: make_c_api_client blocking indefinitely), which
+    would hang the whole bench run.  Returns False when unreachable."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        r = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if not _probe_backend():
+        # one JSON line even when the chip is unreachable, so the
+        # driver records the outage instead of timing out
+        print(json.dumps({
+            "metric": "pattern_match_events_per_sec_per_chip",
+            "value": 0,
+            "unit": "events/s",
+            "vs_baseline": 0,
+            "error": "device backend unreachable (tunnel down); "
+                     "bench skipped",
+        }))
+        return
     kernel = bench_kernel()
     product = bench_product()
     host = bench_host_baseline()
