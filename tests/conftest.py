@@ -19,6 +19,20 @@ os.environ["JAX_NUM_CPU_DEVICES"] = "8"
 
 import jax  # noqa: E402
 
+# Plugin backends (the tunneled device) can initialize during backends()
+# even under JAX_PLATFORMS=cpu via get_backend hooks; a downed remote
+# endpoint makes that init hang forever.  Tests are CPU-only by
+# contract, so drop every non-CPU backend factory before anything
+# touches a backend (same defense as __graft_entry__.dryrun_multichip).
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
 from siddhi_tpu.parallel import ensure_virtual_devices  # noqa: E402
 
 ensure_virtual_devices(8)
