@@ -333,16 +333,32 @@ class DensePatternEngine:
         self.I = 1 if (is_sequence or not every_start) else max(int(n_instances), 1)
         if self.S > 32:
             raise SiddhiAppCreationError("dense NFA supports at most 32 chain nodes")
+        # `every` models: a rearm at node 0's completion is the standing
+        # virgin (`every e1 -> ...`); a WHOLE-CHAIN group-every
+        # (`every (e1 -> e2)`, rearm on the last node back to 0) keeps
+        # ONE arm at a time — the virgin arms only while the partition
+        # has no active instance (completion consumes the arm, expiry
+        # clears it; WithinPatternTestCase.testQuery4/6's cadence).
+        # Partial-chain groups (`every (e1->e2) -> e3`) stay on the host
+        # engine: the suffix instance keeps the partition occupied.
+        self.group_every = False
         for n in nodes:
-            if n.rearm_to is not None and not (n.pos == 0 and n.rearm_to == 0):
-                # the standing virgin models `every` only when re-arm
-                # fires at node 0's completion (`every e1 -> ...`);
-                # group-every re-arms at GROUP completion — one arm at a
-                # time, which a per-event virgin would over-arm
-                # (WithinPatternTestCase.testQuery4/6)
-                raise SiddhiAppCreationError(
-                    "dense NFA: group-scoped `every` re-arms at group "
-                    "completion — host engine used")
+            if n.rearm_to is None:
+                continue
+            if n.pos == 0 and n.rearm_to == 0:
+                continue  # standing virgin
+            if (n.pos == self.S - 1 and n.rearm_to == 0
+                    and not is_sequence
+                    and nodes[0].kind == "stream"
+                    and nodes[0].min_count == 1 and nodes[0].max_count == 1):
+                self.group_every = True
+                continue
+            raise SiddhiAppCreationError(
+                "dense NFA: partial-chain group `every` re-arms with the "
+                "suffix still pending — host engine used")
+        if self.group_every:
+            # one arm at a time: a single instance lane suffices
+            self.I = 1
         # absent states ride deadline-timer registers: a node with an
         # absent `for t` spec arms `deadline = entry_ts + t` on entry,
         # a matching absent-stream event kills the pending instance, and
@@ -605,6 +621,7 @@ class DensePatternEngine:
         node_filters = self.node_filters
         within = self.within_ms
         every_start = self.every_start
+        group_every = self.group_every
         reset_on_emit = self.reset_on_emit
         is_sequence = self.is_sequence
         out_spec = self.out_spec
@@ -680,6 +697,14 @@ class DensePatternEngine:
                 first = jnp.where(expired, 0, first)
                 if dlh[0] is not None:
                     dlh[0] = jnp.where(expired, 0, dlh[0])
+
+            # group-every virgin gating: the fresh arm may only form
+            # while the partition has NO active instance (post-expiry,
+            # pre-event state — one arm at a time, matching the host's
+            # arm-at-group-completion/expiry cadence)
+            if group_every:
+                grp_virgin_ok = ~jnp.any(
+                    a.reshape(B, -1), axis=1)[:, None]  # [B, 1]
 
             # node filters evaluated once against entry-state registers
             # (the reversed loop reads them before any same-step regs
@@ -977,6 +1002,8 @@ class DensePatternEngine:
                         no_lane = (~has_unsat & ~jnp.any(free0, axis=1)
                                    & ok_pre[s][:, 0] & valid)
                         ovf = ovf + no_lane.astype(jnp.int32)
+                    elif group_every:
+                        pending = pending | (lane0 & grp_virgin_ok)
                     else:
                         # simple start never rests: the standing virgin
                         # fires straight through lane 0 on every event

@@ -134,6 +134,11 @@ SHAPES = {
         "@info(name='q') from every a=S[v > 12.0] -> "
         "not S[v > a.v] for 500 millisec "
         "select a.v as av insert into Alerts;"),
+    "group_every": (
+        # whole-chain group-every: ONE arm at a time (virgin forms only
+        # while the partition is empty), re-armed at completion/expiry
+        "@info(name='q') from every (a=S[v > 8.0] -> b=S[v > a.v]) "
+        "within 2 sec select a.v as av, b.v as bv insert into Alerts;"),
     "mid_chain_absent": (
         "@info(name='q') from every a=S[v > 14.0] -> "
         "not S[v > a.v] for 400 millisec -> c=S[v < 5.0] "

@@ -467,3 +467,34 @@ class TestPartitionedAggregatingPurge:
         rt = manager.create_siddhi_app_runtime(app)
         pr = rt.partitions.get("partition_0")
         assert pr is not None and not getattr(pr, "is_dense", False)
+
+
+class TestGroupEveryDense:
+    def test_whole_chain_group_every_lowers(self, manager):
+        # `every (e1 -> e2)`: one arm at a time, re-armed at completion
+        # and after within-expiry (WithinPatternTestCase.testQuery4/6)
+        app = TPU + (
+            "define stream T (v double, w long); "
+            "@info(name='q') from every (a=T[v > 1.0] -> "
+            "b=T[w == a.w]) within 5 sec "
+            "select a.v as av, b.v as bv insert into Alerts;")
+        rt, got = run_app(manager, app, [
+            ([5.0, 7], 1000),
+            ([6.0, 7], 7000),    # first arm expired; fresh arm
+            ([7.0, 7], 7500),    # completes (6, 7)
+            ([8.0, 7], 7510),    # new arm
+        ], stream="T")
+        proc = rt.query_runtimes["q"].pattern_processor
+        assert isinstance(proc, DensePatternRuntime)
+        assert proc.engine.group_every and proc.engine.I == 1
+        assert got == [[6.0, 7.0]]
+
+    def test_partial_chain_group_every_falls_back(self, manager):
+        app = TPU + (
+            "define stream T (v double, w long); "
+            "@info(name='q') from every (a=T[v > 1.0] -> b=T[v > a.v]) "
+            "-> c=T[v > b.v] "
+            "select a.v as av, c.v as cv insert into Alerts;")
+        rt = manager.create_siddhi_app_runtime(app)
+        assert not isinstance(
+            rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
