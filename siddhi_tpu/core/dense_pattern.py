@@ -13,10 +13,12 @@ BASELINE.json).  The planner attempts dense lowering for every
 pattern/sequence query and falls back to the host engine — logging the
 reason — when the query needs semantics outside the dense subset
 (leading/sequence absent states, optional min-0 nodes, >32 nodes,
-non-numeric captures/filters/selects, ...).  Mid-chain and trailing
-absent states (`not X for t`) run densely via per-instance deadline
-registers and a jitted timer step driven by the app scheduler
-(``DensePatternRuntime.on_time``).  Overlapping `every` arms
+non-numeric captures/filters/selects, partial-chain group-every, ...).
+Mid-chain and trailing absent states (`not X for t`) run densely via
+per-instance deadline registers and a jitted timer step driven by the
+app scheduler (``DensePatternRuntime.on_time``); whole-chain
+group-every (`every (e1 -> e2)`) runs densely with an
+arm-when-empty virgin.  Overlapping `every` arms
 run independently on the engine's instance axis (up to
 ``@app:execution('tpu', instances='N')`` per (partition, node), default
 4); instances dropped when every successor lane is full are counted in
