@@ -385,3 +385,50 @@ class TestLogicalAbsentSequence:
             ("Stream3", ["GOOGLE", 35.0, 100], 1100),
         ])
         assert got == [["WSO2", "GOOGLE"]]
+
+
+class TestAbsentWithEverySequence:
+    """AbsentWithEverySequenceTestCase: `every e1, not X for t` — the
+    sequence's single-pending-per-state rule drops later arms while one
+    waits at the absent node."""
+
+    def test_single_pending_fires_once(self):
+        # testQuery1: GOOG's arm is dropped (WSO2's already waiting);
+        # one fire at WSO2's deadline
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "not Stream2[price>e1.price] for 1 sec "
+             "select e1.symbol as symbol insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 55.6, 100], 1100),
+            ("Tick", [1], 2500),
+        ])
+        assert got == [["WSO2"]]
+
+    def test_violation_kills_single_pending(self):
+        # testQuery2
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "not Stream2[price>e1.price] for 1 sec "
+             "select e1.symbol as symbol insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 55.6, 100], 1100),
+            ("Stream2", ["IBM", 55.7, 100], 1200),
+            ("Tick", [1], 2500),
+        ])
+        assert got == []
+
+    def test_waits_out_then_third_state(self):
+        # testQuery3
+        q = ("@info(name='q') from every e1=Stream1[price>20], "
+             "not Stream2[price>e1.price] for 1 sec, "
+             "e3=Stream3[price>e1.price] "
+             "select e1.symbol as symbol1, e3.symbol as symbol3 "
+             "insert into OutputStream;")
+        got = run(q, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream1", ["GOOG", 55.6, 100], 1100),
+            ("Tick", [1], 2300),
+            ("Stream3", ["IBM", 55.7, 100], 2400),
+        ])
+        assert got == [["WSO2", "IBM"]]
