@@ -121,3 +121,54 @@ class TestDeviceDifferentialSweep:
              "@info(name='q2') from Mid#window.length(4) "
              "select k, sum(v) as total group by k insert into O;")
         differential(q, mk_sends())
+
+
+class TestDeviceQueryFuzz:
+    """Seeded random (filter, window, selector) combinations — each
+    (shape, seed) pair pins the device engine against the host across
+    thousands of window transitions."""
+
+    WINDOWS = ["", "#window.length({n})", "#window.lengthBatch({n})",
+               "#window.time({t} sec)", "#window.timeBatch({t} sec)"]
+    SELECTS = [
+        "k, v",
+        "sum(v) as s, count() as c",
+        "k, sum(v) as s group by k",
+        "k, avg(v) as a, min(v) as mn, max(v) as mx group by k",
+    ]
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_combination(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        win = self.WINDOWS[rng.integers(0, len(self.WINDOWS))].format(
+            n=int(rng.integers(2, 7)), t=int(rng.integers(1, 3)))
+        sel = self.SELECTS[rng.integers(0, len(self.SELECTS))]
+        if "Batch" in win and "(" not in sel:
+            # tumbling device queries reduce per flush: select items may
+            # reference only group keys and aggregates (documented
+            # eligibility) — pair batch windows with aggregating selects
+            sel = self.SELECTS[1 + rng.integers(0, len(self.SELECTS) - 1)]
+        thr = float(rng.integers(10, 80))
+        filt = f"[v > {thr}]" if rng.integers(0, 2) else ""
+        q = (DEFS + f"@info(name='q') from S{filt}{win} "
+             f"select {sel} insert into O;")
+        sends = mk_sends(60, seed=200 + seed)
+        host, _ = drive(q, sends)
+        dev, runtimes = drive("@app:execution('tpu') " + q, sends)
+        assert any(isinstance(r, DeviceQueryRuntime) for r in runtimes), (
+            f"seed {seed}: {q} did not lower")
+        batchy = "Batch" in win and "group by" in sel
+        if batchy:
+            # batch flushes order groups differently (see
+            # test_length_batch_having); compare per-row multisets
+            ha = sorted(tuple(round(x, 4) if isinstance(x, float) else x
+                              for x in r) for r in host)
+            da = sorted(tuple(round(x, 4) if isinstance(x, float) else x
+                              for x in r) for r in dev)
+            assert ha == da, f"seed {seed}: {q}"
+        else:
+            assert len(host) == len(dev), (
+                f"seed {seed}: {q}: {len(host)} vs {len(dev)}")
+            for i, (a, b) in enumerate(zip(host, dev)):
+                assert a == [pytest.approx(x, rel=1e-4, abs=1e-6)
+                             for x in b], f"seed {seed} row {i}: {a} != {b}"
