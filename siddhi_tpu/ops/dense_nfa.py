@@ -350,12 +350,18 @@ class DensePatternEngine:
             if (n.pos == self.S - 1 and n.rearm_to == 0
                     and not is_sequence
                     and nodes[0].kind == "stream"
-                    and nodes[0].min_count == 1 and nodes[0].max_count == 1):
+                    and nodes[0].min_count == 1 and nodes[0].max_count == 1
+                    and not any(sp.is_absent for nn in nodes
+                                for sp in nn.specs)):
+                # absent violations kill the host's single group arm
+                # PERMANENTLY (no re-arm); the arm-when-empty virgin
+                # would resurrect it — keep absent group-every on host
                 self.group_every = True
                 continue
             raise SiddhiAppCreationError(
-                "dense NFA: partial-chain group `every` re-arms with the "
-                "suffix still pending — host engine used")
+                "dense NFA: this group-`every` shape (partial chain, or "
+                "absent states whose violation must kill the arm "
+                "permanently) needs the host engine")
         if self.group_every:
             # one arm at a time: a single instance lane suffices
             self.I = 1

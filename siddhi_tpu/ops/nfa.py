@@ -385,14 +385,18 @@ class NFABuilder:
                 if isinstance(side, CountStateElement):
                     raise SiddhiAppCreationError("count states inside logical and/or are not supported")
                 sides.append(self._make_spec(side))
-            if el.operator == "or" and any(
-                s.is_absent and s.waiting_ms is None for s in sides
-            ):
-                # `not B or C` without a 'for' window can never complete
-                # via the absent branch; the reference only supports the
-                # timed race (`not B for t or C`)
-                raise SiddhiAppCreationError(
-                    "'or' with an absent state needs a 'for' duration")
+            if el.operator == "or" and any(s.is_absent for s in sides):
+                if any(s.is_absent and s.waiting_ms is None for s in sides):
+                    # `not B or C` without a 'for' window can never
+                    # complete via the absent branch; the reference only
+                    # supports the timed race (`not B for t or C`)
+                    raise SiddhiAppCreationError(
+                        "'or' with an absent state needs a 'for' duration")
+                if all(s.is_absent for s in sides):
+                    # two racing absences share one deadline register and
+                    # one violation kill — not representable
+                    raise SiddhiAppCreationError(
+                        "'or' of two absent states is not supported")
             return Node(pos=pos, kind="logical", specs=sides, logical_op=el.operator)
         if isinstance(el, AbsentStreamStateElement):
             spec = self._make_spec(el)

@@ -250,3 +250,56 @@ class TestEveryAbsent:
         # every re-arm: each elapsed window armed a waiting arm; the
         # single e2 completes ALL pending arms
         assert len(got) >= 1 and all(g == ["IBM"] for g in got)
+
+
+class TestOrAbsentValidation:
+    def test_double_absent_or_rejected(self):
+        # two racing absences share one deadline/violation slot — the
+        # engine rejects the shape instead of mishandling it
+        m = SiddhiManager()
+        try:
+            with pytest.raises(Exception, match="two absent states"):
+                m.create_siddhi_app_runtime(
+                    STREAMS +
+                    "@info(name='q') from e1=Stream3[price>10] -> "
+                    "not Stream1[price>10] for 1 sec or "
+                    "not Stream2[price>10] for 2 sec "
+                    "select e1.price as p insert into OutputStream;")
+        finally:
+            m.shutdown()
+
+
+class TestGroupEveryAbsentFallback:
+    def test_group_every_with_absent_stays_on_host(self):
+        # host: a violation kills the single group arm PERMANENTLY;
+        # the dense arm-when-empty virgin would resurrect it
+        from siddhi_tpu.core.dense_pattern import DensePatternRuntime
+
+        q = ("@info(name='q') from every (e1=Stream1[price>10] -> "
+             "not Stream2[price>20] for 1 sec) "
+             "select e1.price as p insert into OutputStream;")
+        sends = [
+            ("Stream1", ["A", 15.0, 1], 1000),
+            ("Stream2", ["K", 25.0, 1], 1500),   # violation kills arm
+            ("Stream1", ["B", 16.0, 1], 3000),
+            ("Tick", [1], 5000),
+        ]
+        host = run(q, sends)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') "
+                + STREAMS + TICK_SINK + q)
+            got = []
+            rt.add_callback(
+                "OutputStream",
+                lambda evs: got.extend(list(e.data) for e in evs))
+            rt.start()
+            for stream, row, ts in sends:
+                rt.get_input_handler(stream).send(row, timestamp=ts)
+            proc = rt.query_runtimes["q"].pattern_processor
+            assert not isinstance(proc, DensePatternRuntime)
+            rt.shutdown()
+            assert got == host
+        finally:
+            m.shutdown()
