@@ -170,7 +170,9 @@ class OnDemandQueryRuntime:
 
         # selector
         sel = odq.selector
-        rewriter = AggregatorRewrite(scope, self.compiler)
+        rewriter = AggregatorRewrite(
+            scope, self.compiler,
+            extensions=getattr(self.app, "extensions", None))
         items: Optional[List[SelectItem]] = None
         out_attrs: List[Attribute] = []
         if sel.is_select_all:

@@ -103,25 +103,43 @@ class AggregatorRewrite:
     variables bound to aggregation outputs (the reference instead builds
     AttributeAggregatorExecutors inline in SelectorParser)."""
 
-    def __init__(self, scope: Scope, compiler: ExpressionCompiler):
+    def __init__(self, scope: Scope, compiler: ExpressionCompiler,
+                 extensions=None):
         self.scope = scope
         self.compiler = compiler
+        self.extensions = extensions
         self.bindings: List[AggBinding] = []
 
     def rewrite(self, expr: Expression) -> Expression:
-        if isinstance(expr, FunctionCall) and expr.namespace is None and expr.name in AGGREGATOR_NAMES:
-            key = f"__agg_{len(self.bindings)}"
-            arg: Optional[CompiledExpression] = None
-            if expr.args:
-                if len(expr.args) > 1:
-                    raise SiddhiAppCreationError(f"aggregator '{expr.name}' takes one argument")
-                arg = self.compiler.compile(self.rewrite(expr.args[0]))
-            elif expr.name not in ("count",) and not expr.star:
-                raise SiddhiAppCreationError(f"aggregator '{expr.name}' needs an argument")
-            executor = make_aggregator(expr.name, arg.type if arg is not None else None)
-            self.bindings.append(AggBinding(key, executor, arg))
-            self.scope.add_bare(key, executor.return_type)
-            return Variable(attribute=key)
+        if isinstance(expr, FunctionCall):
+            is_builtin = (expr.namespace is None
+                          and expr.name in AGGREGATOR_NAMES)
+            ext = None
+            if not is_builtin and self.extensions is not None:
+                # custom AttributeAggregatorExecutor analogs registered
+                # via setExtension(..., kind='aggregator') (reference:
+                # util/extension/holder/AttributeAggregatorExtensionHolder)
+                ext = self.extensions.lookup(
+                    "aggregator", expr.name, expr.namespace)
+            if is_builtin or ext is not None:
+                key = f"__agg_{len(self.bindings)}"
+                arg: Optional[CompiledExpression] = None
+                if expr.args:
+                    if len(expr.args) > 1:
+                        raise SiddhiAppCreationError(f"aggregator '{expr.name}' takes one argument")
+                    arg = self.compiler.compile(self.rewrite(expr.args[0]))
+                elif is_builtin and expr.name not in ("count",) and not expr.star:
+                    raise SiddhiAppCreationError(f"aggregator '{expr.name}' needs an argument")
+                if ext is not None:
+                    try:
+                        executor = ext(arg.type if arg is not None else None)
+                    except TypeError:
+                        executor = ext()
+                else:
+                    executor = make_aggregator(expr.name, arg.type if arg is not None else None)
+                self.bindings.append(AggBinding(key, executor, arg))
+                self.scope.add_bare(key, executor.return_type)
+                return Variable(attribute=key)
         if isinstance(expr, ArithmeticOp):
             return ArithmeticOp(expr.op, self.rewrite(expr.left), self.rewrite(expr.right))
         if isinstance(expr, CompareOp):
@@ -741,7 +759,8 @@ class QueryPlanner:
         star_sources=None,
     ) -> Tuple[QuerySelector, StreamDefinition]:
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{qname}"
-        rewriter = AggregatorRewrite(scope, compiler)
+        rewriter = AggregatorRewrite(scope, compiler,
+                                     extensions=self.app.extensions)
 
         items: Optional[List[SelectItem]] = None
         out_attrs: List[Attribute] = []

@@ -187,3 +187,78 @@ class TestParameterValidation:
             "from S#window.anyArgs(1, 'x', v) select v insert into OutputStream;"
         )
         rt.shutdown()
+
+
+class TestCustomAggregators:
+    def test_custom_aggregator_extension(self, manager):
+        # reference: custom AttributeAggregatorExecutor extensions
+        # (util/extension/holder/AttributeAggregatorExtensionHolder);
+        # the factory receives the argument type and implements the
+        # AggExecutor run protocol
+        import numpy as np
+
+        from siddhi_tpu.ops.aggregators import AggExecutor
+
+        class GeoMean(AggExecutor):
+            return_type = AttrType.DOUBLE
+
+            def __init__(self, arg_type=None):
+                pass
+
+            def new_state(self):
+                return {"logsum": 0.0, "n": 0}
+
+            def add_run(self, state, values):
+                logs = np.log(values.astype(np.float64))
+                cum = state["logsum"] + np.cumsum(logs)
+                ns = state["n"] + np.arange(1, len(values) + 1)
+                state["logsum"] = cum[-1] if len(cum) else state["logsum"]
+                state["n"] += len(values)
+                return np.exp(cum / ns)
+
+            def remove_run(self, state, values):
+                logs = np.log(values.astype(np.float64))
+                cum = state["logsum"] - np.cumsum(logs)
+                ns = state["n"] - np.arange(1, len(values) + 1)
+                state["logsum"] = cum[-1] if len(cum) else state["logsum"]
+                state["n"] -= len(values)
+                return np.exp(cum / np.maximum(ns, 1))
+
+        manager.set_extension("custom:geoMean", GeoMean, kind="aggregator")
+        got = run(manager,
+                  "define stream S (v double); "
+                  "from S select custom:geoMean(v) as g insert into O;",
+                  [[2.0], [8.0]])
+        vals = [e.data[0] for e in got]
+        assert vals[0] == pytest.approx(2.0)
+        assert vals[1] == pytest.approx(4.0)  # sqrt(2*8)
+
+    def test_custom_aggregator_with_group_by(self, manager):
+        import numpy as np
+
+        from siddhi_tpu.ops.aggregators import AggExecutor
+
+        class Last(AggExecutor):
+            return_type = AttrType.DOUBLE
+
+            def __init__(self, arg_type=None):
+                pass
+
+            def new_state(self):
+                return {"last": None}
+
+            def add_run(self, state, values):
+                state["last"] = float(values[-1])
+                return values.astype(np.float64)
+
+            def remove_run(self, state, values):
+                return np.full(len(values), state["last"] or 0.0)
+
+        manager.set_extension("lastVal", Last, kind="aggregator")
+        got = run(manager,
+                  "define stream S (k string, v double); "
+                  "from S select k, lastVal(v) as l group by k "
+                  "insert into O;",
+                  [["a", 1.0], ["b", 5.0], ["a", 3.0]])
+        assert [list(e.data) for e in got] == [
+            ["a", 1.0], ["b", 5.0], ["a", 3.0]]
