@@ -79,6 +79,7 @@ def extension(kind: str, name: str, namespace: Optional[str] = None):
 def default_registry() -> ExtensionRegistry:
     # import builtin extension modules for their registration side effects
     import siddhi_tpu.extension.function  # noqa: F401
+    import siddhi_tpu.ops.stream_functions  # noqa: F401
     import siddhi_tpu.ops.windows  # noqa: F401
     import siddhi_tpu.table.record  # noqa: F401
     import siddhi_tpu.transport.sink  # noqa: F401

@@ -741,7 +741,22 @@ class QueryPlanner:
                     where=f"stream function '#{h.name}' on stream '{s.stream_id}'")
                 from siddhi_tpu.core.query import StreamFunctionChainProcessor
 
-                chain.append(StreamFunctionChainProcessor(factory(args, definition.attribute_names)))
+                fn_obj = factory(args, definition.attribute_names)
+                out_attrs = getattr(fn_obj, "output_attributes", None)
+                if out_attrs:
+                    # schema-extending stream functions (reference:
+                    # StreamProcessor.getReturnAttributes, e.g.
+                    # #pol2Cart appending x/y): the new columns resolve
+                    # downstream — filters later in this chain and the
+                    # selector share this scope object
+                    for a_ in out_attrs:
+                        compiler.scope.add(
+                            s.stream_id, a_.name, a_.name, a_.type)
+                        uid = getattr(s, "unique_id", s.stream_id)
+                        if uid != s.stream_id:
+                            compiler.scope.add(
+                                uid, a_.name, a_.name, a_.type)
+                chain.append(StreamFunctionChainProcessor(fn_obj))
             else:
                 raise SiddhiAppCreationError(f"unsupported stream handler {h}")
         return chain, batch_mode, windows

@@ -262,3 +262,35 @@ class TestCustomAggregators:
                   [["a", 1.0], ["b", 5.0], ["a", 3.0]])
         assert [list(e.data) for e in got] == [
             ["a", 1.0], ["b", 5.0], ["a", 3.0]]
+
+
+class TestBuiltinStreamFunctions:
+    def test_pol2cart_appends_xy(self, manager):
+        # reference Pol2CartStreamFunctionProcessor.java:149
+        import math
+
+        got = run(manager,
+                  "define stream P (theta double, rho double); "
+                  "from P#pol2Cart(theta, rho) select x, y insert into O;",
+                  [[60.0, 2.0]], stream="P")
+        x, y = got[0].data
+        assert x == pytest.approx(2 * math.cos(math.radians(60.0)))
+        assert y == pytest.approx(2 * math.sin(math.radians(60.0)))
+
+    def test_pol2cart_z_passthrough_and_downstream_filter(self, manager):
+        got = run(manager,
+                  "define stream P (theta double, rho double, e double); "
+                  "from P#pol2Cart(theta, rho, e)[x > 0.5] "
+                  "select x, z insert into O;",
+                  [[60.0, 2.0, 5.0],     # x = 1.0: kept
+                   [120.0, 0.4, 6.0]],   # x = -0.2: filtered
+                  stream="P")
+        assert len(got) == 1
+        assert got[0].data[1] == pytest.approx(5.0)
+
+    def test_log_function_passthrough(self, manager):
+        got = run(manager,
+                  "define stream S (v double); "
+                  "from S#log('checkpoint') select v insert into O;",
+                  [[7.0], [8.0]])
+        assert [e.data[0] for e in got] == [7.0, 8.0]
