@@ -306,17 +306,24 @@ class ExpressionCompiler:
 
     def _c_IsNull(self, e: IsNull) -> CompiledExpression:
         c = self.compile(e.expr)
-        if c.type in (AttrType.STRING, AttrType.OBJECT):
-            return CompiledExpression(
-                lambda env: np.frompyfunc(lambda x: x is None, 1, 1)(c.fn(env)).astype(bool),
-                AttrType.BOOL,
-            )
-        if c.type in (AttrType.FLOAT, AttrType.DOUBLE):
-            return CompiledExpression(lambda env: np.isnan(c.fn(env)), AttrType.BOOL)
-        # ints/bools have no null representation in-batch
-        return CompiledExpression(
-            lambda env: np.zeros(np.shape(c.fn(env)), dtype=bool), AttrType.BOOL
-        )
+
+        # dispatch on the RUNTIME dtype, not the declared type: nulls
+        # from outer joins / partial upserts ride object-dtype columns
+        # regardless of the attribute's declared type (e.g. a LONG rv
+        # column carrying None after a left outer join)
+        def fn(env):
+            v = np.asarray(c.fn(env))
+            if v.dtype == object:
+                return np.frompyfunc(
+                    lambda x: (x is None
+                               or (isinstance(x, float) and np.isnan(x))),
+                    1, 1)(v).astype(bool)
+            if v.dtype.kind == "f":
+                return np.isnan(v)
+            # native int/bool lanes have no null representation
+            return np.zeros(v.shape, dtype=bool)
+
+        return CompiledExpression(fn, AttrType.BOOL)
 
     def _c_IsNullStream(self, e: IsNullStream) -> CompiledExpression:
         # `e1[1] is null` — presence mask supplied by the pattern engine as

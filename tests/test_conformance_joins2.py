@@ -199,3 +199,34 @@ class TestJoinWithin:
             ("Q", ["a"], 5000),
         ])
         assert got == [["a", 12.0]]
+
+
+class TestJoinNullChecks:
+    def test_is_null_over_outer_join_nulls(self):
+        # IsNullTestCase family: LONG columns carry real nulls after a
+        # left outer join and `is null` must see them downstream
+        app = (DEFS +
+               "@info(name='q') from L#window.length(2) left outer join "
+               "R#window.length(2) on L.sym == R.sym "
+               "select L.lv as lv, R.rv as rv insert into Mid; "
+               "@info(name='q2') from Mid[rv is null] select lv "
+               "insert into O2; "
+               "@info(name='q3') from Mid[not (rv is null)] select lv, rv "
+               "insert into O3;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            nulls, joined = [], []
+            rt.add_callback("O2", lambda evs: nulls.extend(
+                list(e.data) for e in evs))
+            rt.add_callback("O3", lambda evs: joined.extend(
+                list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("L").send(["a", 1], timestamp=1000)
+            rt.get_input_handler("R").send(["a", 10], timestamp=1100)
+            rt.get_input_handler("L").send(["b", 2], timestamp=1200)
+            rt.shutdown()
+            assert nulls == [[1], [2]]
+            assert joined == [[1, 10]]
+        finally:
+            m.shutdown()
