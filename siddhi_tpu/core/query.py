@@ -211,12 +211,39 @@ class QuerySelector:
                     gstate[ai] = binding.executor.new_state()
                 idx = np.asarray(idx_list)
                 seg_vals = vals[idx]
+                # null inputs leave the aggregate UNCHANGED (reference
+                # aggregators skip null data): feed only non-null values
+                # and forward-fill the running output over null rows
+                nulls = None
+                if seg_vals.dtype == object:
+                    nulls = np.frompyfunc(
+                        lambda x: x is None, 1, 1)(seg_vals).astype(bool)
+                    if nulls.any():
+                        seg_vals = seg_vals[~nulls]
+                    else:
+                        nulls = None
                 res = (
                     binding.executor.remove_run(gstate[ai], seg_vals)
                     if is_remove
                     else binding.executor.add_run(gstate[ai], seg_vals)
                 )
                 res = np.asarray(res)
+                last_store = gstate.setdefault("_last_out", {})
+                if nulls is not None:
+                    full = np.empty(len(idx), dtype=object)
+                    # position of the last non-null at or before each
+                    # row; rows before any non-null value repeat the
+                    # aggregate's LAST output from earlier batches
+                    # (None only while the aggregate never saw a value)
+                    prev = last_store.get(ai)
+                    fill = np.cumsum((~nulls).astype(np.int64)) - 1
+                    for j in range(len(idx)):
+                        full[j] = res[fill[j]] if fill[j] >= 0 else prev
+                    if len(res):
+                        last_store[ai] = res[-1]
+                    res = full
+                elif len(res):
+                    last_store[ai] = res[-1]
                 if col is None:
                     col = np.empty(n, dtype=res.dtype if res.dtype != object else object)
                 col[idx] = res
@@ -237,6 +264,9 @@ class QuerySelector:
             if rtype == ev.RESET:
                 for gstate in self.group_states.values():
                     for ai, st in gstate.items():
+                        if ai == "_last_out":  # null-carry cache, not
+                            st.clear()         # an executor state
+                            continue
                         self.aggregations[ai].executor.reset(st)
                 continue
             if rtype == ev.TIMER:

@@ -230,3 +230,27 @@ class TestJoinNullChecks:
             assert joined == [[1, 10]]
         finally:
             m.shutdown()
+
+    def test_aggregates_skip_null_inputs(self):
+        # reference aggregators IGNORE null data: sum(rv) holds its
+        # value over null rows instead of crashing or resetting
+        app = (DEFS +
+               "@info(name='q') from L#window.length(2) left outer join "
+               "R#window.length(2) on L.sym == R.sym "
+               "select L.lv as lv, R.rv as rv insert into Mid; "
+               "@info(name='q2') from Mid select sum(rv) as s, "
+               "count() as c insert into O2;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            got = []
+            rt.add_callback("O2", lambda evs: got.extend(
+                list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("L").send(["a", 1], timestamp=1000)
+            rt.get_input_handler("R").send(["a", 10], timestamp=1100)
+            rt.get_input_handler("L").send(["b", 2], timestamp=1200)
+            rt.shutdown()
+            assert got == [[None, 1], [10, 2], [10, 3]]
+        finally:
+            m.shutdown()
