@@ -337,9 +337,28 @@ class QuerySelector:
             # would reverse ties and break secondary keys)
             idx = np.arange(len(out))
             for name, asc in reversed(self.order_by):
-                col = out.columns[name][idx]
-                _, dense = np.unique(col, return_inverse=True)
-                order = np.argsort(dense if asc else -dense, kind="stable")
+                col = np.asarray(out.columns[name][idx])
+                nulls = None
+                if col.dtype == object:
+                    nulls = np.frompyfunc(
+                        lambda x: x is None, 1, 1)(col).astype(bool)
+                    if not nulls.any():
+                        nulls = None
+                if nulls is None:
+                    _, dense = np.unique(col, return_inverse=True)
+                    key = dense if asc else -dense
+                else:
+                    # nulls order LAST in both directions (reference
+                    # OrderByEventComparator: a null value loses to any
+                    # non-null regardless of asc/desc)
+                    nn = col[~nulls]
+                    key = np.zeros(len(col), dtype=np.int64)
+                    if len(nn):
+                        _, dense_nn = np.unique(nn, return_inverse=True)
+                        key[~nulls] = dense_nn if asc else -dense_nn
+                    key[nulls] = (int(key[~nulls].max()) + 1
+                                  if len(nn) else 0)
+                order = np.argsort(key, kind="stable")
                 idx = idx[order]
             out = out.take(idx)
         if self.offset is not None:

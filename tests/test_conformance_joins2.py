@@ -254,3 +254,33 @@ class TestJoinNullChecks:
             assert got == [[None, 1], [10, 2], [10, 3]]
         finally:
             m.shutdown()
+
+    def test_order_by_with_nulls_sorts_last(self):
+        # reference OrderByEventComparator: nulls lose to any non-null
+        # in BOTH directions
+        app = (DEFS +
+               "@info(name='q') from L#window.length(3) left outer join "
+               "R#window.length(3) on L.sym == R.sym "
+               "select L.lv as lv, R.rv as rv insert into Mid; "
+               "@info(name='q2') from Mid#window.lengthBatch(3) "
+               "select lv, rv order by rv insert into O2; "
+               "@info(name='q3') from Mid#window.lengthBatch(3) "
+               "select lv, rv order by rv desc insert into O3;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            asc, desc = [], []
+            rt.add_callback("O2", lambda evs: asc.extend(
+                list(e.data) for e in evs))
+            rt.add_callback("O3", lambda evs: desc.extend(
+                list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("L").send(["a", 1], timestamp=1000)
+            rt.get_input_handler("R").send(["a", 10], timestamp=1100)
+            rt.get_input_handler("L").send(["b", 2], timestamp=1200)
+            rt.shutdown()
+            # rows: (1, null), (1, 10), (2, null)
+            assert asc == [[1, 10], [1, None], [2, None]]
+            assert desc == [[1, 10], [1, None], [2, None]]
+        finally:
+            m.shutdown()
