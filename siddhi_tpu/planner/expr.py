@@ -414,13 +414,22 @@ def _to_type(arr, t: AttrType):
         a = np.asarray(arr)
         if a.dtype == object:
             return np.frompyfunc(
-                lambda x: x if isinstance(x, bool) else str(x).lower() == "true", 1, 1
-            )(a).astype(bool)
+                lambda x: (None if x is None
+                           else x if isinstance(x, bool)
+                           else str(x).lower() == "true"), 1, 1
+            )(a)
         return a.astype(bool)
     dt = _NUMERIC_NP[t]
     a = np.asarray(arr)
     if a.dtype == object:
-        return np.frompyfunc(lambda x: dt(float(x)), 1, 1)(a).astype(dt)
+        # null-safe: None converts to None (reference per-type convert
+        # executors return null for null input); the column stays
+        # object-dtype when any null is present
+        out = np.frompyfunc(
+            lambda x: None if x is None else dt(float(x)), 1, 1)(a)
+        if any(x is None for x in out.reshape(-1).tolist()):
+            return out
+        return out.astype(dt)
     return a.astype(dt)
 
 

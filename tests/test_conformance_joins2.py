@@ -284,3 +284,24 @@ class TestJoinNullChecks:
             assert desc == [[1, 10], [1, None], [2, None]]
         finally:
             m.shutdown()
+
+    def test_convert_and_cast_null_safe(self):
+        app = (DEFS +
+               "@info(name='q') from L#window.length(3) left outer join "
+               "R#window.length(3) on L.sym == R.sym "
+               "select L.lv as lv, R.rv as rv insert into Mid; "
+               "@info(name='q2') from Mid select cast(rv, 'string') as c, "
+               "convert(rv, 'double') as d insert into O2;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            got = []
+            rt.add_callback("O2", lambda evs: got.extend(
+                list(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("L").send(["a", 1], timestamp=1000)
+            rt.get_input_handler("R").send(["a", 10], timestamp=1100)
+            rt.shutdown()
+            assert got == [[None, None], ["10", 10.0]]
+        finally:
+            m.shutdown()
