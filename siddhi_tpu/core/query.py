@@ -246,6 +246,11 @@ class QuerySelector:
                     last_store[ai] = res[-1]
                 if col is None:
                     col = np.empty(n, dtype=res.dtype if res.dtype != object else object)
+                elif res.dtype == object and col.dtype != object:
+                    # a later group emitted None (all-null inputs): the
+                    # whole output column must carry real nulls, not
+                    # coerced NaN/garbage
+                    col = col.astype(object)
                 col[idx] = res
             out[binding.env_key] = col if col is not None else np.empty(0)
         return out

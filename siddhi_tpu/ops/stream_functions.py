@@ -48,6 +48,7 @@ class Pol2CartStreamFunction:
             self.output_attributes.append(Attribute("z", AttrType.DOUBLE))
 
     def process(self, batch, now):
+        from siddhi_tpu.core.event import EventBatch
         from siddhi_tpu.core.query import build_env
 
         env = build_env(batch)
@@ -57,16 +58,23 @@ class Pol2CartStreamFunction:
         rho = np.broadcast_to(
             np.asarray(self.args[1].fn(env), dtype=np.float64), (n,))
         rad = np.radians(theta)
-        batch.columns["x"] = rho * np.cos(rad)
-        batch.columns["y"] = rho * np.sin(rad)
+        # a NEW batch: the junction hands the SAME EventBatch to every
+        # receiver, so mutating columns/names in place would leak the
+        # appended schema into sibling queries
+        cols = dict(batch.columns)
+        cols["x"] = rho * np.cos(rad)
+        cols["y"] = rho * np.sin(rad)
         if len(self.args) == 3:
-            batch.columns["z"] = np.broadcast_to(
+            cols["z"] = np.broadcast_to(
                 np.asarray(self.args[2].fn(env), dtype=np.float64),
                 (n,)).copy()
-        if "x" not in batch.attribute_names:
-            batch.attribute_names = list(batch.attribute_names) + [
-                a.name for a in self.output_attributes]
-        return batch
+        names = list(batch.attribute_names) + [
+            a.name for a in self.output_attributes
+            if a.name not in batch.attribute_names]
+        out = EventBatch(batch.stream_id, names, cols,
+                         batch.timestamps, batch.types)
+        out.aux.update(batch.aux)
+        return out
 
 
 @extension("stream_function", "log")
@@ -99,15 +107,23 @@ class LogStreamFunction:
             vals.append(str(v[0]) if len(v) else "")
         level = logging.INFO
         message = ""
+        log_events = True
         if len(vals) == 1:
             message = vals[0]
         elif len(vals) >= 2:
             level = self._LEVELS.get(vals[0].lower(), logging.INFO)
             message = vals[1]
-        rows = [
-            [batch.columns[nm][i] for nm in batch.attribute_names]
-            for i in range(len(batch))
-        ]
-        log.log(level, "%s : %d events: %s", message or batch.stream_id,
-                len(batch), rows)
+            if len(vals) >= 3:
+                log_events = vals[2].lower() == "true"
+        if log.isEnabledFor(level):  # row dump is O(rows x cols): lazy
+            if log_events:
+                rows = [
+                    [batch.columns[nm][i] for nm in batch.attribute_names]
+                    for i in range(len(batch))
+                ]
+                log.log(level, "%s : %d events: %s",
+                        message or batch.stream_id, len(batch), rows)
+            else:
+                log.log(level, "%s : %d events",
+                        message or batch.stream_id, len(batch))
         return batch

@@ -413,11 +413,14 @@ def _to_type(arr, t: AttrType):
     if t == AttrType.BOOL:
         a = np.asarray(arr)
         if a.dtype == object:
-            return np.frompyfunc(
+            out = np.frompyfunc(
                 lambda x: (None if x is None
                            else x if isinstance(x, bool)
                            else str(x).lower() == "true"), 1, 1
             )(a)
+            if any(x is None for x in out.reshape(-1).tolist()):
+                return out
+            return out.astype(bool)
         return a.astype(bool)
     dt = _NUMERIC_NP[t]
     a = np.asarray(arr)

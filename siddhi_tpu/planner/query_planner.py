@@ -131,10 +131,20 @@ class AggregatorRewrite:
                 elif is_builtin and expr.name not in ("count",) and not expr.star:
                     raise SiddhiAppCreationError(f"aggregator '{expr.name}' needs an argument")
                 if ext is not None:
+                    import inspect
+
                     try:
-                        executor = ext(arg.type if arg is not None else None)
-                    except TypeError:
-                        executor = ext()
+                        params = [
+                            p for p in
+                            inspect.signature(ext).parameters.values()
+                            if p.kind in (p.POSITIONAL_ONLY,
+                                          p.POSITIONAL_OR_KEYWORD)
+                        ]
+                        takes_arg = len(params) >= 1
+                    except (TypeError, ValueError):
+                        takes_arg = True
+                    executor = (ext(arg.type if arg is not None else None)
+                                if takes_arg else ext())
                 else:
                     executor = make_aggregator(expr.name, arg.type if arg is not None else None)
                 self.bindings.append(AggBinding(key, executor, arg))
@@ -234,7 +244,7 @@ class QueryPlanner:
             # side-local scope: handler expressions see bare side attrs
             side_scope = scope_for_definition(definition, ref)
             side_compiler = ExpressionCompiler(side_scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
-            chain, b_mode, windows = self._plan_handlers(s, definition, side_compiler)
+            chain, b_mode, windows, _extra = self._plan_handlers(s, definition, side_compiler)
             batch_mode = batch_mode or b_mode
             window = None
             filters = []
@@ -600,9 +610,10 @@ class QueryPlanner:
             scope.add_alias(s.stream_id, s.alias)
         compiler = ExpressionCompiler(scope, functions=self.app.functions, table_resolver=self.app.table_resolver)
 
-        chain, batch_mode, windows = self._plan_handlers(s, definition, compiler)
+        chain, batch_mode, windows, extra_attrs = self._plan_handlers(s, definition, compiler)
         selector, out_def = self._plan_selector(
-            query.selector, scope, compiler, name, query, batch_mode
+            query.selector, scope, compiler, name, query, batch_mode,
+            extra_attrs=extra_attrs,
         )
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
@@ -714,6 +725,7 @@ class QueryPlanner:
         chain = []
         windows = []
         batch_mode = False
+        extra_attrs = []  # schema-extending stream functions' outputs
         for h in s.handlers:
             if isinstance(h, Filter):
                 chain.append(FilterProcessor(compiler.compile(h.expression)))
@@ -756,10 +768,11 @@ class QueryPlanner:
                         if uid != s.stream_id:
                             compiler.scope.add(
                                 uid, a_.name, a_.name, a_.type)
+                    extra_attrs.extend(out_attrs)
                 chain.append(StreamFunctionChainProcessor(fn_obj))
             else:
                 raise SiddhiAppCreationError(f"unsupported stream handler {h}")
-        return chain, batch_mode, windows
+        return chain, batch_mode, windows, extra_attrs
 
     # -- selector -----------------------------------------------------------
 
@@ -772,6 +785,7 @@ class QueryPlanner:
         query: Query,
         batch_mode: bool,
         star_sources=None,
+        extra_attrs=None,
     ) -> Tuple[QuerySelector, StreamDefinition]:
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{qname}"
         rewriter = AggregatorRewrite(scope, compiler,
@@ -805,8 +819,10 @@ class QueryPlanner:
                     "clause for pattern/join inputs"
                 )
             in_def = self.app.resolve_stream_definition(query.input_stream)
-            out_attrs = list(in_def.attributes)
-            out_names = in_def.attribute_names
+            # schema-extending stream functions (#pol2Cart) append to
+            # the flowing schema, so `select *` includes their outputs
+            out_attrs = list(in_def.attributes) + list(extra_attrs or [])
+            out_names = [a.name for a in out_attrs]
         else:
             items = []
             for oa in sel.selection:

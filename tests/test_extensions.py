@@ -294,3 +294,21 @@ class TestBuiltinStreamFunctions:
                   "from S#log('checkpoint') select v insert into O;",
                   [[7.0], [8.0]])
         assert [e.data[0] for e in got] == [7.0, 8.0]
+
+    def test_pol2cart_select_star_and_sibling_isolation(self, manager):
+        # select * includes the appended columns, and a sibling query on
+        # the SAME stream must not see them (no shared-batch mutation)
+        rt = manager.create_siddhi_app_runtime(
+            "define stream P (theta double, rho double); "
+            "@info(name='q1') from P#pol2Cart(theta, rho) "
+            "select * insert into O; "
+            "@info(name='q2') from P select * insert into O2;")
+        star, sib = [], []
+        rt.add_callback("O", lambda evs: star.extend(list(e.data) for e in evs))
+        rt.add_callback("O2", lambda evs: sib.extend(list(e.data) for e in evs))
+        rt.start()
+        rt.get_input_handler("P").send([0.0, 2.0])
+        rt.shutdown()
+        assert len(star) == 1 and len(star[0]) == 4   # theta, rho, x, y
+        assert star[0][2] == pytest.approx(2.0)       # x = rho*cos(0)
+        assert sib == [[0.0, 2.0]]                    # untouched schema
