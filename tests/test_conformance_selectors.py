@@ -228,3 +228,50 @@ class TestMathAndFunctions:
                   "instanceOfFloat(price) as c "
                   "insert into OutputStream;", [["A", 20.0, 1]])
         assert got == [[True, False, True]]
+
+
+class TestConvertMatrix:
+    """convert(value, 'type') across every (from, to) pair (reference:
+    ConvertFunctionTestCase / ConvertFunctionExecutor's per-type
+    switch): numeric conversions truncate like Java casts, strings
+    parse, bools map via string semantics."""
+
+    DEFS6 = ("define stream C (i int, l long, f float, d double, "
+             "s string, b bool); ")
+    ROW = [7, 5_000_000_000, 2.5, 3.9, "11", True]
+
+    def _convert(self, src, target):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                self.DEFS6 + f"@info(name='q') from C select "
+                f"convert({src}, '{target}') as c insert into O;")
+            got = []
+            rt.add_callback("O", lambda evs: got.extend(e.data[0] for e in evs))
+            rt.start()
+            rt.get_input_handler("C").send(list(self.ROW))
+            rt.shutdown()
+            return got[0]
+        finally:
+            m.shutdown()
+
+    def test_numeric_to_numeric_truncates(self):
+        assert self._convert("d", "int") == 3       # 3.9 -> 3
+        assert self._convert("d", "long") == 3
+        assert self._convert("f", "int") == 2       # 2.5 -> 2
+        assert self._convert("i", "double") == 7.0
+        assert self._convert("l", "double") == 5_000_000_000.0
+        assert self._convert("i", "long") == 7
+
+    def test_string_parses_to_numbers(self):
+        assert self._convert("s", "int") == 11
+        assert self._convert("s", "long") == 11
+        assert self._convert("s", "double") == 11.0
+
+    def test_to_string(self):
+        assert self._convert("i", "string") == "7"
+        assert self._convert("b", "string").lower() == "true"
+
+    def test_bool_conversions(self):
+        assert self._convert("b", "bool") is True or \
+            self._convert("b", "bool") == True  # noqa: E712
