@@ -268,17 +268,39 @@ def _probe_backend(timeout_s: int = 120) -> bool:
         return False
 
 
+# outage retry: one transient tunnel window must not zero a whole round
+# (round 4 lost its only hardware run that way).  Worst case ~12 min of
+# probe timeouts + ~12.5 min of backoff sleeps before giving up.
+PROBE_RETRIES = 6
+PROBE_BACKOFF_S = (30, 60, 120, 240, 300)
+
+
+def _probe_with_retry() -> bool:
+    for attempt in range(PROBE_RETRIES):
+        if _probe_backend():
+            return True
+        if attempt == PROBE_RETRIES - 1:
+            break  # no further probe follows; don't sleep for nothing
+        wait = PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)]
+        print(f"device backend unreachable (attempt {attempt + 1}/"
+              f"{PROBE_RETRIES}); retrying in {wait}s", file=sys.stderr)
+        time.sleep(wait)
+    return False
+
+
 def main():
-    if not _probe_backend():
+    if not _probe_with_retry():
         # one JSON line even when the chip is unreachable, so the
-        # driver records the outage instead of timing out
+        # driver records the outage instead of timing out.  value is
+        # null (NOT 0): a consumer aggregating `value` must never
+        # mistake the outage sentinel for a real measurement.
         print(json.dumps({
             "metric": "pattern_match_events_per_sec_per_chip",
-            "value": 0,
+            "value": None,
             "unit": "events/s",
-            "vs_baseline": 0,
-            "error": "device backend unreachable (tunnel down); "
-                     "bench skipped",
+            "vs_baseline": None,
+            "error": "device backend unreachable (tunnel down, retried "
+                     f"{PROBE_RETRIES}x with backoff); bench skipped",
         }))
         return
     kernel = bench_kernel()

@@ -142,13 +142,18 @@ class EventBatch:
         return self.mask(m)
 
     def copy(self) -> "EventBatch":
-        return EventBatch(
+        out = EventBatch(
             self.stream_id,
             list(self.attribute_names),
             {k: v.copy() for k, v in self.columns.items()},
             self.timestamps.copy(),
             self.types.copy(),
         )
+        for name in self._ROW_AUX:
+            a = self.aux.get(name)
+            if a is not None:
+                out.aux[name] = list(a)
+        return out
 
     @staticmethod
     def concat(batches: List["EventBatch"]) -> "EventBatch":

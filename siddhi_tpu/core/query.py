@@ -655,13 +655,21 @@ class GroupByTimeRateLimiter(OutputRateLimiter):
     def next_wakeup(self) -> Optional[int]:
         return self._window_end
 
+    @staticmethod
+    def _copy_last(last: Dict) -> Dict:
+        # re-materialize the per-group single-row batches: a shallow dict
+        # copy would alias EventBatch internals between the live limiter
+        # and the snapshot (restored batches could bleed mutations)
+        return {k: v.copy() if isinstance(v, EventBatch) else v
+                for k, v in last.items()}
+
     def snapshot(self):
-        return {"seen": set(self._seen), "last": dict(self._last),
+        return {"seen": set(self._seen), "last": self._copy_last(self._last),
                 "end": self._window_end}
 
     def restore(self, state):
         self._seen = set(state["seen"])
-        self._last = dict(state["last"])
+        self._last = self._copy_last(state["last"])
         self._window_end = state["end"]
 
 

@@ -22,7 +22,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
 from siddhi_tpu.query_api import (
     AndOp,
     ArithmeticOp,
@@ -361,18 +364,31 @@ class ExpressionCompiler:
                     n = env.get(N_KEY, 1)
                     if not isinstance(n, (int, np.integer)):
                         n = 1
-                    out = np.zeros(max(int(n), 1), dtype=bool)
-                    for i in range(len(out)):
-                        ev = {}
-                        for k, v in env.items():
-                            if (isinstance(v, np.ndarray) and v.ndim >= 1
-                                    and k != N_KEY):
-                                ev[k] = v[i] if i < len(v) else v[-1]
-                            else:
-                                ev[k] = v
-                        ev[N_KEY] = 1
+                    n = max(int(n), 1)
+                    out = np.zeros(n, dtype=bool)
+                    # split env once per batch: array columns must be
+                    # row-aligned with the batch (a short column is a
+                    # planner bug — fail loudly, don't repeat v[-1])
+                    arrays = {}
+                    scalars = {}
+                    for k, v in env.items():
+                        if k == N_KEY:
+                            continue
+                        if isinstance(v, np.ndarray) and v.ndim >= 1:
+                            if len(v) < n:
+                                raise SiddhiAppRuntimeError(
+                                    f"'IN {e.source_id}': env column '{k}' "
+                                    f"has {len(v)} rows for a {n}-row batch")
+                            arrays[k] = v
+                        else:
+                            scalars[k] = v
+                    scalars[N_KEY] = 1
+                    for i in range(n):
+                        ev = dict(scalars)
+                        for k, v in arrays.items():
+                            ev[k] = v[i]
                         out[i] = len(cond.slots_matching(ev)) > 0
-                    return out if len(out) > 1 else out[0]
+                    return out if n > 1 else out[0]
 
                 return CompiledExpression(member_cond, AttrType.BOOL)
         member_fn = self.table_resolver(e.source_id)

@@ -13,6 +13,10 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional, Tuple
 
+# per-task fire cap within one advance(); far above any legitimate
+# timer fan (a task re-arming every fire drains one wakeup per fire)
+_MAX_DRAIN_FIRES = 100_000
+
 
 class Scheduler:
     def __init__(self, app_context):
@@ -64,12 +68,22 @@ class Scheduler:
             # equal-wake guard stops tasks whose fire does not advance
             # their clock.
             prev = None
-            while True:
+            # defensive cap: a task whose wakeups oscillate between two
+            # distinct elapsed values would otherwise spin this drain
+            # forever (the equal-wake guard only catches exact repeats)
+            for _ in range(_MAX_DRAIN_FIRES):
                 wake = t.next_wakeup()
                 if wake is None or wake > now or wake == prev:
                     break
                 prev = wake
                 t.fire(now)
+            else:
+                import logging
+
+                logging.getLogger("siddhi_tpu").warning(
+                    "scheduler task %r still has elapsed wakeups after "
+                    "%d fires in one advance; deferring to the next tick",
+                    t, _MAX_DRAIN_FIRES)
 
     # -- wall-clock fallback (processing-time mode only) --------------------
 

@@ -30,8 +30,14 @@ try:
     for _name in list(getattr(_xb, "_backend_factories", {})):
         if _name != "cpu":
             _xb._backend_factories.pop(_name, None)
-except Exception:
-    pass
+except Exception as _e:  # pragma: no cover - jax-version drift
+    import warnings
+
+    # the scrub touches a private attr; if a jax upgrade renames it the
+    # hang-defense silently vanishes — make that visible
+    warnings.warn(
+        f"CPU-only backend scrub ineffective ({_e}); a downed remote "
+        "device plugin may hang backend init", RuntimeWarning)
 
 from siddhi_tpu.parallel import ensure_virtual_devices  # noqa: E402
 

@@ -9,6 +9,16 @@ UpdateOrInsertTableTestCase, InOperatorTestCase, cache/store corpora).
 import pytest
 
 from siddhi_tpu import SiddhiManager
+from siddhi_tpu.extension.registry import extension
+from siddhi_tpu.table.record import InMemoryRecordStore
+
+
+# the reference test double (test/.../TestStoreContainingInMemoryTable)
+# is test-scoped there too; registered unconditionally so the cache
+# test below can never silently skip
+@extension("store", "testStoreContainingInMemoryTable")
+class _TestStoreContainingInMemoryTable(InMemoryRecordStore):
+    pass
 
 BASE = (
     "define stream StockStream (symbol string, price double, volume long); "
@@ -166,19 +176,12 @@ class TestCacheTable:
                "from StockStream insert into T; "
                "from Check join T on Check.symbol == T.symbol "
                "select T.symbol as symbol insert into OutputStream;")
-        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
-
-        try:
-            got, _ = run(app, [
-                ("StockStream", ["A", 1.0, 1]),
-                ("StockStream", ["B", 2.0, 2]),
-                ("StockStream", ["C", 3.0, 3]),
-                ("Check", ["C"]),
-            ])
-        except SiddhiAppCreationError:
-            # creation-time only: the record-store test double is not
-            # registered in this environment; runtime failures still fail
-            pytest.skip("record-store test double not registered")
+        got, _ = run(app, [
+            ("StockStream", ["A", 1.0, 1]),
+            ("StockStream", ["B", 2.0, 2]),
+            ("StockStream", ["C", 3.0, 3]),
+            ("Check", ["C"]),
+        ])
         assert [g[0] for g in got] == ["C"]
 
 
