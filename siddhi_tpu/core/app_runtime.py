@@ -221,6 +221,9 @@ class SiddhiAppRuntime:
             # stops reporting stale metrics
             sm.throughput.clear()
             sm.latency.clear()
+            sm.lowering.clear()
+        else:
+            sm.lowering.update(self.lowering())
         if not detail:
             sm.buffers.clear()
         for j in self.junctions.values():
@@ -248,6 +251,22 @@ class SiddhiAppRuntime:
     def statistics(self) -> Dict[str, float]:
         sm = self.app_context.statistics_manager
         return sm.stats() if sm is not None else {}
+
+    def lowering(self) -> Dict[str, str]:
+        """Per-query engine placement: ``'host'`` (columnar numpy
+        chain), ``'dense'`` (jitted dense NFA), or ``'device'`` (jitted
+        device query engine) — so an ``execution('tpu')`` user can see
+        WHICH queries actually lowered instead of silently getting host
+        execution (the dense path's capacity introspection analog for
+        the general query path)."""
+        out = {
+            name: getattr(qr, "lowered_to", "host")
+            for name, qr in self.query_runtimes.items()
+        }
+        for pr in self.partitions.values():
+            if hasattr(pr, "query_lowering"):
+                out.update(pr.query_lowering())
+        return out
 
     def pattern_state(self) -> Dict[str, Dict]:
         """Ops introspection of every pattern/sequence query's engine

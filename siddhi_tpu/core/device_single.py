@@ -60,11 +60,14 @@ class DeviceQueryRuntime:
 
     # -- event path ----------------------------------------------------------
 
-    def process_stream_batch(self, batch: EventBatch):
+    def process_stream_batch(self, batch: EventBatch, keys=None):
         """Advance the device pipeline with a junction batch.  Only
         CURRENT rows drive it (control events — TIMER/RESET — have no
         device meaning; RESET cannot reach a device query because batch
-        windows, their only producer, are ineligible upstream)."""
+        windows, their only producer, are ineligible upstream).
+        ``keys`` (partition mode): raw partition-key value per row,
+        already aligned to the batch's CURRENT rows by the partition
+        receiver."""
         cur = batch.only(ev.CURRENT)
         n = len(cur)
         if n == 0:
@@ -75,9 +78,16 @@ class DeviceQueryRuntime:
             for a in eng.all_attrs if a in cur.columns
         }
         ts = np.asarray(cur.timestamps, dtype=np.int64)
-        self.state, out_cols, out_ts = eng.process_batch(self.state, cols, ts)
+        self.state, out_cols, out_ts = eng.process_batch(
+            self.state, cols, ts, part_keys=keys)
         self.step_invocations += 1
         self._emit(out_cols, out_ts)
+
+    def purge_idle(self, now: int, idle_ms) -> int:
+        """Partition-mode idle-key purge (the dense analog of dropping
+        idle PartitionInstances)."""
+        self.state, n = self.engine.purge_idle_keys(self.state, now, idle_ms)
+        return n
 
     def _emit(self, out_cols: Dict[str, np.ndarray], out_ts: np.ndarray):
         if len(out_ts) == 0:

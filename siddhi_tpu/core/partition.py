@@ -267,10 +267,13 @@ def _pattern_stream_ids(st) -> List[str]:
 
 
 class DensePartitionReceiver:
-    """Subscriber on a partitioned stream's global junction for the dense
-    TPU form: evaluates the partition executor once per batch, interns
-    keys to engine rows, and advances every dense pattern runtime that
-    reads this stream — no per-key instances, no per-key routing."""
+    """Subscriber on a partitioned stream's global junction for the
+    TPU form: evaluates the partition executor once per batch and
+    advances every device-lowered runtime that reads this stream — no
+    per-key instances, no per-key routing.  Runtimes are either dense
+    NFA pattern runtimes (which intern keys to engine rows themselves)
+    or partitioned device-query runtimes (which take the raw key
+    column); both kinds advance in query plan order."""
 
     def __init__(self, stream_id: str, executor, runtimes: List):
         self.stream_id = stream_id
@@ -293,8 +296,12 @@ class DensePartitionReceiver:
             # the vectorized intern index applies
             keys = np.asarray(keys.tolist())
         for rt in self.runtimes:
-            part = rt.intern_keys(keys)
-            rt.process_stream_batch(self.stream_id, cur, part=part, keys=keys)
+            if hasattr(rt, "intern_keys"):  # dense NFA pattern runtime
+                part = rt.intern_keys(keys)
+                rt.process_stream_batch(self.stream_id, cur, part=part,
+                                        keys=keys)
+            else:  # partitioned device-query runtime
+                rt.process_stream_batch(cur, keys=keys)
 
 
 class PartitionStreamReceiver:
@@ -383,7 +390,9 @@ class PartitionRuntime:
                     app_planner.app_context.tpu_partitions)
             except SiddhiAppCreationError as e:
                 self.dense_query_runtimes = {}
-                logging.getLogger("siddhi_tpu").info(
+                # WARN: execution('tpu') was requested and this
+                # partition is getting per-key host instances
+                logging.getLogger("siddhi_tpu").warning(
                     "%s: dense TPU path unavailable (%s); using per-key "
                     "instances", self.name, e)
 
@@ -414,14 +423,18 @@ class PartitionRuntime:
             app_planner.scheduler.register_task(self)
 
     def _plan_dense(self, partition: Partition, app_planner):
-        """Lower every inner query to the dense engine or raise (caller
+        """Lower every inner query to a device engine or raise (caller
         falls back to per-key instances wholesale — mixed mode would
-        split one partition's semantics across two engines)."""
+        split one partition's semantics across two engines).  Pattern
+        queries lower to the dense NFA engine; general single-stream
+        queries (filter/window/group-by) lower to the device query
+        engine with the partition key composed into the group axis."""
         from siddhi_tpu.planner.query_planner import QueryPlanner
         from siddhi_tpu.query_api import (
             InsertIntoStream,
             Query,
             ReturnStream,
+            SingleInputStream,
             StateInputStream,
         )
         from siddhi_tpu.query_api.annotation import find_annotation as _find
@@ -433,9 +446,6 @@ class PartitionRuntime:
             if not isinstance(q, Query):
                 raise SiddhiAppCreationError("nested element not a query")
             st = q.input_stream
-            if not isinstance(st, StateInputStream):
-                raise SiddhiAppCreationError(
-                    "partition body has a non-pattern query")
             out = q.output_stream
             if isinstance(out, InsertIntoStream) and out.is_inner:
                 raise SiddhiAppCreationError(
@@ -443,23 +453,41 @@ class PartitionRuntime:
             elif not isinstance(out, (InsertIntoStream, ReturnStream)) and out is not None:
                 raise SiddhiAppCreationError(
                     "table/window outputs need per-key instances")
-            for sid in _pattern_stream_ids(st):
-                if sid not in self.partitioned_defs:
+            if isinstance(st, StateInputStream):
+                for sid in _pattern_stream_ids(st):
+                    if sid not in self.partitioned_defs:
+                        raise SiddhiAppCreationError(
+                            f"pattern input '{sid}' is not a partitioned stream")
+            elif isinstance(st, SingleInputStream):
+                if st.is_inner or st.is_fault:
                     raise SiddhiAppCreationError(
-                        f"pattern input '{sid}' is not a partitioned stream")
+                        "inner/fault stream inputs need per-key instances")
+                if st.stream_id not in self.partitioned_defs:
+                    raise SiddhiAppCreationError(
+                        f"input '{st.stream_id}' is not a partitioned stream")
+            else:
+                raise SiddhiAppCreationError(
+                    "join queries inside partitions need per-key instances")
 
         qp = QueryPlanner(app_planner)
-        planned = []  # (name, qr, DensePatternRuntime)
+        planned = []  # (name, qr, runtime)
         try:
             for qi, q in enumerate(partition.queries):
                 info = _find(q.annotations, "info")
                 name = (info.element("name") if info else None) or f"{self.name}_q{qi}"
-                qr = qp._plan_dense_state(
-                    q, name, q.input_stream,
-                    n_partitions=app_planner.app_context.tpu_partitions,
-                    subscribe=False,
-                )
-                planned.append((name, qr, qr.pattern_processor))
+                if isinstance(q.input_stream, StateInputStream):
+                    qr = qp._plan_dense_state(
+                        q, name, q.input_stream,
+                        n_partitions=app_planner.app_context.tpu_partitions,
+                        subscribe=False,
+                    )
+                    planned.append((name, qr, qr.pattern_processor))
+                else:
+                    qr = qp._plan_device_single(
+                        q, name, q.input_stream,
+                        partition_mode=True, subscribe=False,
+                    )
+                    planned.append((name, qr, qr.device_runtime))
         except SiddhiAppCreationError:
             # unwind scheduler tasks of already-planned siblings before
             # the wholesale fallback to per-key instances
@@ -473,11 +501,32 @@ class PartitionRuntime:
         for name, qr, runtime in planned:
             self.dense_query_runtimes[name] = qr
         for sid, ex in self._executors.items():
-            runtimes = [r for _n, _qr, r in planned if sid in r.engine.stream_keys]
+            runtimes = [
+                r for _n, _qr, r in planned
+                if (sid in r.engine.stream_keys
+                    if hasattr(r, "intern_keys")
+                    else r.engine.stream_id == sid)
+            ]
             if runtimes:
                 app_planner.junctions[sid].subscribe(
                     DensePartitionReceiver(sid, ex, runtimes)
                 )
+
+    def query_lowering(self) -> Dict[str, str]:
+        """Engine placement of every inner query (see
+        AppRuntime.lowering): dense-lowered bodies report per query;
+        per-key instance bodies are host by construction."""
+        if self.is_dense:
+            return {
+                n: getattr(qr, "lowered_to", "host")
+                for n, qr in self.dense_query_runtimes.items()
+            }
+        out = {}
+        for qi, q in enumerate(self.partition.queries):
+            info = find_annotation(getattr(q, "annotations", []), "info")
+            n = (info.element("name") if info else None) or f"{self.name}_q{qi}"
+            out[n] = "host"
+        return out
 
     def instance_for(self, key) -> PartitionInstance:
         inst = self.instances.get(key)
@@ -504,7 +553,9 @@ class PartitionRuntime:
             # reclaim idle key rows of the shared engines (the dense
             # analog of dropping idle PartitionInstances)
             for qr in self.dense_query_runtimes.values():
-                qr.pattern_processor.purge_idle(now, self._purge_idle_ms)
+                rt = (getattr(qr, "pattern_processor", None)
+                      or getattr(qr, "device_runtime", None))
+                rt.purge_idle(now, self._purge_idle_ms)
             return
         dead = [
             k

@@ -826,6 +826,12 @@ class QueryRuntime:
         self.callback_output: Optional[QueryCallbackOutput] = None
         self.latency_tracker = None
         self.debugger = None  # set by SiddhiAppRuntime.debug()
+        # which engine this query actually runs on: 'host' (columnar
+        # numpy chain), 'dense' (jitted dense NFA), or 'device' (jitted
+        # device query engine) — surfaced via statistics and the REST
+        # introspection endpoint so `execution('tpu')` fallbacks are
+        # visible, not silent
+        self.lowered_to = "host"
 
     def add_callback(self, cb: QueryCallback):
         if self.callback_output is None:

@@ -40,6 +40,23 @@ contract):
  - time windows hold at most ``window_capacity`` passing events (the
    reference buffer is unbounded; overflow drops the oldest).
 
+Partition mode (``partition with (key of S) begin ... end`` under
+``@app:execution('tpu')``, reference:
+partition/PartitionStreamReceiver.java:82-118):
+ - the partition key arrives as an external per-row column, composes
+   into the group axis for aggregation state, and scopes windows per
+   key: each key owns a ``[W]`` ring-buffer row of a ``[n_wgroups, W]``
+   device array (the per-instance window of the reference's cloned
+   queries) — see ``_keyed_sliding_step``;
+ - sliding windows expire PER ROW within a batch, preserving the
+   reference's event-at-a-time semantics regardless of batch size (the
+   host engine's batch path approximates time windows at the batch
+   watermark);
+ - tumbling windows and output rate limits need per-key pane/limiter
+   state and fall back to per-key host instances;
+ - idle keys are purged via ``purge_idle_keys`` (free-listed rows are
+   zeroed and reused), driven by the partition's @purge annotation.
+
 Numeric lanes (TPU-first dtype policy):
  - INT attributes ride int32 lanes — bit-exact;
  - FLOAT/DOUBLE attributes ride float32 lanes, and aggregation state
@@ -97,6 +114,12 @@ SUPPORTED_WINDOWS = (None, "length", "time", "lengthBatch", "timeBatch")
 
 PER_EVENT = "per_event"
 PER_FLUSH = "per_flush"
+
+# host-side chunking bound for the per-event step: the running and
+# keyed-sliding kinds build [B, B] same-group masks, so an unbounded
+# junction batch would allocate quadratically; chunks advance state
+# sequentially, which is semantics-preserving for every kind
+MAX_DEVICE_BATCH = 2048
 
 
 @dataclass
@@ -203,6 +226,8 @@ class DeviceQueryEngine:
         stream_def,
         n_groups: int = 1024,
         window_capacity: int = 1024,
+        partition_mode: bool = False,
+        n_wgroups: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -211,6 +236,15 @@ class DeviceQueryEngine:
         self.query = query
         self.stream_def = stream_def
         self.n_groups = n_groups
+        # partitioned form (`partition with (key of S) begin ... end`
+        # under execution('tpu')): the partition key arrives per batch as
+        # an external column, composes into the group axis for per-key
+        # aggregation state, and scopes windows per key (each key gets
+        # its own ring-buffer row — the reference's per-instance window,
+        # partition/PartitionStreamReceiver.java:82-118, re-designed as
+        # [n_wgroups, W] device state instead of per-key Python objects)
+        self.partition_mode = bool(partition_mode)
+        self.n_wgroups = int(n_wgroups) if n_wgroups else n_groups
 
         s = query.input_stream
         if not isinstance(s, SingleInputStream):
@@ -363,9 +397,16 @@ class DeviceQueryEngine:
             self.kind = "sliding"
         else:
             self.kind = "tumbling"
+        if self.partition_mode:
+            if self.kind == "tumbling":
+                raise SiddhiAppCreationError(
+                    "partitioned tumbling windows need per-key pane "
+                    "boundaries — per-key host instances used")
+            if self.kind == "sliding":
+                self.kind = "keyed_sliding"
 
         # window geometry
-        if self.kind == "sliding":
+        if self.kind in ("sliding", "keyed_sliding"):
             self.W = (
                 int(self.window_param) if self.window_name == "length"
                 else int(window_capacity)
@@ -378,9 +419,20 @@ class DeviceQueryEngine:
         self._trace_check()
         self._step_cache: Dict[str, Callable] = {}
 
-        # host-side interning / pane bookkeeping
+        # host-side interning / pane bookkeeping.  In partition mode the
+        # group key space is the composed tuple (partition_key, *group
+        # keys); window groups (``wgrp``) intern the partition key alone.
+        # Purged ids go to free lists for reuse (their state rows are
+        # zeroed first) — the device analog of dropping idle
+        # PartitionInstances.
         self._group_ids: Dict = {}
         self._group_vals: List = []
+        self._group_free: List[int] = []
+        self._group_last: Dict[int, int] = {}
+        self._wgrp_ids: Dict = {}
+        self._wgrp_vals: List = []
+        self._wgrp_free: List[int] = []
+        self._wgrp_last: Dict[int, int] = {}
         self.base_ts: Optional[int] = None
         self._pane_end: Optional[int] = None  # timeBatch
         self._pane_fill = 0  # passing events in the open pane
@@ -535,6 +587,14 @@ class DeviceQueryEngine:
             state["win_ts"] = jnp.zeros(W, dtype=jnp.int32)
             state["win_grp"] = jnp.zeros(W, dtype=jnp.int32)
             state["win_valid"] = jnp.zeros(W, dtype=bool)
+        elif self.kind == "keyed_sliding":
+            # per-key ring buffers: each partition key owns one [W] row
+            Gw, W = self.n_wgroups, self.W
+            state["win_vals"] = jnp.zeros((Gw, W, A), dtype=jnp.float32)
+            state["win_ts"] = jnp.zeros((Gw, W), dtype=jnp.int32)
+            state["win_grp"] = jnp.zeros((Gw, W), dtype=jnp.int32)
+            state["win_valid"] = jnp.zeros((Gw, W), dtype=bool)
+            state["win_count"] = jnp.zeros(Gw, dtype=jnp.int32)
         elif self.kind in ("running", "tumbling"):
             kinds = {a.kind for a in self.aggs}
             if kinds & {"sum", "avg"}:
@@ -606,10 +666,11 @@ class DeviceQueryEngine:
         return fmask, out
 
     def make_step(self, jit: bool = True) -> Callable:
-        """Per-event step (filter / running / sliding kinds):
+        """Per-event step (filter / running / sliding / keyed_sliding):
 
         step(state, cols {attr: [B] f32}, ts[B] i32 relative-ms,
-             grp[B] i32, valid[B] bool)
+             grp[B] i32, wgrp[B] i32 (window group; partition mode only),
+             valid[B] bool)
           -> (state, out_valid[B], out_vals[B, n_out])
         """
         key = ("step", jit)
@@ -619,7 +680,7 @@ class DeviceQueryEngine:
         A = max(len(self.aggs), 1)
         aggs = self.aggs
 
-        def step(state, cols, ts, grp, valid):
+        def step(state, cols, ts, grp, wgrp, valid):
             B = ts.shape[0]
             env = self._base_env(cols, ts, B)
             fmask = self._filter_mask(env, valid)
@@ -689,6 +750,10 @@ class DeviceQueryEngine:
                 ov, out = self._emit(env_out, fmask, B)
                 return new_state, ov, out
 
+            if self.kind == "keyed_sliding":
+                return self._keyed_sliding_step(
+                    state, env, fmask, ts, grp, wgrp, B, A)
+
             # sliding: compact passing rows, gather [B, W] windows
             W = self.W
             pos = jnp.cumsum(fmask.astype(jnp.int32)) - 1  # [B]
@@ -744,6 +809,103 @@ class DeviceQueryEngine:
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[key] = fn
         return fn
+
+    def _keyed_sliding_step(self, state, env, fmask, ts, grp, wgrp, B, A):
+        """Per-key sliding window (partition mode): each window group
+        (partition key) owns one [W] ring-buffer row, so a row's window
+        is ITS key's last W passing events — the reference's
+        per-instance window (partition/PartitionStreamReceiver.java:
+        82-118) as [n_wgroups, W] device state.  Aggregation masks
+        further restrict to the composed (key, group-by) group.  All
+        batch work is [B, B] / [B, W] masked reductions (the [B, B]
+        matmul rides the MXU); state updates are unique-slot scatters."""
+        jnp = self.jnp
+        aggs = self.aggs
+        W = self.W
+        Gw = self.n_wgroups
+        argvals = self._arg_vals(env, B)  # [B, A]
+        tril = jnp.tril(jnp.ones((B, B), dtype=bool))
+        samew = (wgrp[:, None] == wgrp[None, :]) & fmask[None, :]
+        # passing rank within the row's window group (includes self)
+        r = jnp.sum(samew & tril, axis=1).astype(jnp.int32)  # [B]
+        n_w = jnp.sum(samew, axis=1).astype(jnp.int32)  # whole-batch count
+        # batch-side membership: among the last W passing events of the
+        # row's window group
+        mb = samew & tril & ((r[:, None] - r[None, :]) < W)
+        # buffer-side membership: recency rank (0 = newest buffered)
+        # shifted by the r batch arrivals that displace old entries
+        b_vals = state["win_vals"][wgrp]  # [B, W, A]
+        b_ts = state["win_ts"][wgrp]  # [B, W]
+        b_grp = state["win_grp"][wgrp]  # [B, W]
+        b_valid = state["win_valid"][wgrp]  # [B, W]
+        cnt = state["win_count"][wgrp]  # [B]
+        slots = jnp.arange(W)[None, :]
+        rec = jnp.mod(cnt[:, None] - 1 - slots, W)
+        mbuf = b_valid & ((rec + r[:, None]) < W)
+        if self.window_name == "time":
+            T = self.window_param
+            mb = mb & (ts[None, :] > (ts[:, None] - T))
+            mbuf = mbuf & (b_ts > (ts[:, None] - T))
+        # aggregation masks: composed group within the key's window
+        mba = mb & (grp[None, :] == grp[:, None])
+        mbufa = mbuf & (b_grp == grp[:, None])
+        f32 = jnp.float32
+        bsum = mba.astype(f32) @ argvals  # [B, A]
+        bcnt = jnp.sum(mba, axis=1).astype(f32)[:, None]  # [B, 1]
+        usum = jnp.sum(b_vals * mbufa.astype(f32)[:, :, None], axis=1)
+        ucnt = jnp.sum(mbufa, axis=1).astype(f32)[:, None]
+        wsum = bsum + usum
+        wcnt = bcnt + ucnt
+        env_out = dict(env)
+        need_min = any(a.kind == "min" for a in aggs)
+        need_max = any(a.kind == "max" for a in aggs)
+        if need_min or need_max:
+            big = jnp.float32(np.inf)
+            pmin = jnp.minimum(
+                jnp.min(jnp.where(mba[:, :, None], argvals[None, :, :], big),
+                        axis=1),
+                jnp.min(jnp.where(mbufa[:, :, None], b_vals, big), axis=1))
+            pmax = jnp.maximum(
+                jnp.max(jnp.where(mba[:, :, None], argvals[None, :, :], -big),
+                        axis=1),
+                jnp.max(jnp.where(mbufa[:, :, None], b_vals, -big), axis=1))
+        for ai, a in enumerate(aggs):
+            if a.kind == "sum":
+                env_out[a.env_key] = wsum[:, ai]
+            elif a.kind == "count":
+                env_out[a.env_key] = wcnt[:, 0]
+            elif a.kind == "avg":
+                env_out[a.env_key] = wsum[:, ai] / jnp.maximum(wcnt[:, 0], 1.0)
+            elif a.kind == "min":
+                env_out[a.env_key] = pmin[:, ai]
+            elif a.kind == "max":
+                env_out[a.env_key] = pmax[:, ai]
+        ov, out = self._emit(env_out, fmask, B)
+        # state update: each kept passing row scatters to its ring slot
+        # (slot = (count + r - 1) mod W).  Rows already displaced within
+        # this batch, and padded/filtered rows, dump to the scratch row
+        # Gw so no two real writes ever collide.
+        keep = fmask & ((n_w - r) < W)
+        slot = jnp.mod(cnt + r - 1, W)
+        widx = jnp.where(keep, wgrp, Gw)
+
+        def pad(x):
+            return jnp.concatenate(
+                [x, jnp.zeros((1,) + x.shape[1:], x.dtype)], axis=0)
+
+        new_state = dict(state)
+        new_state["win_vals"] = (
+            pad(state["win_vals"]).at[widx, slot].set(argvals)[:Gw])
+        new_state["win_ts"] = (
+            pad(state["win_ts"]).at[widx, slot].set(ts)[:Gw])
+        new_state["win_grp"] = (
+            pad(state["win_grp"]).at[widx, slot].set(grp)[:Gw])
+        new_state["win_valid"] = (
+            pad(state["win_valid"]).at[widx, slot].set(True)[:Gw])
+        new_state["win_count"] = (
+            pad(state["win_count"])
+            .at[jnp.where(fmask, wgrp, Gw)].add(1)[:Gw])
+        return new_state, ov, out
 
     def make_acc_step(self, jit: bool = True) -> Callable:
         """Tumbling accumulate step:
@@ -875,44 +1037,168 @@ class DeviceQueryEngine:
             self._pane_end -= delta
         return state, rel64
 
-    def _intern_groups(self, cols: Dict[str, np.ndarray],
-                       ts: np.ndarray, n: int) -> np.ndarray:
-        """Evaluate group-key exprs host-side and intern to dense ids."""
-        if not self.group_exprs:
-            return np.zeros(n, dtype=np.int32)
+    def _host_env(self, cols: Dict[str, np.ndarray], ts: np.ndarray,
+                  n: int) -> Dict:
         env = {a: np.asarray(cols[a]) for a in self.all_attrs if a in cols}
         env[TS_KEY] = np.asarray(ts)
         env[N_KEY] = n
+        return env
+
+    def _intern_groups(self, cols: Dict[str, np.ndarray],
+                       ts: np.ndarray, n: int,
+                       pk: Optional[np.ndarray] = None,
+                       now: Optional[int] = None) -> np.ndarray:
+        """Evaluate group-key exprs host-side and intern to dense ids.
+        In partition mode (``pk`` given) the interned key is the
+        composed tuple ``(partition_key, *group_keys)``."""
+        if not self.group_exprs and pk is None:
+            return np.zeros(n, dtype=np.int32)
+        env = self._host_env(cols, ts, n)
         key_cols = [np.broadcast_to(np.asarray(g.fn(env)), (n,))
                     for g in self.group_exprs]
-        if len(key_cols) == 1:
-            # vectorized: factorize the batch once; one dict probe per
-            # UNIQUE value instead of per event
-            uniq, inv = np.unique(key_cols[0], return_inverse=True)
+        if pk is not None:
+            key_cols = [np.broadcast_to(pk, (n,))] + key_cols
+        if len(key_cols) == 1 and pk is None:
+            try:
+                # vectorized: factorize the batch once; one dict probe
+                # per UNIQUE value instead of per event
+                uniq, inv = np.unique(key_cols[0], return_inverse=True)
+            except TypeError:  # unorderable (None in an object column)
+                return self._intern_rows(key_cols, n, now, scalar=True)
             out_u = np.empty(len(uniq), dtype=np.int32)
             for i, k in enumerate(uniq.tolist()):
-                out_u[i] = self._alloc_group(k)
+                out_u[i] = self._alloc_group(k, now)
             return out_u[inv].astype(np.int32, copy=False)
+        # multi-column / composed keys: combine per-column factor codes
+        # so the dict is probed once per UNIQUE combination, not per
+        # row.  Falls back to the exact per-row probe when a column is
+        # unorderable (None in an object column) or the radix product
+        # would overflow int64 (which would silently merge distinct
+        # combinations).
+        try:
+            code = np.zeros(n, dtype=np.int64)
+            radix = 1
+            for c in key_cols:
+                u, inv = np.unique(c, return_inverse=True)
+                radix *= len(u) + 1
+                if radix > 2**62:
+                    raise OverflowError("group-key radix product")
+                code = code * (len(u) + 1) + inv
+        except (TypeError, OverflowError):
+            return self._intern_rows(key_cols, n, now)
+        _uc, first, cinv = np.unique(
+            code, return_index=True, return_inverse=True)
+        out_u = np.empty(len(first), dtype=np.int32)
+        for j, fi in enumerate(first.tolist()):
+            k = tuple(c[fi].item() if hasattr(c[fi], "item") else c[fi]
+                      for c in key_cols)
+            out_u[j] = self._alloc_group(k, now)
+        return out_u[cinv].astype(np.int32, copy=False)
+
+    def _intern_rows(self, key_cols, n: int, now, scalar: bool = False
+                     ) -> np.ndarray:
+        """Exact per-row interning (the fallback for unorderable or
+        radix-overflowing key columns)."""
         out = np.empty(n, dtype=np.int32)
         for i in range(n):
-            k = tuple(c[i].item() if hasattr(c[i], "item") else c[i]
-                      for c in key_cols)
-            out[i] = self._alloc_group(k)
+            parts = tuple(c[i].item() if hasattr(c[i], "item") else c[i]
+                          for c in key_cols)
+            out[i] = self._alloc_group(parts[0] if scalar else parts, now)
         return out
 
-    def _alloc_group(self, k) -> int:
-        gid = self._group_ids.get(k)
+    @staticmethod
+    def _alloc_id(k, ids: Dict, vals: List, free: List[int],
+                  last: Dict, limit: int, what: str,
+                  now: Optional[int]) -> int:
+        """Shared free-listed id allocator for group/window-group
+        interning (purged ids are reused after their rows are zeroed)."""
+        gid = ids.get(k)
         if gid is None:
-            gid = len(self._group_ids)
-            if gid >= self.n_groups:
-                raise SiddhiAppRuntimeError(
-                    f"device query: group cardinality exceeded "
-                    f"n_groups={self.n_groups}")
-            self._group_ids[k] = gid
-            self._group_vals.append(k)
+            if free:
+                gid = free.pop()
+                vals[gid] = k
+            else:
+                gid = len(vals)
+                if gid >= limit:
+                    raise SiddhiAppRuntimeError(what)
+                vals.append(k)
+            ids[k] = gid
+        if now is not None:
+            last[gid] = now
         return gid
 
-    def _pad(self, cols, rel, grp, n):
+    def _alloc_group(self, k, now: Optional[int] = None) -> int:
+        return self._alloc_id(
+            k, self._group_ids, self._group_vals, self._group_free,
+            self._group_last, self.n_groups,
+            f"device query: group cardinality exceeded "
+            f"n_groups={self.n_groups}", now)
+
+    def _intern_wgroups(self, pk: np.ndarray, now: int) -> np.ndarray:
+        """Partition-key values -> dense window-group ids."""
+        uniq, inv = np.unique(np.asarray(pk), return_inverse=True)
+        out_u = np.empty(len(uniq), dtype=np.int32)
+        for i, k in enumerate(uniq.tolist()):
+            out_u[i] = self._alloc_wgrp(k, now)
+        return out_u[inv].astype(np.int32, copy=False)
+
+    def _alloc_wgrp(self, k, now: int) -> int:
+        return self._alloc_id(
+            k, self._wgrp_ids, self._wgrp_vals, self._wgrp_free,
+            self._wgrp_last, self.n_wgroups,
+            f"device query: partition-key cardinality exceeded "
+            f"{self.n_wgroups} (raise @app:execution partitions or "
+            "enable @purge)", now)
+
+    def purge_idle_keys(self, state, now: int, idle_ms: Optional[int]):
+        """Reclaim device state rows of partition keys idle for
+        ``idle_ms`` (the analog of PartitionRuntime dropping idle
+        per-key instances; ids return to the free lists after their
+        rows are zeroed).  Returns ``(state, n_purged_keys)``."""
+        if not self.partition_mode or idle_ms is None:
+            return state, 0
+        dead_w = [w for w, t in self._wgrp_last.items()
+                  if now - t >= idle_ms]
+        if not dead_w:
+            return state, 0
+        jnp = self.jnp
+        state = dict(state)
+        dead_pk = {self._wgrp_vals[w] for w in dead_w}
+        if self.group_exprs:
+            # composed groups die with their partition key (the host
+            # instance dies whole); key-active groups stay even if the
+            # group itself has been quiet
+            dead_g = [gid for k, gid in self._group_ids.items()
+                      if k[0] in dead_pk]
+        else:
+            dead_g = list(dead_w)  # grp aliases wgrp
+        if dead_g and self.kind == "running":
+            gi = jnp.asarray(np.asarray(dead_g, dtype=np.int32))
+            for key in ("acc_sum", "acc_cnt"):
+                if key in state:
+                    state[key] = state[key].at[gi].set(0.0)
+            if "acc_min" in state:
+                state["acc_min"] = state["acc_min"].at[gi].set(jnp.inf)
+            if "acc_max" in state:
+                state["acc_max"] = state["acc_max"].at[gi].set(-jnp.inf)
+        if self.kind == "keyed_sliding":
+            wi = jnp.asarray(np.asarray(dead_w, dtype=np.int32))
+            state["win_valid"] = state["win_valid"].at[wi].set(False)
+            state["win_count"] = state["win_count"].at[wi].set(0)
+        for w in dead_w:
+            del self._wgrp_ids[self._wgrp_vals[w]]
+            self._wgrp_vals[w] = None
+            self._wgrp_free.append(w)
+            del self._wgrp_last[w]
+        if self.group_exprs:
+            for gid in dead_g:
+                del self._group_ids[self._group_vals[gid]]
+                self._group_vals[gid] = None
+                self._group_free.append(gid)
+                self._group_last.pop(gid, None)
+        return state, len(dead_w)
+
+    def _pad(self, cols, rel, grp, n, wgrp=None):
         jnp = self.jnp
         B = _pow2(n)
         valid = np.zeros(B, dtype=bool)
@@ -928,21 +1214,39 @@ class DeviceQueryEngine:
         t[:n] = rel[:n]
         g = np.zeros(B, dtype=np.int32)
         g[:n] = grp[:n]
-        return c, jnp.asarray(t), jnp.asarray(g), jnp.asarray(valid), B
+        wg = np.zeros(B, dtype=np.int32)
+        if wgrp is not None:
+            wg[:n] = wgrp[:n]
+        return c, jnp.asarray(t), jnp.asarray(g), jnp.asarray(wg), \
+            jnp.asarray(valid), B
 
-    def _out_columns(self, vals, sel, gids, in_cols, in_sel) -> Dict[str, np.ndarray]:
+    def _out_columns(self, vals, sel, gids, in_cols, in_sel,
+                     host_env=None) -> Dict[str, np.ndarray]:
         """Assemble output columns (declared dtypes) for the selected
         rows.  ``vals``: {name: [*]} device column dict; ``sel``: row
-        indices into it; ``gids``: group id per output row;
-        ``in_cols``/``in_sel``: input batch columns + row indices for
-        passthrough items (None for flush outputs, which cannot have
-        passthroughs)."""
+        indices into it; ``gids``: group id per output row (None for the
+        stateless filter kind — group keys are then evaluated host-side
+        from ``host_env``); ``in_cols``/``in_sel``: input batch columns
+        + row indices for passthrough items (None for flush outputs,
+        which cannot have passthroughs)."""
         cols: Dict[str, np.ndarray] = {}
         for oi, (kind, v, name) in enumerate(self.out_spec):
             t = self.out_types[oi]
             if kind == "group_key":
+                if gids is None:
+                    # no interned ids: evaluate the key expr directly
+                    n = host_env[N_KEY]
+                    col = np.broadcast_to(
+                        np.asarray(self.group_exprs[v].fn(host_env)), (n,))
+                    cols[name] = col[in_sel].astype(t.np_dtype, copy=False)
+                    continue
                 comp = [self._group_vals[int(g)] for g in gids]
-                comp = [k[v] if isinstance(k, tuple) else k for k in comp]
+                if self.partition_mode:
+                    # composed tuple is (partition_key, *group_keys)
+                    comp = [k[v + 1] for k in comp]
+                else:
+                    comp = [k[v] if isinstance(k, tuple) else k
+                            for k in comp]
                 cols[name] = (
                     np.asarray(comp, dtype=t.np_dtype) if comp
                     else np.empty(0, dtype=t.np_dtype))
@@ -972,37 +1276,76 @@ class DeviceQueryEngine:
             [np.full(c[2], c[1], dtype=np.int64) for c in chunks])
         return out_cols, out_ts
 
-    def process_batch(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+    def process_batch(self, state, cols: Dict[str, np.ndarray],
+                      ts: np.ndarray,
+                      part_keys: Optional[np.ndarray] = None):
         """Columnar host entry point: ``(state, out_cols, out_ts)`` with
         output columns cast back to the declared attribute types (the
-        product runtime builds an EventBatch straight from these)."""
+        product runtime builds an EventBatch straight from these).
+        ``part_keys`` (partition mode only): raw partition-key value per
+        row."""
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         if n == 0:
             return state, self._empty_cols(), np.empty(0, dtype=np.int64)
+        if self.partition_mode and part_keys is None:
+            raise SiddhiAppRuntimeError(
+                "partitioned device query needs per-row partition keys")
+        pk = np.asarray(part_keys) if part_keys is not None else None
+        if n > MAX_DEVICE_BATCH and self.kind != "tumbling":
+            chunks = []
+            for i in range(0, n, MAX_DEVICE_BATCH):
+                sl = slice(i, i + MAX_DEVICE_BATCH)
+                state, oc, ot = self.process_batch(
+                    state, {k: np.asarray(v)[sl] for k, v in cols.items()},
+                    ts[sl], pk[sl] if pk is not None else None)
+                chunks.append((oc, ot))
+            out_cols = {
+                nm: np.concatenate([c[0][nm] for c in chunks])
+                for nm in self.output_names
+            }
+            return state, out_cols, np.concatenate([c[1] for c in chunks])
         if self.base_ts is None:
             self.base_ts = int(ts[0]) - 1
         rel64 = ts - self.base_ts
         if int(rel64.max()) >= self._REL_LIMIT:
             state, rel64 = self._re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
-        grp = self._intern_groups(cols, ts, n)
-        if self.kind in ("filter", "running", "sliding"):
+        now = int(ts.max())
+        if self.kind == "filter":
+            # stateless: no interning at all (group-key select items are
+            # evaluated host-side below) — unbounded key cardinality
+            grp = wgrp = np.zeros(n, dtype=np.int32)
+        elif self.partition_mode:
+            wgrp = self._intern_wgroups(pk, now)
+            grp = (self._intern_groups(cols, ts, n, pk=pk, now=now)
+                   if self.group_exprs else wgrp)
+        else:
+            wgrp = None
+            grp = self._intern_groups(cols, ts, n)
+        if self.kind in ("filter", "running", "sliding", "keyed_sliding"):
             step = self.make_step()
-            c, t, g, valid, B = self._pad(cols, rel, grp, n)
-            state, ov, out = step(state, c, t, g, valid)
+            c, t, g, wg, valid, B = self._pad(cols, rel, grp, n, wgrp)
+            state, ov, out = step(state, c, t, g, wg, valid)
             idx = np.flatnonzero(np.asarray(ov)[:n])
             out_np = {k: np.asarray(col)[:n] for k, col in out.items()}
-            out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
+            if self.kind == "filter":
+                out_cols = self._out_columns(
+                    out_np, idx, None, cols, idx,
+                    host_env=self._host_env(cols, ts, n))
+            else:
+                out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
             return state, out_cols, ts[idx]
         state, out_cols, out_ts = self._process_tumbling(
             state, cols, rel, grp, n)
         return state, out_cols, out_ts
 
-    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray,
+                part_keys: Optional[np.ndarray] = None):
         """Host entry point.  Returns ``(state, rows)`` where rows are
         emitted output dicts in emission order."""
-        state, out_cols, out_ts = self.process_batch(state, cols, ts)
+        state, out_cols, out_ts = self.process_batch(state, cols, ts,
+                                                     part_keys)
         names = self.output_names
         rows = [
             {nm: out_cols[nm][i] for nm in names}
@@ -1068,7 +1411,7 @@ class DeviceQueryEngine:
     def _acc_segment(self, state, cols, rel, grp, idx) -> Tuple[object, int]:
         acc = self.make_acc_step()
         n = len(idx)
-        c, t, g, valid, B = self._pad(
+        c, t, g, _wg, valid, B = self._pad(
             {k: np.asarray(v)[idx] for k, v in cols.items()},
             rel[idx], grp[idx], n)
         gkv = np.zeros((B, max(len(self._numeric_group_keys), 1)),
@@ -1148,6 +1491,12 @@ class DeviceQueryEngine:
             "base_ts": self.base_ts,
             "group_ids": dict(self._group_ids),
             "group_vals": list(self._group_vals),
+            "group_free": list(self._group_free),
+            "group_last": dict(self._group_last),
+            "wgrp_ids": dict(self._wgrp_ids),
+            "wgrp_vals": list(self._wgrp_vals),
+            "wgrp_free": list(self._wgrp_free),
+            "wgrp_last": dict(self._wgrp_last),
             "pane_end": self._pane_end,
             "pane_fill": self._pane_fill,
             "prev_pane_fill": self._prev_pane_fill,
@@ -1157,6 +1506,12 @@ class DeviceQueryEngine:
         self.base_ts = s["base_ts"]
         self._group_ids = dict(s["group_ids"])
         self._group_vals = list(s["group_vals"])
+        self._group_free = list(s.get("group_free", []))
+        self._group_last = dict(s.get("group_last", {}))
+        self._wgrp_ids = dict(s.get("wgrp_ids", {}))
+        self._wgrp_vals = list(s.get("wgrp_vals", []))
+        self._wgrp_free = list(s.get("wgrp_free", []))
+        self._wgrp_last = dict(s.get("wgrp_last", {}))
         self._pane_end = s["pane_end"]
         self._pane_fill = s["pane_fill"]
         self._prev_pane_fill = s["prev_pane_fill"]
@@ -1178,6 +1533,8 @@ def compile_query(
     query_name: Optional[str] = None,
     n_groups: int = 1024,
     window_capacity: int = 1024,
+    partition_mode: bool = False,
+    n_wgroups: Optional[int] = None,
 ) -> DeviceQueryEngine:
     """Compile a SiddhiQL single-stream query into a DeviceQueryEngine."""
     from siddhi_tpu.compiler import SiddhiCompiler
@@ -1201,4 +1558,5 @@ def compile_query(
     if d is None:
         raise SiddhiAppCreationError(f"stream '{s.stream_id}' is not defined")
     return DeviceQueryEngine(
-        query, d, n_groups=n_groups, window_capacity=window_capacity)
+        query, d, n_groups=n_groups, window_capacity=window_capacity,
+        partition_mode=partition_mode, n_wgroups=n_wgroups)

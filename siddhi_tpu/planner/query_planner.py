@@ -374,7 +374,9 @@ class QueryPlanner:
                     "query '%s': pattern lowered to the dense TPU path", name)
                 return qr
             except SiddhiAppCreationError as e:
-                logging.getLogger("siddhi_tpu").info(
+                # WARN: the user asked for execution('tpu') and is
+                # getting host execution — must be visible
+                logging.getLogger("siddhi_tpu").warning(
                     "query '%s': dense TPU path unavailable (%s); "
                     "using host pattern engine", name, e)
 
@@ -577,6 +579,7 @@ class QueryPlanner:
             # scheduler arming)
             qr._dense_timer_task = runtime
             self.app.scheduler.register_task(runtime)
+        qr.lowered_to = "dense"
         return qr
 
     # -- single stream ------------------------------------------------------
@@ -599,7 +602,9 @@ class QueryPlanner:
                     name)
                 return qr
             except SiddhiAppCreationError as e:
-                logging.getLogger("siddhi_tpu").info(
+                # WARN: the user asked for execution('tpu') and is
+                # getting host execution — must be visible
+                logging.getLogger("siddhi_tpu").warning(
                     "query '%s': device query path unavailable (%s); "
                     "using host engine", name, e)
 
@@ -629,11 +634,20 @@ class QueryPlanner:
         return qr
 
     def _plan_device_single(
-        self, query: Query, name: str, s: SingleInputStream
+        self, query: Query, name: str, s: SingleInputStream,
+        partition_mode: bool = False, subscribe: bool = True,
     ) -> QueryRuntime:
         """Plan a single-stream query onto the jitted device engine;
         raises SiddhiAppCreationError when the query is outside the
-        device subset (caller falls back to the host chain)."""
+        device subset (caller falls back to the host chain).
+
+        ``partition_mode``/``subscribe=False`` come from the partitioned
+        form (PartitionRuntime._plan_dense): the partition key arrives
+        per batch from the partition receiver and composes into the
+        engine's group axis — per-key state rows in device memory
+        instead of per-key Python instances (reference semantics:
+        partition/PartitionStreamReceiver.java:82-118 +
+        util/snapshot/state/PartitionStateHolder.java:43)."""
         from siddhi_tpu.core.device_single import (
             DeviceQueryRuntime,
             _DeviceQueryReceiver,
@@ -665,10 +679,20 @@ class QueryPlanner:
                 raise SiddhiAppCreationError(
                     "table/aggregation inputs need the host planner")
 
+        if partition_mode and query.output_rate is not None:
+            # the host partitioned form gives each key instance its OWN
+            # rate limiter; one shared limiter would pool emission
+            # windows across keys (same contract as the dense NFA gate)
+            raise SiddhiAppCreationError(
+                "partitioned queries with output rate limits need "
+                "per-key limiters — host instances used")
         definition = self.app.resolve_stream_definition(s)
         engine = DeviceQueryEngine(
             query, definition,
             n_groups=self.app.app_context.tpu_partitions,
+            partition_mode=partition_mode,
+            n_wgroups=(self.app.app_context.tpu_partitions
+                       if partition_mode else None),
         )
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
         out_attrs = [
@@ -687,15 +711,20 @@ class QueryPlanner:
         runtime = DeviceQueryRuntime(
             engine, f"#device_{name}", emit=lambda b: qr.process(b, 0))
         qr.device_runtime = runtime
-        junction = self.app.junction_for_input(s)
-        junction.subscribe(_DeviceQueryReceiver(runtime))
+        if subscribe:
+            junction = self.app.junction_for_input(s)
+            junction.subscribe(_DeviceQueryReceiver(runtime))
         # registered LAST: nothing below may raise, so a fallback to the
-        # host path never leaks a live scheduler task
-        self.app.scheduler.register_task(runtime)
-        if rate_limiter.needs_scheduler_task:
-            task = _RateLimiterTask(qr, rate_limiter)
-            qr._rate_task = task
-            self.app.scheduler.register_task(task)
+        # host path never leaks a live scheduler task.  Partition mode
+        # registers nothing: tumbling panes (the only timer need) are
+        # ineligible there, and the partition runtime owns purge timing.
+        if not partition_mode:
+            self.app.scheduler.register_task(runtime)
+            if rate_limiter.needs_scheduler_task:
+                task = _RateLimiterTask(qr, rate_limiter)
+                qr._rate_task = task
+                self.app.scheduler.register_task(task)
+        qr.lowered_to = "device"
         return qr
 
     def _plan_rate_limiter(self, query: Query):

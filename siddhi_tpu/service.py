@@ -60,6 +60,9 @@ class SiddhiService:
                 elif len(parts) == 3 and parts[1] == "siddhi-pattern-state":
                     code, payload = service.pattern_state(parts[2])
                     self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-query-lowering":
+                    code, payload = service.query_lowering(parts[2])
+                    self._send(code, payload)
                 elif self.path.rstrip("/") == "/siddhi-apps":
                     self._send(200, {"status": "OK", "apps": service.app_names()})
                 else:
@@ -128,6 +131,19 @@ class SiddhiService:
                 "message": f"there is no Siddhi app named '{name}'",
             }
         return 200, {"status": "OK", "queries": runtime.pattern_state()}
+
+    def query_lowering(self, name: str):
+        """Per-query engine placement (host | dense | device) of a
+        deployed app — which queries actually lowered to a device
+        engine under @app:execution('tpu')."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        return 200, {"status": "OK", "queries": runtime.lowering()}
 
     def app_names(self):
         with self._lock:

@@ -114,6 +114,10 @@ class StatisticsManager:
         self.throughput: Dict[str, ThroughputTracker] = {}
         self.latency: Dict[str, LatencyTracker] = {}
         self.buffers: Dict[str, BufferedEventsTracker] = {}
+        # per-query engine placement ('host' | 'dense' | 'device'),
+        # populated at app build — not a counter, but reported alongside
+        # so execution('tpu') fallbacks are visible in the metrics feed
+        self.lowering: Dict[str, str] = {}
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -132,8 +136,11 @@ class StatisticsManager:
     def buffer_tracker(self, name: str, junction) -> BufferedEventsTracker:
         return self.buffers.setdefault(name, BufferedEventsTracker(name, junction))
 
-    def stats(self) -> Dict[str, float]:
-        out: Dict[str, float] = {}
+    def stats(self) -> Dict[str, object]:
+        """Metric name -> value.  Values are floats except the
+        ``Queries.<name>.loweredTo`` keys, whose values are the strings
+        'host' | 'dense' | 'device'."""
+        out: Dict[str, object] = {}
         # snapshot the registries: _apply_statistics_level repopulates
         # them from another thread while the reporter iterates
         for t in list(self.throughput.values()):
@@ -145,6 +152,8 @@ class StatisticsManager:
             out[self._metric("Queries", l.name, "events")] = l.events
         for b in list(self.buffers.values()):
             out[self._metric("Streams", b.name, "bufferedEvents")] = b.buffered()
+        for qname, engine in list(self.lowering.items()):
+            out[self._metric("Queries", qname, "loweredTo")] = engine
         return out
 
     def reset(self):

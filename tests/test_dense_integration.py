@@ -253,7 +253,10 @@ class TestDensePartition:
         rt.shutdown()
         assert errors  # routed to the app's exception listeners
 
-    def test_partition_fallback_on_non_pattern_body(self, manager):
+    def test_partition_general_query_lowers_to_device(self, manager):
+        # round 5: general (non-pattern) partition bodies lower to the
+        # device query engine with the key composed into the group axis
+        # (previously they fell back to per-key instances)
         app = (
             "@app:execution('tpu') "
             "define stream S (k string, v double); "
@@ -265,7 +268,8 @@ class TestDensePartition:
             (["a", 1.0], 10), (["a", 2.0], 20), (["b", 5.0], 30),
         ], out="Out", stream="S")
         pr = rt.partitions["partition_0"]
-        assert not pr.is_dense  # per-key instances still work under tpu mode
+        assert pr.is_dense
+        assert pr.query_lowering() == {"q": "device"}
         assert got == [["a", 1.0], ["a", 3.0], ["b", 5.0]]
 
     def test_partition_dense_persist_restore(self, manager):

@@ -1,0 +1,345 @@
+"""Partitioned general queries on the device engine, differentially
+against the host per-key-instance form (reference semantics:
+partition/PartitionRuntimeImpl.java:75, PartitionStreamReceiver.java:
+82-118 — each key behaves as its own cloned query).
+
+Per-event sends must match the host ORDER exactly; batched sends match
+as multisets (the host routes key-grouped sub-batches, the device
+engine emits in input-row order — both are interleavings of identical
+per-key subsequences).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import EventBatch
+
+DEFS = "define stream S (user string, v double, k int); "
+
+
+def run_app(app_body, events, tpu, batched=False, partitions=64,
+            expect_dense=True):
+    """events: list of (user, v, k, ts)."""
+    mode = (f"@app:execution('tpu', partitions='{partitions}') "
+            if tpu else "")
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + mode + DEFS + app_body)
+        if tpu and expect_dense:
+            pr = rt.partitions["partition_0"]
+            assert pr.is_dense, "expected the partition to lower densely"
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(
+            tuple(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        if batched:
+            users = np.asarray([e[0] for e in events])
+            vs = np.asarray([e[1] for e in events], dtype=np.float64)
+            ks = np.asarray([e[2] for e in events], dtype=np.int32)
+            ts = np.asarray([e[3] for e in events], dtype=np.int64)
+            h.send_batch(EventBatch(
+                "S", ["user", "v", "k"],
+                {"user": users, "v": vs, "k": ks}, ts))
+        else:
+            for u, v, k, t in events:
+                h.send([u, float(v), int(k)], timestamp=t)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def _rows_match(a, b):
+    """Row equality with rel tolerance on floats (device state
+    accumulates in float32, a documented precision subset of the host's
+    float64 — ops/device_query.py module docstring)."""
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, float) or isinstance(y, float):
+            if y != pytest.approx(x, rel=1e-4, abs=1e-6):
+                return False
+        elif x != y:
+            return False
+    return True
+
+
+def assert_differential(app_body, events, batched=False, **kw):
+    """Device vs host.  Per-event sends compare in exact order.  For
+    batched sends the reference side is the host run PER EVENT — the
+    reference's event-at-a-time semantics — compared as multisets: the
+    device batch path preserves per-event semantics regardless of
+    batching (per-row time-window expiry), while the host batch path
+    approximates time windows at the batch watermark."""
+    host = run_app(app_body, events, tpu=False, batched=False, **kw)
+    dev = run_app(app_body, events, tpu=True, batched=batched, **kw)
+    assert len(host) == len(dev), (host, dev)
+    if batched:
+        skey = lambda rows: sorted(
+            rows, key=lambda r: tuple(
+                round(x, 3) if isinstance(x, float) else repr(x)
+                for x in r))
+        host, dev = skey(host), skey(dev)
+    for i, (a, b) in enumerate(zip(host, dev)):
+        assert _rows_match(a, b), f"row {i}: {a} != {b}"
+    return dev
+
+
+def events_seq(n=40, seed=0, users=("a", "b", "c"), t_step=100):
+    rng = np.random.default_rng(seed)
+    out = []
+    t = 1_000
+    for _ in range(n):
+        out.append((
+            users[int(rng.integers(len(users)))],
+            round(float(rng.uniform(0, 10)), 3),
+            int(rng.integers(0, 3)),
+            t,
+        ))
+        t += int(rng.integers(1, t_step))
+    return out
+
+
+PARTITION = "partition with (user of S) begin {q} end;"
+
+
+class TestPartitionedFilter:
+    def test_filter_projection(self):
+        q = ("@info(name='q') from S[v > 5.0] select user, v "
+             "insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_filter_batched(self):
+        q = ("@info(name='q') from S[v > 5.0 and k != 1] select user, v, k "
+             "insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq(64),
+                            batched=True)
+
+    def test_two_filter_queries(self):
+        # the reference's SimplePartitionedDoubleFilterQueryPerformance
+        # shape: two filter queries in one partition body
+        q = ("@info(name='q1') from S[v > 5.0] select user, v "
+             "insert into Out; "
+             "@info(name='q2') from S[v <= 5.0] select user, v "
+             "insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+
+class TestPartitionedRunningAggregates:
+    @pytest.mark.parametrize("agg", ["sum(v)", "count()", "avg(v)",
+                                     "min(v)", "max(v)"])
+    def test_running(self, agg):
+        q = (f"@info(name='q') from S select user, {agg} as a "
+             "insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_running_with_filter(self):
+        q = ("@info(name='q') from S[v > 2.0] select user, sum(v) as a, "
+             "count() as c insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_inner_group_by(self):
+        # per-(key, group) state: composed group axis
+        q = ("@info(name='q') from S select user, k, sum(v) as a "
+             "group by k insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_inner_group_by_having(self):
+        q = ("@info(name='q') from S select user, k, sum(v) as a "
+             "group by k having a > 10.0 insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq(60))
+
+    def test_batched_running(self):
+        q = ("@info(name='q') from S select user, sum(v) as a "
+             "insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq(64),
+                            batched=True)
+
+
+class TestPartitionedSlidingWindows:
+    @pytest.mark.parametrize("agg", ["sum(v)", "count()", "avg(v)",
+                                     "min(v)", "max(v)"])
+    def test_length_window(self, agg):
+        q = (f"@info(name='q') from S#window.length(3) select user, "
+             f"{agg} as a insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_length_window_with_filter(self):
+        q = ("@info(name='q') from S[v > 2.0]#window.length(2) "
+             "select user, sum(v) as a insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    @pytest.mark.parametrize("agg", ["sum(v)", "count()", "min(v)"])
+    def test_time_window(self, agg):
+        q = (f"@info(name='q') from S#window.time(250 ms) select user, "
+             f"{agg} as a insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_time_window_group_by(self):
+        q = ("@info(name='q') from S#window.time(300 ms) select user, k, "
+             "count() as c group by k insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq())
+
+    def test_length_window_batched(self):
+        q = ("@info(name='q') from S#window.length(4) select user, "
+             "sum(v) as a insert into Out;")
+        assert_differential(PARTITION.format(q=q), events_seq(64),
+                            batched=True)
+
+    def test_window_displacement_within_one_batch(self):
+        # one key floods > W events in a single batch: displaced rows
+        # must never land in the ring buffer
+        events = [("a", float(i), 0, 1000 + i) for i in range(16)]
+        q = ("@info(name='q') from S#window.length(3) select user, "
+             "sum(v) as a insert into Out;")
+        assert_differential(PARTITION.format(q=q), events, batched=True)
+
+
+class TestRangePartitionsOnDevice:
+    def test_range_partition_running(self):
+        body = ("partition with (v < 5.0 as 'low' or v >= 5.0 as 'high' "
+                "of S) begin @info(name='q') from S select k, count() as c "
+                "insert into Out; end;")
+        assert_differential(body, events_seq())
+
+
+class TestMixedPartitionBody:
+    def test_pattern_and_filter_in_one_partition(self):
+        # pattern lowers to the dense NFA, the filter to the device
+        # query engine — both under one partition
+        body = ("partition with (user of S) begin "
+                "@info(name='pat') from every e1=S[v > 8.0] -> "
+                "e2=S[v > 8.0] within 10 sec "
+                "select e1.v as v1, e2.v as v2 insert into Out; "
+                "@info(name='flt') from S[v > 9.0] select user, v "
+                "insert into Out; end;")
+        assert_differential(body, events_seq(60, seed=3))
+
+
+class TestFallbacks:
+    """Ineligible partition bodies fall back WHOLESALE to per-key
+    instances (and still produce host-exact results trivially)."""
+
+    def _is_dense(self, body):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu', partitions='16') "
+                + DEFS + body)
+            return rt.partitions["partition_0"].is_dense
+        finally:
+            m.shutdown()
+
+    def test_tumbling_falls_back(self):
+        q = ("@info(name='q') from S#window.lengthBatch(3) select user, "
+             "sum(v) as a insert into Out;")
+        assert not self._is_dense(PARTITION.format(q=q))
+        assert_differential(PARTITION.format(q=q), events_seq(),
+                            partitions=16, expect_dense=False)
+
+    def test_rate_limit_falls_back(self):
+        q = ("@info(name='q') from S select user, sum(v) as a "
+             "output last every 3 events insert into Out;")
+        assert not self._is_dense(PARTITION.format(q=q))
+
+    def test_order_by_falls_back(self):
+        q = ("@info(name='q') from S select user, v order by v "
+             "insert into Out;")
+        assert not self._is_dense(PARTITION.format(q=q))
+
+    def test_mixed_with_ineligible_falls_back_wholesale(self):
+        q = ("@info(name='q1') from S select user, sum(v) as a "
+             "insert into Out; "
+             "@info(name='q2') from S#window.lengthBatch(2) select user, "
+             "sum(v) as a insert into Out;")
+        assert not self._is_dense(PARTITION.format(q=q))
+
+
+class TestPartitionedDevicePersistence:
+    def test_snapshot_restore_roundtrip(self):
+        from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+        app = ("@app:name('pdp') @app:playback "
+               "@app:execution('tpu', partitions='16') " + DEFS +
+               PARTITION.format(q=(
+                   "@info(name='q') from S#window.length(2) select user, "
+                   "sum(v) as a insert into Out;")))
+        m = SiddhiManager()
+        m.set_persistence_store(InMemoryPersistenceStore())
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            assert rt.partitions["partition_0"].is_dense
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send(["a", 1.0, 0], timestamp=1000)
+            h.send(["a", 3.0, 0], timestamp=1001)
+            h.send(["b", 7.0, 0], timestamp=1002)
+            rev = rt.persist()
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            got = []
+            rt2.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt2.start()
+            rt2.restore_revision(rev)
+            h2 = rt2.get_input_handler("S")
+            h2.send(["a", 10.0, 0], timestamp=1003)  # window [3, 10]
+            h2.send(["b", 1.0, 0], timestamp=1004)   # window [7, 1]
+            rt2.shutdown()
+            assert got == [("a", 13.0), ("b", 8.0)], got
+        finally:
+            m.shutdown()
+
+
+class TestPartitionedFuzz:
+    """Seeded sweep over query shape x event stream combinations."""
+
+    QUERIES = [
+        "from S[v > 4.0] select user, v insert into Out;",
+        "from S select user, sum(v) as a, max(v) as m insert into Out;",
+        "from S[k != 0] select user, count() as c insert into Out;",
+        "from S select user, k, avg(v) as a group by k insert into Out;",
+        "from S#window.length(2) select user, sum(v) as a insert into Out;",
+        "from S#window.length(5) select user, min(v) as a, count() as c "
+        "insert into Out;",
+        "from S[v > 1.0]#window.time(200 ms) select user, sum(v) as a "
+        "insert into Out;",
+        "from S#window.time(150 ms) select user, k, count() as c "
+        "group by k insert into Out;",
+    ]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        for qi, q in enumerate(self.QUERIES):
+            events = events_seq(
+                n=int(rng.integers(20, 60)), seed=seed * 31 + qi,
+                users=tuple("uvwxyz"[: int(rng.integers(2, 6))]),
+                t_step=int(rng.integers(20, 200)))
+            assert_differential(
+                PARTITION.format(q=f"@info(name='q') {q}"), events,
+                batched=bool(rng.integers(2)))
+
+
+class TestPartitionedDevicePurge:
+    def test_purge_frees_rows_and_matches_host_reset(self):
+        app_body = (
+            "@purge(enable='true', interval='1 sec', idle.period='2 sec') "
+            + PARTITION.format(q=(
+                "@info(name='q') from S select user, count() as c "
+                "insert into Out;")))
+        # host and device must agree INCLUDING the purge-induced reset
+        events = [("a", 1.0, 0, 1000), ("b", 1.0, 0, 1001),
+                  ("a", 1.0, 0, 1500),
+                  # watermark jump: both engines purge idle keys
+                  ("a", 1.0, 0, 60_000), ("b", 1.0, 0, 60_001)]
+        host = run_app(app_body, events, tpu=False)
+        dev = run_app(app_body, events, tpu=True, partitions=2)
+        assert host == dev, (host, dev)
+        # b restarted at 1 (purged), proving a 2-row engine survived 2
+        # distinct live keys + 1 reused row
+        assert dev[-1] == ("b", 1)

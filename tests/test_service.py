@@ -110,3 +110,68 @@ def test_deploy_conflicts_with_manager_registered_app(service):
         assert service.manager.get_siddhi_app_runtime("restApp") is rt
     finally:
         rt.shutdown()
+
+
+def test_query_lowering_endpoint(service):
+    base = f"http://127.0.0.1:{service.port}"
+    app = (
+        "@app:name('lowApp') @app:playback "
+        "@app:execution('tpu', partitions='16') "
+        "define stream S (user string, v double); "
+        "@info(name='dev') from S select user, sum(v) as t insert into A; "
+        "@info(name='hostq') from S select user, v order by v "
+        "insert into B; "
+        "partition with (user of S) begin "
+        "@info(name='pq') from S[v > 1.0] select user, v insert into C; "
+        "end;"
+    )
+    status, payload = post(f"{base}/siddhi-artifact-deploy", app)
+    assert status == 200, payload
+    status, payload = get(f"{base}/siddhi-query-lowering/lowApp")
+    assert status == 200
+    q = payload["queries"]
+    assert q["dev"] == "device"       # eligible single-stream query
+    assert q["hostq"] == "host"       # order-by keeps the host selector
+    assert q["pq"] == "device"        # partitioned filter on the device
+    status, payload = get(f"{base}/siddhi-query-lowering/ghost")
+    assert status == 404
+
+
+def test_lowering_in_statistics(service):
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:name('statsLow') @app:playback @app:statistics "
+            "@app:execution('tpu', partitions='8') "
+            "define stream S (user string, v double); "
+            "@info(name='dq') from S select user, count() as c "
+            "insert into Out;")
+        sm = rt.app_context.statistics_manager
+        stats = sm.stats()
+        key = "io.siddhi.SiddhiApps.statsLow.Siddhi.Queries.dq.loweredTo"
+        assert stats[key] == "device"
+        assert rt.lowering() == {"dq": "device"}
+    finally:
+        m.shutdown()
+
+
+def test_fallback_warns(caplog):
+    import logging
+
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    try:
+        with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') "
+                "define stream S (user string, v double); "
+                "@info(name='hq') from S select user, v order by v "
+                "insert into Out;")
+        assert rt.lowering() == {"hq": "host"}
+        assert any("device query path unavailable" in r.getMessage()
+                   for r in caplog.records), caplog.records
+    finally:
+        m.shutdown()
