@@ -221,6 +221,12 @@ class MinMaxForeverAgg(AggExecutor):
         cur = np.nan if state["v"] is None else state["v"]
         return np.full(n, cur, dtype=np.float64)
 
+    def reset(self, state: dict):
+        # forever values survive window RESETs: the reference's reset()
+        # returns the current value WITHOUT clearing state
+        # (MinForeverAttributeAggregatorExecutor.java:179-181)
+        pass
+
 
 class DistinctCountAgg(AggExecutor):
     return_type = AttrType.LONG

@@ -169,7 +169,13 @@ class DenseExprCompiler(ExpressionCompiler):
     integer leaves compile to bit-exact paired compares at ANY
     magnitude.  Every other integer use (arithmetic, function args)
     raises, sending the query to the host engine — the reference is
-    per-type exact and so are we, just along a narrower surface."""
+    per-type exact and so are we, just along a narrower surface.
+
+    ``PAIR_TYPES`` is the attribute-type set riding pair lanes; the
+    device query engine subclasses with LONG-only (its INT attributes
+    keep plain int32 lanes)."""
+
+    PAIR_TYPES = _INT_TYPES
 
     def _i64_parts(self, e, var_only=False):
         """Integer leaf -> (hi_fn, lo_fn) env readers, else None.
@@ -184,7 +190,7 @@ class DenseExprCompiler(ExpressionCompiler):
             return (lambda env: hi), (lambda env: lo)
         if isinstance(e, Variable):
             key, t = self.scope.resolve(e)
-            if t in _INT_TYPES:
+            if t in self.PAIR_TYPES:
                 return ((lambda env: env[key + "|hi"]),
                         (lambda env: env[key + "|lo"]))
         return None
@@ -223,7 +229,7 @@ class DenseExprCompiler(ExpressionCompiler):
 
     def _c_Variable(self, e):
         key, t = self.scope.resolve(e)
-        if t in _INT_TYPES:
+        if t in self.PAIR_TYPES:
             raise SiddhiAppCreationError(
                 "dense NFA: integer attribute used outside a plain "
                 "comparison (arithmetic/functions on 64-bit lanes need "

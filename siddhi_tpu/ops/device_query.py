@@ -31,7 +31,9 @@ contract):
  - single input stream; filters precede at most one window;
  - windows: none (running aggregates), length, time (sliding, per-event
    emission), lengthBatch, timeBatch (tumbling, per-flush emission);
- - aggregators: sum / count / avg / min / max;
+ - aggregators: sum / count / avg / min / max / stdDev / minForever /
+   maxForever / and / or (distinctCount and unionSet keep unbounded
+   per-group value sets — documented host fallback);
  - filter / select / having expressions must be jax-traceable (numeric
    attrs, arithmetic/comparison/boolean ops) — checked at compile time
    by actually tracing them;
@@ -109,8 +111,16 @@ from siddhi_tpu.query_api import (
     WindowHandler,
 )
 
-SUPPORTED_AGGS = ("sum", "count", "avg", "min", "max")
+SUPPORTED_AGGS = ("sum", "count", "avg", "min", "max", "stdDev",
+                  "minForever", "maxForever", "and", "or")
+# distinctCount / unionSet keep per-group value-count dicts (reference:
+# DistinctCountAttributeAggregatorExecutor) — unbounded value sets have
+# no fixed-shape device layout, so they are a documented host fallback
 SUPPORTED_WINDOWS = (None, "length", "time", "lengthBatch", "timeBatch")
+
+# aggregators whose window/running reduction is a masked SUM of the
+# (transformed) argument lane: and/or reduce over the bool lane
+_SUM_KINDS = ("sum", "avg", "stdDev", "and", "or")
 
 PER_EVENT = "per_event"
 PER_FLUSH = "per_flush"
@@ -124,9 +134,47 @@ MAX_DEVICE_BATCH = 2048
 
 @dataclass
 class DeviceAgg:
-    kind: str  # sum | count | avg | min | max
+    kind: str  # one of SUPPORTED_AGGS
     arg: Optional[CompiledExpression]  # None for count
     env_key: str
+
+
+def _DevicePairCompiler(scope, pair_keys):
+    """Compiler for device-evaluated expressions: LONG STREAM attributes
+    (``pair_keys``) ride hi/lo int32 pair lanes (bit-exact comparisons
+    at any magnitude); INT keeps its plain int32 lane; synthetic
+    LONG-typed env keys (count() outputs) ride ordinary float32 lanes.
+    Imported lazily to keep dense_nfa out of the module import path."""
+    from siddhi_tpu.ops.dense_nfa import DenseExprCompiler
+    from siddhi_tpu.planner.expr import ExpressionCompiler as _Plain
+
+    class _C(DenseExprCompiler):
+        PAIR_TYPES = (AttrType.LONG,)
+
+        def _i64_parts(self, e, var_only=False):
+            if isinstance(e, Variable):
+                key, _t = self.scope.resolve(e)
+                if key not in pair_keys:
+                    return None
+            return super()._i64_parts(e, var_only)
+
+        def _c_Variable(self, e):
+            key, t = self.scope.resolve(e)
+            if t in self.PAIR_TYPES and key not in pair_keys:
+                return _Plain._c_Variable(self, e)
+            return super()._c_Variable(e)
+
+    return _C(scope)
+
+
+def _split_i64(v: np.ndarray):
+    """int64 column -> (hi, lo) int32 lanes; lo is bias-signed so SIGNED
+    int32 comparison of lo equals UNSIGNED comparison of the raw low
+    word (ops/dense_nfa.py:91-105)."""
+    v = np.asarray(v, dtype=np.int64)
+    hi = (v >> 32).astype(np.int32)
+    lo = ((v & 0xFFFFFFFF) - 2**31).astype(np.int32)
+    return hi, lo
 
 
 def _map_children(expr: Expression, fn) -> Expression:
@@ -173,8 +221,11 @@ class _DeviceAggRewrite:
         ):
             if expr.name not in SUPPORTED_AGGS:
                 raise SiddhiAppCreationError(
-                    f"device query path does not support aggregator '{expr.name}'"
-                )
+                    f"device query path does not support aggregator "
+                    f"'{expr.name}'"
+                    + (" (unbounded value sets need the host engine)"
+                       if expr.name in ("distinctCount", "unionSet")
+                       else ""))
             key = f"__dagg_{len(self.aggs)}"
             arg = None
             if expr.args:
@@ -185,7 +236,15 @@ class _DeviceAggRewrite:
             elif expr.name != "count":
                 raise SiddhiAppCreationError(
                     f"aggregator '{expr.name}' needs an argument")
-            out_t = AttrType.LONG if expr.name == "count" else AttrType.DOUBLE
+            if expr.name in ("and", "or"):
+                if arg is None or arg.type != AttrType.BOOL:
+                    raise SiddhiAppCreationError(
+                        f"aggregator '{expr.name}' needs a boolean argument")
+                out_t = AttrType.BOOL
+            elif expr.name == "count":
+                out_t = AttrType.LONG
+            else:
+                out_t = AttrType.DOUBLE
             self.aggs.append(DeviceAgg(expr.name, arg, key))
             self.scope.add_bare(key, out_t)
             return Variable(attribute=key)
@@ -284,22 +343,32 @@ class DeviceQueryEngine:
 
         # -- scope / expression compilation ----------------------------------
         # device lanes: INT rides int32 (bit-exact), FLOAT/DOUBLE ride
-        # float32.  LONG gets NO lane — it is host-only (group keys /
-        # bare select items); _check_value_types rejects device-expr use
+        # float32, LONG rides a hi/lo int32 PAIR usable in plain
+        # comparisons (bit-exact at any magnitude — the dense NFA's
+        # lane technique, ops/dense_nfa.py:91-105); LONG arithmetic /
+        # aggregate arguments still fall back to the host engine.
         self._lane_dtype: Dict[str, np.dtype] = {
             a.name: (np.dtype(np.int32) if a.type == AttrType.INT
+                     else np.dtype(np.bool_) if a.type == AttrType.BOOL
                      else np.dtype(np.float32))
             for a in stream_def.attributes
-            if a.type.is_numeric and a.type != AttrType.LONG
+            if (a.type.is_numeric or a.type == AttrType.BOOL)
+            and a.type != AttrType.LONG
         }
         self.attrs = list(self._lane_dtype)
+        self.long_attrs = [a.name for a in stream_def.attributes
+                           if a.type == AttrType.LONG]
         self.all_attrs = list(stream_def.attribute_names)
         scope = Scope()
         for a in stream_def.attributes:
             scope.add(s.alias or s.stream_id, a.name, a.name, a.type)
             if s.alias:
                 scope.add(s.stream_id, a.name, a.name, a.type)
-        compiler = ExpressionCompiler(scope)
+        # device-evaluated expressions: LONG stream attrs ride pair lanes
+        compiler = _DevicePairCompiler(scope, set(self.long_attrs))
+        # host-evaluated expressions (group keys, window constants):
+        # native numpy width, any type
+        host_compiler = ExpressionCompiler(scope)
 
         self.filters = [compiler.compile(e) for e in self.filter_exprs]
 
@@ -309,7 +378,7 @@ class DeviceQueryEngine:
             if not self.window_args:
                 raise SiddhiAppCreationError(
                     f"window '{self.window_name}' needs an argument")
-            c = compiler.compile(self.window_args[0])
+            c = host_compiler.compile(self.window_args[0])
             try:
                 self.window_param = int(c.fn({}))
             except Exception as e:
@@ -320,7 +389,7 @@ class DeviceQueryEngine:
         # group-by keys (exprs; interned host-side)
         sel = query.selector
         self.group_exprs: List[CompiledExpression] = [
-            compiler.compile(g) for g in (sel.group_by or [])
+            host_compiler.compile(g) for g in (sel.group_by or [])
         ]
         self.group_raw: List[Expression] = list(sel.group_by or [])
         # numeric group keys usable inside flush exprs
@@ -463,28 +532,41 @@ class DeviceQueryEngine:
 
     def _check_value_types(self, stream_def, s, sel):
         """Reject device-evaluated expressions (filters, computed select
-        items incl. aggregate arguments, having) that read a LONG
-        attribute — or a LONG constant outside int32 range: neither has
-        a 64-bit device lane, and int32/float32 would silently wrap or
-        round (the reference is per-type exact,
-        executor/math/ & condition/compare/).  Group-by keys and bare
-        select items stay host-side and may be any type."""
+        items incl. aggregate arguments, having) that use a LONG
+        attribute OUTSIDE a plain comparison, or a LONG constant outside
+        int32 range on a non-pair lane: LONG comparisons ride bit-exact
+        hi/lo int32 pairs (any magnitude), but LONG arithmetic has no
+        64-bit device lane and float32 would silently round above 2^24
+        (the reference is per-type exact, executor/math/ &
+        condition/compare/).  Group-by keys and bare select items stay
+        host-side and may be any type."""
         from siddhi_tpu.query_api import Constant
 
         names = set(stream_def.attribute_names)
         ids = (None, s.stream_id, s.alias)
 
+        def is_long_var(e):
+            return (isinstance(e, Variable) and e.stream_id in ids
+                    and e.attribute in names
+                    and stream_def.attribute_type(e.attribute)
+                    == AttrType.LONG)
+
         def walk(e):
+            if isinstance(e, CompareOp) and (
+                    is_long_var(e.left) or is_long_var(e.right)):
+                # pair-compare subtree: the device compiler takes the
+                # hi/lo path (or raises its own eligibility error when
+                # the other side is not pair-able) — any magnitude is
+                # bit-exact there
+                return e
             if isinstance(e, Variable):
-                if e.stream_id in ids and e.attribute in names:
-                    t = stream_def.attribute_type(e.attribute)
-                    if t == AttrType.LONG:
-                        raise SiddhiAppCreationError(
-                            f"device query path: attribute '{e.attribute}' "
-                            "is LONG and has no 64-bit device lane yet; "
-                            "float32 would lose precision above 2^24 — "
-                            "host engine used (LONG is fine as a group-by "
-                            "key or bare select item)")
+                if is_long_var(e):
+                    raise SiddhiAppCreationError(
+                        f"device query path: attribute '{e.attribute}' "
+                        "is LONG and used outside a plain comparison; "
+                        "its hi/lo lanes support comparisons only — "
+                        "host engine used (LONG is fine as a group-by "
+                        "key, bare select item, or comparison operand)")
                 return e
             if (isinstance(e, Constant) and e.type == AttrType.LONG
                     and e.value is not None
@@ -517,17 +599,26 @@ class DeviceQueryEngine:
             a: jax.ShapeDtypeStruct((B,), self._lane_dtype[a])
             for a in self.attrs
         }
-        env[TS_KEY] = jax.ShapeDtypeStruct((B,), np.int32)
+        i32 = jax.ShapeDtypeStruct((B,), np.int32)
+        for a in self.long_attrs:
+            env[a + "|hi"] = i32
+            env[a + "|lo"] = i32
+        env[TS_KEY] = i32
         env[N_KEY] = B
         for a in self.aggs:
-            env[a.env_key] = jax.ShapeDtypeStruct((B,), np.float32)
+            env[a.env_key] = jax.ShapeDtypeStruct(
+                (B,), np.bool_ if a.kind in ("and", "or") else np.float32)
         return env
 
     def _flush_env_shapes(self, G: int = 8):
         import jax
 
         f32 = jax.ShapeDtypeStruct((G,), np.float32)
-        env = {a.env_key: f32 for a in self.aggs}
+        env = {
+            a.env_key: (jax.ShapeDtypeStruct((G,), np.bool_)
+                        if a.kind in ("and", "or") else f32)
+            for a in self.aggs
+        }
         for i in self._numeric_group_keys:
             g = self.group_raw[i]
             if isinstance(g, Variable):
@@ -581,6 +672,7 @@ class DeviceQueryEngine:
         A = max(len(self.aggs), 1)
         G = self.n_groups
         state = {}
+        kinds = {a.kind for a in self.aggs}
         if self.kind == "sliding":
             W = self.W
             state["win_vals"] = jnp.zeros((W, A), dtype=jnp.float32)
@@ -596,12 +688,12 @@ class DeviceQueryEngine:
             state["win_valid"] = jnp.zeros((Gw, W), dtype=bool)
             state["win_count"] = jnp.zeros(Gw, dtype=jnp.int32)
         elif self.kind in ("running", "tumbling"):
-            kinds = {a.kind for a in self.aggs}
-            if kinds & {"sum", "avg"}:
+            if kinds & set(_SUM_KINDS):
                 state["acc_sum"] = jnp.zeros((G, A), dtype=jnp.float32)
-            if kinds & {"count", "avg"} or True:
-                # counts always kept: cheap, and avg/flush-valid need them
-                state["acc_cnt"] = jnp.zeros((G, A), dtype=jnp.float32)
+            if "stdDev" in kinds:
+                state["acc_sumsq"] = jnp.zeros((G, A), dtype=jnp.float32)
+            # counts always kept: cheap, and avg/flush-valid need them
+            state["acc_cnt"] = jnp.zeros((G, A), dtype=jnp.float32)
             if "min" in kinds:
                 state["acc_min"] = jnp.full((G, A), jnp.inf, dtype=jnp.float32)
             if "max" in kinds:
@@ -610,12 +702,26 @@ class DeviceQueryEngine:
                 state["touched"] = jnp.zeros(G, dtype=bool)
                 K = max(len(self._numeric_group_keys), 1)
                 state["grp_keys"] = jnp.zeros((G, K), dtype=jnp.float32)
+        # all-time accumulators (minForever/maxForever): per agg group,
+        # NEVER reset by window expiry or tumbling flushes
+        if self.kind in ("running", "tumbling", "sliding", "keyed_sliding"):
+            if "minForever" in kinds:
+                state["acc_minf"] = jnp.full((G, A), jnp.inf,
+                                             dtype=jnp.float32)
+            if "maxForever" in kinds:
+                state["acc_maxf"] = jnp.full((G, A), -jnp.inf,
+                                             dtype=jnp.float32)
         return state
 
     # -- steps ---------------------------------------------------------------
 
     def _base_env(self, cols, ts, B):
         env = {a: cols[a] for a in self.attrs if a in cols}
+        for a in self.long_attrs:
+            hk, lk = a + "|hi", a + "|lo"
+            if hk in cols:
+                env[hk] = cols[hk]
+                env[lk] = cols[lk]
         env[TS_KEY] = ts
         env[N_KEY] = B
         return env
@@ -665,6 +771,97 @@ class DeviceQueryEngine:
             fmask = fmask & jnp.asarray(self.having.fn(env_out)).astype(bool)
         return fmask, out
 
+    def _finalize_aggs(self, env_out, wsum, wcnt, wsumsq=None, wmin=None,
+                       wmax=None, fmin=None, fmax=None):
+        """Map reduced moments to aggregator output lanes.  ``wsum`` /
+        ``wcnt`` are the masked window (or running-total) sum and count
+        per row; ``wsumsq`` the sum of squares (stdDev); ``wmin/wmax``
+        the window min/max; ``fmin/fmax`` the all-time accumulators.
+        and/or reduce over their bool argument lane: and = no false
+        member (count == sum), or = some true member (sum > 0) — the
+        reference's true/false counters
+        (query/selector/attribute/aggregator/
+        AndAttributeAggregatorExecutor.java) as masked sums."""
+        jnp = self.jnp
+        for ai, a in enumerate(self.aggs):
+            k = a.kind
+            if k == "sum":
+                env_out[a.env_key] = wsum[:, ai]
+            elif k == "count":
+                env_out[a.env_key] = wcnt[:, 0]
+            elif k == "avg":
+                env_out[a.env_key] = wsum[:, ai] / jnp.maximum(wcnt[:, 0], 1.0)
+            elif k == "stdDev":
+                # population stddev from (sum, sumsq, n) — the host
+                # StdDevAgg decomposition in float32
+                nn = jnp.maximum(wcnt[:, 0], 1.0)
+                mean = wsum[:, ai] / nn
+                var = jnp.maximum(wsumsq[:, ai] / nn - mean * mean, 0.0)
+                env_out[a.env_key] = jnp.sqrt(var)
+            elif k == "min":
+                env_out[a.env_key] = wmin[:, ai]
+            elif k == "max":
+                env_out[a.env_key] = wmax[:, ai]
+            elif k == "minForever":
+                env_out[a.env_key] = fmin[:, ai]
+            elif k == "maxForever":
+                env_out[a.env_key] = fmax[:, ai]
+            elif k == "and":
+                env_out[a.env_key] = (wcnt[:, 0] - wsum[:, ai]) < 0.5
+            else:  # or
+                env_out[a.env_key] = wsum[:, ai] > 0.5
+
+    def _kinds(self):
+        return {a.kind for a in self.aggs}
+
+    def _prefix_minmax(self, argvals, grp, fmask, B, need_min, need_max):
+        """Within-batch same-group running min/max including self
+        ([B, A] each; None when not needed)."""
+        jnp = self.jnp
+        tri = jnp.tril(jnp.ones((B, B), dtype=bool))
+        same = tri & (grp[:, None] == grp[None, :]) & fmask[None, :]
+        big = jnp.float32(np.inf)
+        pmin = pmax = None
+        if need_min:
+            pmin = jnp.min(
+                jnp.where(same[:, :, None], argvals[None, :, :], big), axis=1)
+        if need_max:
+            pmax = jnp.max(
+                jnp.where(same[:, :, None], argvals[None, :, :], -big), axis=1)
+        return pmin, pmax
+
+    def _forever_rows(self, state, argvals, grp, fmask, B,
+                      pmin=None, pmax=None):
+        """Per-row all-time min/max ([B, A]) = pre-batch accumulator
+        combined with the within-batch same-group prefix (callers that
+        already computed the prefix pass it in to avoid tracing the
+        [B, B, A] reduction twice)."""
+        jnp = self.jnp
+        kinds = self._kinds()
+        need_min = "minForever" in kinds and pmin is None
+        need_max = "maxForever" in kinds and pmax is None
+        if need_min or need_max:
+            cmin, cmax = self._prefix_minmax(
+                argvals, grp, fmask, B, need_min, need_max)
+            pmin = pmin if pmin is not None else cmin
+            pmax = pmax if pmax is not None else cmax
+        fmin = fmax = None
+        if "minForever" in kinds:
+            fmin = jnp.minimum(state["acc_minf"][grp], pmin)
+        if "maxForever" in kinds:
+            fmax = jnp.maximum(state["acc_maxf"][grp], pmax)
+        return fmin, fmax
+
+    def _forever_scatter(self, state, new_state, argvals, grp, fmask):
+        jnp = self.jnp
+        upd = fmask[:, None]
+        if "acc_minf" in state:
+            new_state["acc_minf"] = state["acc_minf"].at[grp].min(
+                jnp.where(upd, argvals, jnp.inf))
+        if "acc_maxf" in state:
+            new_state["acc_maxf"] = state["acc_maxf"].at[grp].max(
+                jnp.where(upd, argvals, -jnp.inf))
+
     def make_step(self, jit: bool = True) -> Callable:
         """Per-event step (filter / running / sliding / keyed_sliding):
 
@@ -678,7 +875,6 @@ class DeviceQueryEngine:
             return self._step_cache[key]
         jnp = self.jnp
         A = max(len(self.aggs), 1)
-        aggs = self.aggs
 
         def step(state, cols, ts, grp, wgrp, valid):
             B = ts.shape[0]
@@ -701,44 +897,39 @@ class DeviceQueryEngine:
                 masked_vals = argvals * fmask[:, None].astype(jnp.float32)
                 psum = m @ masked_vals  # [B, A]
                 pcnt = m @ fmask[:, None].astype(jnp.float32)  # [B, 1]
+                kinds = self._kinds()
+                prev_sum = state.get("acc_sum")
+                wsum = ((prev_sum[grp] if prev_sum is not None else 0.0)
+                        + psum)
+                wcnt = state["acc_cnt"][grp][:, :1] + pcnt
+                wsumsq = None
+                if "acc_sumsq" in state:
+                    wsumsq = (state["acc_sumsq"][grp]
+                              + m @ (masked_vals * argvals))
+                # one prefix pass covers min/max AND the forever pair
+                wmin = wmax = None
+                pmin, pmax = self._prefix_minmax(
+                    argvals, grp, fmask, B,
+                    bool(kinds & {"min", "minForever"}),
+                    bool(kinds & {"max", "maxForever"}))
+                if "min" in kinds:
+                    wmin = jnp.minimum(state["acc_min"][grp], pmin)
+                if "max" in kinds:
+                    wmax = jnp.maximum(state["acc_max"][grp], pmax)
+                fmin, fmax = self._forever_rows(state, argvals, grp,
+                                                fmask, B, pmin, pmax)
                 env_out = dict(env)
-                new_state = dict(state)
-                need_min = any(a.kind == "min" for a in aggs)
-                need_max = any(a.kind == "max" for a in aggs)
-                if need_min or need_max:
-                    big = jnp.float32(np.inf)
-                    vw = jnp.where(
-                        (tri.astype(bool) & same)[:, :, None],
-                        argvals[None, :, :], big)
-                    pmin = jnp.min(vw, axis=1)  # [B, A]
-                    vw2 = jnp.where(
-                        (tri.astype(bool) & same)[:, :, None],
-                        argvals[None, :, :], -big)
-                    pmax = jnp.max(vw2, axis=1)
-                upd = fmask[:, None]
-                for ai, a in enumerate(aggs):
-                    if a.kind in ("sum", "avg", "count"):
-                        prev_sum = state.get("acc_sum")
-                        prev_cnt = state["acc_cnt"]
-                        s_tot = (prev_sum[grp, ai] if prev_sum is not None
-                                 else 0.0) + psum[:, ai]
-                        c_tot = prev_cnt[grp, ai] + pcnt[:, 0]
-                        if a.kind == "sum":
-                            env_out[a.env_key] = s_tot
-                        elif a.kind == "count":
-                            env_out[a.env_key] = c_tot
-                        else:
-                            env_out[a.env_key] = s_tot / jnp.maximum(c_tot, 1.0)
-                    elif a.kind == "min":
-                        env_out[a.env_key] = jnp.minimum(
-                            state["acc_min"][grp, ai], pmin[:, ai])
-                    elif a.kind == "max":
-                        env_out[a.env_key] = jnp.maximum(
-                            state["acc_max"][grp, ai], pmax[:, ai])
+                self._finalize_aggs(env_out, wsum, wcnt, wsumsq, wmin,
+                                    wmax, fmin, fmax)
                 # state update (scatter; duplicate group rows combine)
+                new_state = dict(state)
+                upd = fmask[:, None]
                 if "acc_sum" in state:
                     new_state["acc_sum"] = state["acc_sum"].at[grp].add(
                         jnp.where(upd, argvals, 0.0))
+                if "acc_sumsq" in state:
+                    new_state["acc_sumsq"] = state["acc_sumsq"].at[grp].add(
+                        jnp.where(upd, argvals * argvals, 0.0))
                 new_state["acc_cnt"] = state["acc_cnt"].at[grp].add(
                     jnp.where(upd, jnp.ones_like(argvals), 0.0))
                 if "acc_min" in state:
@@ -747,12 +938,13 @@ class DeviceQueryEngine:
                 if "acc_max" in state:
                     new_state["acc_max"] = state["acc_max"].at[grp].max(
                         jnp.where(upd, argvals, -jnp.inf))
+                self._forever_scatter(state, new_state, argvals, grp, fmask)
                 ov, out = self._emit(env_out, fmask, B)
                 return new_state, ov, out
 
             if self.kind == "keyed_sliding":
                 return self._keyed_sliding_step(
-                    state, env, fmask, ts, grp, wgrp, B, A)
+                    state, env, fmask, ts, grp, wgrp, B)
 
             # sliding: compact passing rows, gather [B, W] windows
             W = self.W
@@ -779,21 +971,19 @@ class DeviceQueryEngine:
                 member = member & (cat_ts[gidx] > (ts[:, None] - T))
             mf = member.astype(jnp.float32)[:, :, None]
             env_out = dict(env)
+            kinds = self._kinds()
             wsum = jnp.sum(w_vals * mf, axis=1)  # [B, A]
             wcnt = jnp.sum(mf, axis=1)  # [B, 1]
-            for ai, a in enumerate(aggs):
-                if a.kind == "sum":
-                    env_out[a.env_key] = wsum[:, ai]
-                elif a.kind == "count":
-                    env_out[a.env_key] = wcnt[:, 0]
-                elif a.kind == "avg":
-                    env_out[a.env_key] = wsum[:, ai] / jnp.maximum(wcnt[:, 0], 1.0)
-                elif a.kind == "min":
-                    env_out[a.env_key] = jnp.min(
-                        jnp.where(member, w_vals[:, :, ai], jnp.inf), axis=1)
-                elif a.kind == "max":
-                    env_out[a.env_key] = jnp.max(
-                        jnp.where(member, w_vals[:, :, ai], -jnp.inf), axis=1)
+            wsumsq = (jnp.sum(w_vals * w_vals * mf, axis=1)
+                      if "stdDev" in kinds else None)
+            m3 = member[:, :, None]
+            wmin = (jnp.min(jnp.where(m3, w_vals, jnp.inf), axis=1)
+                    if "min" in kinds else None)
+            wmax = (jnp.max(jnp.where(m3, w_vals, -jnp.inf), axis=1)
+                    if "max" in kinds else None)
+            fmin, fmax = self._forever_rows(state, argvals, grp, fmask, B)
+            self._finalize_aggs(env_out, wsum, wcnt, wsumsq, wmin, wmax,
+                                fmin, fmax)
             ov, out = self._emit(env_out, fmask, B)
             # new buffer = last W entries ending at the batch's final
             # passing row: concat[n_pass : n_pass + W]
@@ -804,13 +994,14 @@ class DeviceQueryEngine:
             new_state["win_ts"] = dyn(cat_ts, start, W, axis=0)
             new_state["win_grp"] = dyn(cat_grp, start, W, axis=0)
             new_state["win_valid"] = dyn(cat_valid, start, W, axis=0)
+            self._forever_scatter(state, new_state, argvals, grp, fmask)
             return new_state, ov, out
 
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[key] = fn
         return fn
 
-    def _keyed_sliding_step(self, state, env, fmask, ts, grp, wgrp, B, A):
+    def _keyed_sliding_step(self, state, env, fmask, ts, grp, wgrp, B):
         """Per-key sliding window (partition mode): each window group
         (partition key) owns one [W] ring-buffer row, so a row's window
         is ITS key's last W passing events — the reference's
@@ -820,7 +1011,6 @@ class DeviceQueryEngine:
         batch work is [B, B] / [B, W] masked reductions (the [B, B]
         matmul rides the MXU); state updates are unique-slot scatters."""
         jnp = self.jnp
-        aggs = self.aggs
         W = self.W
         Gw = self.n_wgroups
         argvals = self._arg_vals(env, B)  # [B, A]
@@ -850,36 +1040,34 @@ class DeviceQueryEngine:
         mba = mb & (grp[None, :] == grp[:, None])
         mbufa = mbuf & (b_grp == grp[:, None])
         f32 = jnp.float32
+        kinds = self._kinds()
         bsum = mba.astype(f32) @ argvals  # [B, A]
         bcnt = jnp.sum(mba, axis=1).astype(f32)[:, None]  # [B, 1]
         usum = jnp.sum(b_vals * mbufa.astype(f32)[:, :, None], axis=1)
         ucnt = jnp.sum(mbufa, axis=1).astype(f32)[:, None]
         wsum = bsum + usum
         wcnt = bcnt + ucnt
+        wsumsq = None
+        if "stdDev" in kinds:
+            wsumsq = (mba.astype(f32) @ (argvals * argvals)
+                      + jnp.sum(b_vals * b_vals
+                                * mbufa.astype(f32)[:, :, None], axis=1))
         env_out = dict(env)
-        need_min = any(a.kind == "min" for a in aggs)
-        need_max = any(a.kind == "max" for a in aggs)
-        if need_min or need_max:
-            big = jnp.float32(np.inf)
-            pmin = jnp.minimum(
+        big = jnp.float32(np.inf)
+        wmin = wmax = None
+        if "min" in kinds:
+            wmin = jnp.minimum(
                 jnp.min(jnp.where(mba[:, :, None], argvals[None, :, :], big),
                         axis=1),
                 jnp.min(jnp.where(mbufa[:, :, None], b_vals, big), axis=1))
-            pmax = jnp.maximum(
+        if "max" in kinds:
+            wmax = jnp.maximum(
                 jnp.max(jnp.where(mba[:, :, None], argvals[None, :, :], -big),
                         axis=1),
                 jnp.max(jnp.where(mbufa[:, :, None], b_vals, -big), axis=1))
-        for ai, a in enumerate(aggs):
-            if a.kind == "sum":
-                env_out[a.env_key] = wsum[:, ai]
-            elif a.kind == "count":
-                env_out[a.env_key] = wcnt[:, 0]
-            elif a.kind == "avg":
-                env_out[a.env_key] = wsum[:, ai] / jnp.maximum(wcnt[:, 0], 1.0)
-            elif a.kind == "min":
-                env_out[a.env_key] = pmin[:, ai]
-            elif a.kind == "max":
-                env_out[a.env_key] = pmax[:, ai]
+        fmin, fmax = self._forever_rows(state, argvals, grp, fmask, B)
+        self._finalize_aggs(env_out, wsum, wcnt, wsumsq, wmin, wmax,
+                            fmin, fmax)
         ov, out = self._emit(env_out, fmask, B)
         # state update: each kept passing row scatters to its ring slot
         # (slot = (count + r - 1) mod W).  Rows already displaced within
@@ -905,6 +1093,7 @@ class DeviceQueryEngine:
         new_state["win_count"] = (
             pad(state["win_count"])
             .at[jnp.where(fmask, wgrp, Gw)].add(1)[:Gw])
+        self._forever_scatter(state, new_state, argvals, grp, fmask)
         return new_state, ov, out
 
     def make_acc_step(self, jit: bool = True) -> Callable:
@@ -915,7 +1104,6 @@ class DeviceQueryEngine:
         if key in self._step_cache:
             return self._step_cache[key]
         jnp = self.jnp
-        aggs = self.aggs
         K = max(len(self._numeric_group_keys), 1)
 
         def acc(state, cols, ts, grp, gkv, valid):
@@ -928,6 +1116,9 @@ class DeviceQueryEngine:
             if "acc_sum" in state:
                 new_state["acc_sum"] = state["acc_sum"].at[grp].add(
                     jnp.where(upd, argvals, 0.0))
+            if "acc_sumsq" in state:
+                new_state["acc_sumsq"] = state["acc_sumsq"].at[grp].add(
+                    jnp.where(upd, argvals * argvals, 0.0))
             new_state["acc_cnt"] = state["acc_cnt"].at[grp].add(
                 jnp.where(upd, jnp.ones_like(argvals), 0.0))
             if "acc_min" in state:
@@ -936,6 +1127,7 @@ class DeviceQueryEngine:
             if "acc_max" in state:
                 new_state["acc_max"] = state["acc_max"].at[grp].max(
                     jnp.where(upd, argvals, -jnp.inf))
+            self._forever_scatter(state, new_state, argvals, grp, fmask)
             new_state["touched"] = state["touched"].at[grp].max(fmask)
             # group-key registers: scatter only PASSING rows (filtered
             # rows go to a dump row G) — a same-batch passing+filtered
@@ -962,31 +1154,30 @@ class DeviceQueryEngine:
         if key in self._step_cache:
             return self._step_cache[key]
         jnp = self.jnp
-        aggs = self.aggs
         G = self.n_groups
 
         def flush(state):
             env = {N_KEY: G}
-            for ai, a in enumerate(aggs):
-                if a.kind == "sum":
-                    env[a.env_key] = state["acc_sum"][:, ai]
-                elif a.kind == "count":
-                    env[a.env_key] = state["acc_cnt"][:, ai]
-                elif a.kind == "avg":
-                    env[a.env_key] = state["acc_sum"][:, ai] / jnp.maximum(
-                        state["acc_cnt"][:, ai], 1.0)
-                elif a.kind == "min":
-                    env[a.env_key] = state["acc_min"][:, ai]
-                elif a.kind == "max":
-                    env[a.env_key] = state["acc_max"][:, ai]
+            self._finalize_aggs(
+                env,
+                state.get("acc_sum", state["acc_cnt"]),
+                state["acc_cnt"][:, :1],
+                state.get("acc_sumsq"),
+                state.get("acc_min"),
+                state.get("acc_max"),
+                state.get("acc_minf"),
+                state.get("acc_maxf"),
+            )
             for ki, i in enumerate(self._numeric_group_keys):
                 g = self.group_raw[i]
                 if isinstance(g, Variable):
                     env[g.attribute] = state["grp_keys"][:, ki]
             valid = state["touched"]
             ov, out = self._emit(env, valid, G)
+            # pane reset: sums/counts/min/max restart; the all-time
+            # minForever/maxForever accumulators survive flushes
             new_state = dict(state)
-            for k in ("acc_sum", "acc_cnt"):
+            for k in ("acc_sum", "acc_cnt", "acc_sumsq"):
                 if k in state:
                     new_state[k] = jnp.zeros_like(state[k])
             if "acc_min" in state:
@@ -1172,15 +1363,17 @@ class DeviceQueryEngine:
                       if k[0] in dead_pk]
         else:
             dead_g = list(dead_w)  # grp aliases wgrp
-        if dead_g and self.kind == "running":
+        if dead_g:
+            # group-axis accumulators (running totals + all-time
+            # forever values) die with their partition key
             gi = jnp.asarray(np.asarray(dead_g, dtype=np.int32))
-            for key in ("acc_sum", "acc_cnt"):
+            for key in ("acc_sum", "acc_cnt", "acc_sumsq"):
                 if key in state:
                     state[key] = state[key].at[gi].set(0.0)
-            if "acc_min" in state:
-                state["acc_min"] = state["acc_min"].at[gi].set(jnp.inf)
-            if "acc_max" in state:
-                state["acc_max"] = state["acc_max"].at[gi].set(-jnp.inf)
+            for key, init in (("acc_min", jnp.inf), ("acc_minf", jnp.inf),
+                              ("acc_max", -jnp.inf), ("acc_maxf", -jnp.inf)):
+                if key in state:
+                    state[key] = state[key].at[gi].set(init)
         if self.kind == "keyed_sliding":
             wi = jnp.asarray(np.asarray(dead_w, dtype=np.int32))
             state["win_valid"] = state["win_valid"].at[wi].set(False)
@@ -1210,6 +1403,14 @@ class DeviceQueryEngine:
             if k in cols:
                 col[:n] = np.asarray(cols[k])[:n].astype(lane)
             c[k] = jnp.asarray(col)
+        for k in self.long_attrs:
+            hi = np.zeros(B, dtype=np.int32)
+            lo = np.zeros(B, dtype=np.int32)
+            if k in cols:
+                h, l = _split_i64(np.asarray(cols[k])[:n])
+                hi[:n], lo[:n] = h, l
+            c[k + "|hi"] = jnp.asarray(hi)
+            c[k + "|lo"] = jnp.asarray(lo)
         t = np.zeros(B, dtype=np.int32)
         t[:n] = rel[:n]
         g = np.zeros(B, dtype=np.int32)
@@ -1292,7 +1493,10 @@ class DeviceQueryEngine:
             raise SiddhiAppRuntimeError(
                 "partitioned device query needs per-row partition keys")
         pk = np.asarray(part_keys) if part_keys is not None else None
-        if n > MAX_DEVICE_BATCH and self.kind != "tumbling":
+        # the chunk bound exists for the [B, B] same-group masks of the
+        # running/keyed-sliding kinds (and sliding's [B, W+B] gathers);
+        # the stateless filter kind is purely per-row — one dispatch
+        if n > MAX_DEVICE_BATCH and self.kind not in ("tumbling", "filter"):
             chunks = []
             for i in range(0, n, MAX_DEVICE_BATCH):
                 sl = slice(i, i + MAX_DEVICE_BATCH)
@@ -1476,6 +1680,10 @@ class DeviceQueryEngine:
 
     def _host_filter_mask(self, cols, rel, n) -> np.ndarray:
         env = {a: np.asarray(cols[a]) for a in self.all_attrs if a in cols}
+        for a in self.long_attrs:  # pair-compiled filters read hi/lo
+            if a in cols:
+                env[a + "|hi"], env[a + "|lo"] = _split_i64(
+                    np.asarray(cols[a])[:n])
         env[TS_KEY] = np.asarray(rel)
         env[N_KEY] = n
         m = np.ones(n, dtype=bool)

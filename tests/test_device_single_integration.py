@@ -222,9 +222,11 @@ class TestFallbacks:
         self.fallback(DEFINE + "from S#window.sort(5, v) select v "
                                "insert into OutputStream;")
 
-    def test_long_filter_falls_back(self):
-        # LONG device operand (no 64-bit lane yet) -> host engine
-        self.fallback(DEFINE + "from S[k == 123456789012] select v "
+    def test_long_arithmetic_falls_back(self):
+        # round 5: plain LONG comparisons ride hi/lo pair lanes (see
+        # tests/test_device_wide_aggs.py); LONG ARITHMETIC still has no
+        # 64-bit device lane -> host engine
+        self.fallback(DEFINE + "from S[k + 1 == 123456789012] select v "
                                "insert into OutputStream;")
 
     def test_expired_output_falls_back(self):

@@ -1,0 +1,239 @@
+"""Widened device-query operator surface, differential vs host:
+
+- stdDev / minForever / maxForever / and / or aggregators (reference:
+  query/selector/attribute/aggregator/*.java) on running, sliding,
+  and tumbling forms;
+- LONG attributes in plain comparisons via bit-exact hi/lo int32 pair
+  lanes (any magnitude) + the documented arithmetic fallback;
+- BOOL attribute lanes;
+- adversarial float32 drift fuzz pinning the device path's precision
+  contract (ops/device_query.py module docstring: float32 accumulation
+  is a documented subset of the host's float64).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.device_single import DeviceQueryRuntime
+
+DEFS = ("define stream S (k long, v double, n long, ok bool); ")
+
+
+def drive(app, sends, out="O"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
+        runtimes = [getattr(qr, "device_runtime", None)
+                    for qr in rt.query_runtimes.values()]
+        rt.shutdown()
+        return got, runtimes
+    finally:
+        m.shutdown()
+
+
+def differential(query, sends, expect_device=True, rel=1e-4):
+    host, _ = drive(query, sends)
+    dev, runtimes = drive("@app:execution('tpu') " + query, sends)
+    dr = [r for r in runtimes if isinstance(r, DeviceQueryRuntime)]
+    if expect_device:
+        assert dr, f"did not lower: {query}"
+    else:
+        assert not dr, f"unexpectedly lowered: {query}"
+    assert len(dev) == len(host), (host, dev)
+    for i, (a, b) in enumerate(zip(host, dev)):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert y == pytest.approx(x, rel=rel, abs=1e-6), \
+                    f"row {i}: {a} != {b}"
+            else:
+                assert x == y, f"row {i}: {a} != {b}"
+    return dev
+
+
+def mk_sends(n=40, seed=5):
+    rng = np.random.default_rng(seed)
+    return [([int(rng.integers(0, 4)), float(rng.integers(0, 50)),
+              int(rng.integers(0, 10**12)), bool(rng.integers(0, 2))],
+             1000 + i * 61)
+            for i in range(n)]
+
+
+class TestNewAggregators:
+    @pytest.mark.parametrize("agg,alias", [
+        ("stdDev(v)", "sd"), ("minForever(v)", "mf"),
+        ("maxForever(v)", "xf"), ("and(ok)", "a"), ("or(ok)", "o"),
+    ])
+    def test_running(self, agg, alias):
+        differential(
+            DEFS + f"@info(name='q') from S select k, {agg} as {alias} "
+            "group by k insert into O;", mk_sends())
+
+    @pytest.mark.parametrize("agg,alias", [
+        ("stdDev(v)", "sd"), ("minForever(v)", "mf"),
+        ("maxForever(v)", "xf"), ("and(ok)", "a"), ("or(ok)", "o"),
+    ])
+    def test_length_window(self, agg, alias):
+        differential(
+            DEFS + f"@info(name='q') from S#window.length(3) select k, "
+            f"{agg} as {alias} group by k insert into O;", mk_sends())
+
+    @pytest.mark.parametrize("agg,alias", [
+        ("stdDev(v)", "sd"), ("minForever(v)", "mf"), ("or(ok)", "o"),
+    ])
+    def test_time_window(self, agg, alias):
+        differential(
+            DEFS + f"@info(name='q') from S#window.time(300 ms) select k, "
+            f"{agg} as {alias} group by k insert into O;", mk_sends())
+
+    def test_tumbling_std_and_forever(self):
+        # lengthBatch flush emits per-group rows (host and device order
+        # groups differently within one flush: multiset compare);
+        # forever values survive pane resets
+        # (MinForeverAttributeAggregatorExecutor semantics)
+        q = (DEFS + "@info(name='q') from S#window.lengthBatch(5) select "
+             "k, stdDev(v) as sd, maxForever(v) as xf group by k "
+             "insert into O;")
+        sends = mk_sends(30)
+        host, _ = drive(q, sends)
+        dev, runtimes = drive("@app:execution('tpu') " + q, sends)
+        assert any(isinstance(r, DeviceQueryRuntime) for r in runtimes)
+        norm = lambda rows: sorted(
+            tuple(round(x, 4) if isinstance(x, float) else x for x in r)
+            for r in rows)
+        assert norm(host) == norm(dev)
+        assert host, "tumbling query emitted nothing"
+
+    def test_distinct_count_falls_back(self):
+        differential(
+            DEFS + "@info(name='q') from S select k, distinctCount(v) "
+            "as dc group by k insert into O;", mk_sends(),
+            expect_device=False)
+
+    def test_mixed_all_aggs_one_select(self):
+        differential(
+            DEFS + "@info(name='q') from S#window.length(4) select k, "
+            "sum(v) as s, count() as c, avg(v) as av, min(v) as mn, "
+            "max(v) as mx, stdDev(v) as sd, minForever(v) as mf, "
+            "maxForever(v) as xf, and(ok) as b1, or(ok) as b2 "
+            "group by k insert into O;", mk_sends(60))
+
+
+class TestLongLanes:
+    def test_long_filter_large_magnitudes(self):
+        # > 2^32 constants: bit-exact hi/lo pair compares
+        differential(
+            DEFS + "@info(name='q') from S[n > 500000000000] "
+            "select k, n insert into O;", mk_sends(60))
+
+    def test_long_vs_long_attr_compare(self):
+        differential(
+            DEFS + "@info(name='q') from S[n != k] select k, n, v "
+            "insert into O;", mk_sends())
+
+    @pytest.mark.parametrize("op", ["==", "!=", "<", "<=", ">", ">="])
+    def test_all_operators_boundary(self, op):
+        # values straddling the int32 boundary and the exact constant
+        c = 2**31 + 7
+        sends = [([0, 0.0, x, True], 1000 + i) for i, x in enumerate([
+            c - 1, c, c + 1, -c, 0, 2**40, -(2**40)])]
+        differential(
+            DEFS + f"@info(name='q') from S[n {op} {c}] select n "
+            "insert into O;", sends)
+
+    def test_long_arithmetic_falls_back(self):
+        differential(
+            DEFS + "@info(name='q') from S[n + 1 > 5] select k "
+            "insert into O;", mk_sends(10), expect_device=False)
+
+    def test_long_sum_falls_back(self):
+        differential(
+            DEFS + "@info(name='q') from S select k, sum(n) as s "
+            "group by k insert into O;", mk_sends(10),
+            expect_device=False)
+
+    def test_bool_attr_filter(self):
+        differential(
+            DEFS + "@info(name='q') from S[ok] select k, v "
+            "insert into O;", mk_sends())
+
+
+class TestFloat32DriftContract:
+    """Pin the float32 precision contract on adversarial inputs: the
+    device path accumulates sums in float32 (MXU-native), so the
+    guaranteed bound is |device - host| <= C * eps32 * sum(|x|) with
+    C covering accumulation-order effects — NOT exact equality.
+    min/max/count stay exact because inputs are float32-representable
+    and comparisons do not accumulate."""
+
+    EPS32 = 1.2e-7
+    C = 64  # accumulation-order head-room
+
+    def _run(self, sends, query):
+        host, _ = drive(DEFS + query, sends)
+        dev, runtimes = drive("@app:execution('tpu') " + DEFS + query, sends)
+        assert any(isinstance(r, DeviceQueryRuntime) for r in runtimes)
+        assert len(host) == len(dev)
+        return host, dev
+
+    def test_large_magnitude_sum_bounded_drift(self):
+        rng = np.random.default_rng(3)
+        # float32-representable magnitudes around 1e8
+        vals = (rng.uniform(0.5e8, 1e8, 64).astype(np.float32)
+                .astype(np.float64))
+        sends = [([0, float(v), 0, True], 1000 + i)
+                 for i, v in enumerate(vals)]
+        host, dev = self._run(
+            sends, "@info(name='q') from S select sum(v) as s insert into O;")
+        budget = np.cumsum(np.abs(vals)) * self.EPS32 * self.C
+        for i, (h, d) in enumerate(zip(host, dev)):
+            assert abs(h[0] - d[0]) <= budget[i], (
+                f"row {i}: drift {abs(h[0] - d[0])} over budget {budget[i]}")
+
+    def test_cancellation_heavy_sum_bounded_drift(self):
+        rng = np.random.default_rng(4)
+        base = rng.uniform(0.5e8, 1e8, 32).astype(np.float32).astype(np.float64)
+        vals = np.empty(64)
+        vals[0::2] = base
+        vals[1::2] = -base  # pairwise cancellation; true sum ~ 0
+        sends = [([0, float(v), 0, True], 1000 + i)
+                 for i, v in enumerate(vals)]
+        host, dev = self._run(
+            sends, "@info(name='q') from S select sum(v) as s insert into O;")
+        budget = np.cumsum(np.abs(vals)) * self.EPS32 * self.C
+        for i, (h, d) in enumerate(zip(host, dev)):
+            assert abs(h[0] - d[0]) <= budget[i]
+
+    def test_min_max_count_exact_on_adversarial_magnitudes(self):
+        rng = np.random.default_rng(5)
+        vals = (rng.uniform(-1e8, 1e8, 64).astype(np.float32)
+                .astype(np.float64))
+        sends = [([int(i % 3), float(v), 0, True], 1000 + i)
+                 for i, v in enumerate(vals)]
+        host, dev = self._run(
+            sends,
+            "@info(name='q') from S#window.length(5) select k, min(v) as "
+            "mn, max(v) as mx, count() as c group by k insert into O;")
+        for i, (h, d) in enumerate(zip(host, dev)):
+            assert h == d, f"row {i}: {h} != {d}"
+
+    def test_stddev_relative_error_on_spread_data(self):
+        # stdDev uses the sum/sumsq decomposition: on data whose spread
+        # is comparable to its magnitude the relative error stays small
+        rng = np.random.default_rng(6)
+        vals = rng.uniform(1e6, 3e6, 80)
+        sends = [([0, float(v), 0, True], 1000 + i)
+                 for i, v in enumerate(vals)]
+        host, dev = self._run(
+            sends,
+            "@info(name='q') from S select stdDev(v) as sd insert into O;")
+        for i, (h, d) in enumerate(zip(host, dev)):
+            if i < 2:
+                continue  # n<2: stddev ~ 0, relative error meaningless
+            assert d[0] == pytest.approx(h[0], rel=2e-3), f"row {i}"
