@@ -122,11 +122,15 @@ class DeviceQueryRuntime:
         }
 
     def restore(self, state: Dict):
-        jnp = self.engine.jnp
-        self.state = {
-            k: jnp.asarray(v) for k, v in state["device_state"].items()
-        }
-        self.engine.host_restore(state["host"])
+        eng = self.engine
+        if hasattr(eng, "put_state"):  # sharded: restore the placement
+            self.state = eng.put_state(state["device_state"])
+        else:
+            jnp = eng.jnp
+            self.state = {
+                k: jnp.asarray(v) for k, v in state["device_state"].items()
+            }
+        eng.host_restore(state["host"])
 
 
 class _DeviceQueryReceiver:

@@ -669,6 +669,14 @@ class DeviceQueryEngine:
 
     def init_state(self):
         jnp = self.jnp
+        return {k: jnp.asarray(v) for k, v in self.init_state_host().items()}
+
+    def init_state_host(self):
+        """NUMPY zero state (the sharded wrapper builds its shard-major
+        layout from this without touching any device backend).  numpy's
+        zeros/full/float32/... names match jnp's, so the builder body
+        reads identically to a device-side one."""
+        jnp = np
         A = max(len(self.aggs), 1)
         G = self.n_groups
         state = {}
@@ -1341,11 +1349,14 @@ class DeviceQueryEngine:
             f"{self.n_wgroups} (raise @app:execution partitions or "
             "enable @purge)", now)
 
-    def purge_idle_keys(self, state, now: int, idle_ms: Optional[int]):
+    def purge_idle_keys(self, state, now: int, idle_ms: Optional[int],
+                        remap=None):
         """Reclaim device state rows of partition keys idle for
         ``idle_ms`` (the analog of PartitionRuntime dropping idle
         per-key instances; ids return to the free lists after their
-        rows are zeroed).  Returns ``(state, n_purged_keys)``."""
+        rows are zeroed).  ``remap`` maps logical group ids to state
+        row ids (the sharded wrapper's shard-major bijection; identity
+        by default).  Returns ``(state, n_purged_keys)``."""
         if not self.partition_mode or idle_ms is None:
             return state, 0
         dead_w = [w for w, t in self._wgrp_last.items()
@@ -1366,7 +1377,10 @@ class DeviceQueryEngine:
         if dead_g:
             # group-axis accumulators (running totals + all-time
             # forever values) die with their partition key
-            gi = jnp.asarray(np.asarray(dead_g, dtype=np.int32))
+            rows = np.asarray(dead_g, dtype=np.int64)
+            if remap is not None:
+                rows = remap(rows)
+            gi = jnp.asarray(rows.astype(np.int32))
             for key in ("acc_sum", "acc_cnt", "acc_sumsq"):
                 if key in state:
                     state[key] = state[key].at[gi].set(0.0)
@@ -1390,6 +1404,24 @@ class DeviceQueryEngine:
                 self._group_free.append(gid)
                 self._group_last.pop(gid, None)
         return state, len(dead_w)
+
+    def host_lane_cols(self, cols, n: int) -> Dict[str, np.ndarray]:
+        """Raw input columns -> device-lane numpy columns (lane-dtype
+        casts + LONG hi/lo splits), un-padded — the sharded wrapper
+        routes these per shard before device_put."""
+        out: Dict[str, np.ndarray] = {}
+        for k in self.attrs:
+            lane = self._lane_dtype[k]
+            out[k] = (np.asarray(cols[k])[:n].astype(lane, copy=False)
+                      if k in cols else np.zeros(n, dtype=lane))
+        for k in self.long_attrs:
+            if k in cols:
+                hi, lo = _split_i64(np.asarray(cols[k])[:n])
+            else:
+                hi = np.zeros(n, dtype=np.int32)
+                lo = np.zeros(n, dtype=np.int32)
+            out[k + "|hi"], out[k + "|lo"] = hi, lo
+        return out
 
     def _pad(self, cols, rel, grp, n, wgrp=None):
         jnp = self.jnp

@@ -19,6 +19,7 @@ TPU-native equivalent implemented here:
   slice, DCN across hosts).
 """
 
+from siddhi_tpu.parallel.device_shard import ShardedDeviceQueryEngine
 from siddhi_tpu.parallel.mesh import (
     ShardedPatternEngine,
     distributed_initialize,
@@ -28,6 +29,7 @@ from siddhi_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "ShardedDeviceQueryEngine",
     "ShardedPatternEngine",
     "distributed_initialize",
     "ensure_virtual_devices",

@@ -1,0 +1,227 @@
+"""Device-query engine sharded over a mesh's group axis.
+
+``ShardedDeviceQueryEngine`` wraps a running-kind
+:class:`siddhi_tpu.ops.device_query.DeviceQueryEngine`: per-group
+aggregation state rows ([G, A] sum/cnt/min/... arrays) are laid out
+shard-major with one scratch row per shard, device_put with a
+``P('p')`` row sharding, and the per-event step runs under
+``jax.shard_map`` — shard-local scatters only, no collectives on the
+hot path (a group's rows live on exactly one shard, the same contract
+as the dense NFA's partition axis, mesh.py).
+
+Group ids intern host-side exactly as in the unsharded engine; a
+round-robin bijection (``gid -> (gid % n_shards) * per_shard +
+gid // n_shards``) spreads sequentially-allocated ids across shards so
+early groups don't pile onto shard 0.  Events route host-side to their
+owning shard (:func:`route_to_shards`) — same-group rows keep their
+relative order inside one shard bucket, so the step's within-batch
+same-group prefix matmul is unaffected.
+
+The wrapper exposes the engine's host surface (``process_batch``,
+snapshots, purge, introspection) so ``DeviceQueryRuntime`` holds it
+exactly like an unsharded engine.
+
+No reference analog: the reference scales group-by state with
+ThreadLocal-keyed maps on one JVM (config/SiddhiAppContext.java:55-109).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.parallel.mesh import route_to_shards
+
+
+class ShardedDeviceQueryEngine:
+    """A running-kind DeviceQueryEngine with its group axis sharded."""
+
+    def __init__(self, engine, mesh, axis_name: str = "p"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if engine.kind != "running":
+            raise SiddhiAppCreationError(
+                f"mesh sharding of the device query engine covers the "
+                f"running (per-group accumulator) kind; kind="
+                f"'{engine.kind}' runs single-device")
+        self.engine = engine
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        if engine.n_groups % self.n_shards:
+            # unreachable via @app:execution (the annotation parser
+            # enforces partitions % devices == 0 at app creation);
+            # guards direct-API construction
+            raise SiddhiAppCreationError(
+                f"{engine.n_groups} groups not divisible by "
+                f"{self.n_shards} shards")
+        self.per_shard = engine.n_groups // self.n_shards
+        self.rows_per_shard = self.per_shard + 1  # +1 scratch row
+
+        jnp = engine.jnp
+        a = axis_name
+        raw = engine.make_step(jit=False)
+        host = engine.init_state_host()
+        self.state_specs = {
+            k: P(a, *([None] * (v.ndim - 1))) for k, v in host.items()
+        }
+        specs = self.state_specs
+        col_keys = list(engine.host_lane_cols({}, 0))
+
+        def sharded_step(state, cols, ts, grp, valid):
+            wgrp = jnp.zeros_like(grp)  # running kind ignores wgrp
+            return raw(state, cols, ts, grp, wgrp, valid)
+
+        out_names = [nm for kind, _v, nm in engine.out_spec
+                     if kind == "expr"]
+        self._step = jax.jit(jax.shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=(specs, {k: P(a) for k in col_keys}, P(a), P(a), P(a)),
+            out_specs=(specs, P(a), {nm: P(a) for nm in out_names}),
+        ), donate_argnums=(0,))
+        self._P = P
+        self._NamedSharding = NamedSharding
+        self._jax = jax
+
+    # -- engine-surface proxy (host bookkeeping, snapshots, purge) ----------
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    # -- sharded state -------------------------------------------------------
+
+    def _put(self, x, spec):
+        return self._jax.device_put(x, self._NamedSharding(self.mesh, spec))
+
+    def init_state(self):
+        host = self.engine.init_state_host()
+        n_rows = self.n_shards * self.rows_per_shard
+        state = {}
+        for k, v in host.items():
+            arr = np.zeros((n_rows,) + v.shape[1:], dtype=v.dtype)
+            arr[...] = v[0] if len(v) else 0  # per-row init is uniform
+            state[k] = self._put(arr, self.state_specs[k])
+        return state
+
+    def put_state(self, host_state: Dict[str, np.ndarray]):
+        """Numpy state (a snapshot) -> sharded device arrays.  The
+        snapshot must carry THIS layout's row count — a snapshot taken
+        under a different device count has a different shard-major
+        bijection, and restoring it silently cross-wires groups."""
+        n_rows = self.n_shards * self.rows_per_shard
+        for k, v in host_state.items():
+            v = np.asarray(v)
+            if v.shape[0] != n_rows:
+                raise SiddhiAppCreationError(
+                    f"sharded device-query snapshot '{k}' has "
+                    f"{v.shape[0]} rows; this {self.n_shards}-device "
+                    f"layout needs {n_rows} — persist and restore must "
+                    "use the same @app:execution devices count")
+        return {
+            k: self._put(np.asarray(v), self.state_specs[k])
+            for k, v in host_state.items()
+        }
+
+    def _remap(self, gid: np.ndarray) -> np.ndarray:
+        """Sequential gid -> shard-major row id, round-robin across
+        shards WITH the per-shard scratch row accounted for."""
+        owner = gid % self.n_shards
+        local = gid // self.n_shards
+        return owner * self.rows_per_shard + local
+
+    # -- host entry point (mirrors DeviceQueryEngine.process_batch) ---------
+
+    def process_batch(self, state, cols: Dict[str, np.ndarray],
+                      ts: np.ndarray,
+                      part_keys: Optional[np.ndarray] = None):
+        from siddhi_tpu.ops.device_query import MAX_DEVICE_BATCH
+
+        eng = self.engine
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(ts)
+        if n == 0:
+            return state, eng._empty_cols(), np.empty(0, dtype=np.int64)
+        if n > MAX_DEVICE_BATCH:
+            # same chunk bound as the unsharded engine: the running
+            # step builds [B, B] same-group masks per shard
+            pk_all = (np.asarray(part_keys)
+                      if part_keys is not None else None)
+            chunks = []
+            for i in range(0, n, MAX_DEVICE_BATCH):
+                sl = slice(i, i + MAX_DEVICE_BATCH)
+                state, oc, ot = self.process_batch(
+                    state, {k: np.asarray(v)[sl] for k, v in cols.items()},
+                    ts[sl], pk_all[sl] if pk_all is not None else None)
+                chunks.append((oc, ot))
+            out_cols = {
+                nm: np.concatenate([c[0][nm] for c in chunks])
+                for nm in eng.output_names
+            }
+            return state, out_cols, np.concatenate([c[1] for c in chunks])
+        if eng.base_ts is None:
+            eng.base_ts = int(ts[0]) - 1
+        rel64 = ts - eng.base_ts
+        if int(rel64.max()) >= eng._REL_LIMIT:
+            # running kind holds no timestamp state; only the anchor moves
+            eng.base_ts += int(rel64.min()) - 1
+            rel64 = ts - eng.base_ts
+        rel = rel64.astype(np.int32)
+        now = int(ts.max())
+        if eng.partition_mode:
+            if part_keys is None:
+                raise SiddhiAppCreationError(
+                    "partitioned device query needs per-row partition keys")
+            pk = np.asarray(part_keys)
+            # wgroup interning runs unconditionally: _wgrp_last drives
+            # the idle-key purge even when composed groups carry state
+            wgrp = eng._intern_wgroups(pk, now)
+            grp = (eng._intern_groups(cols, ts, n, pk=pk, now=now)
+                   if eng.group_exprs else wgrp)
+        else:
+            grp = eng._intern_groups(cols, ts, n)
+        lanes = eng.host_lane_cols(cols, n)
+        local, rcols, rts, valid, pos = route_to_shards(
+            self.n_shards, self.per_shard, self._route_part(grp),
+            lanes, rel)
+        P, a = self._P, self.axis_name
+        args = (
+            {k: self._put(v, P(a)) for k, v in rcols.items()},
+            self._put(rts.astype(np.int32), P(a)),
+            self._put(local, P(a)),
+            self._put(valid, P(a)),
+        )
+        state, ov, out = self._step(state, *args)
+        ov_np = np.asarray(ov)[pos]
+        idx = np.flatnonzero(ov_np)
+        out_np = {k: np.asarray(col)[pos] for k, col in out.items()}
+        out_cols = eng._out_columns(out_np, idx, grp[idx], cols, idx)
+        return state, out_cols, ts[idx]
+
+    def _route_part(self, gid: np.ndarray) -> np.ndarray:
+        """Global gid -> the 'global partition id' route_to_shards
+        expects (owner * parts_per_shard + local), with parts_per_shard
+        = per_shard usable rows (scratch handled by route_to_shards
+        itself)."""
+        owner = gid % self.n_shards
+        local = gid // self.n_shards
+        return owner * self.per_shard + local
+
+    def process(self, state, cols, ts, part_keys=None):
+        state, out_cols, out_ts = self.process_batch(state, cols, ts,
+                                                     part_keys)
+        names = self.engine.output_names
+        rows = [
+            {nm: out_cols[nm][i] for nm in names}
+            for i in range(len(out_ts))
+        ]
+        return state, rows
+
+    def purge_idle_keys(self, state, now: int, idle_ms):
+        """Partition-mode purge: the engine's own purge with dead
+        logical group ids remapped to this layout's shard-major rows."""
+        return self.engine.purge_idle_keys(state, now, idle_ms,
+                                           remap=self._remap)

@@ -184,6 +184,17 @@ class QueryPlanner:
     def __init__(self, app_planner):
         self.app = app_planner  # AppPlanner
 
+    def _get_mesh(self, nd: int):
+        """One app-wide device mesh, built on first use (shared by the
+        dense pattern axis and the device-query group axis)."""
+        mesh = getattr(self.app, "_tpu_mesh", None)
+        if mesh is None:
+            from siddhi_tpu.parallel import make_mesh
+
+            mesh = make_mesh(nd)
+            self.app._tpu_mesh = mesh
+        return mesh
+
     def plan(self, query: Query, query_index: int) -> QueryRuntime:
         info = find_annotation(query.annotations, "info")
         name = (info.element("name") if info else None) or f"query_{query_index}"
@@ -543,12 +554,7 @@ class QueryPlanner:
         mesh = None
         nd = self.app.app_context.tpu_devices
         if nd and n_partitions > 1:
-            from siddhi_tpu.parallel import make_mesh
-
-            mesh = getattr(self.app, "_tpu_mesh", None)
-            if mesh is None:
-                mesh = make_mesh(nd)
-                self.app._tpu_mesh = mesh
+            mesh = self._get_mesh(nd)
         runtime = DensePatternRuntime(
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
             key_fn=key_fn, mesh=mesh,
@@ -694,6 +700,20 @@ class QueryPlanner:
             n_wgroups=(self.app.app_context.tpu_partitions
                        if partition_mode else None),
         )
+        # @app:execution('tpu', devices='N'): shard the group axis of
+        # running-kind queries over an N-device mesh (same treatment as
+        # DensePatternRuntime's partition axis); other kinds stay
+        # single-device
+        nd = self.app.app_context.tpu_devices
+        if nd and engine.kind == "running":
+            from siddhi_tpu.parallel import ShardedDeviceQueryEngine
+
+            engine = ShardedDeviceQueryEngine(engine, self._get_mesh(nd))
+            import logging
+
+            logging.getLogger("siddhi_tpu").info(
+                "query '%s': device group axis sharded over %d devices",
+                name, nd)
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
         out_attrs = [
             Attribute(nm, t)

@@ -1,0 +1,218 @@
+"""Mesh-sharded device query engine: a running group-by query's
+per-group state lives on N devices (shard-major rows under shard_map)
+and results match the host engine — the device-query analog of the
+dense NFA's sharded partition axis (tests/test_sharded_product.py).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.device_single import DeviceQueryRuntime
+from siddhi_tpu.ops.device_query import compile_query
+from siddhi_tpu.parallel import ShardedDeviceQueryEngine, make_mesh
+
+APP = "define stream S (sym string, v double, k int); "
+
+
+def n_state_devices(state):
+    return len({d for arr in state.values() for d in arr.devices()})
+
+
+class TestShardedEngine:
+    def test_differential_vs_unsharded(self):
+        q = (APP + "@info(name='q') from S select k, sum(v) as s, "
+             "count() as c, min(v) as mn, max(v) as mx group by k "
+             "insert into Out;")
+        plain = compile_query(q, "q", n_groups=64)
+        sharded = ShardedDeviceQueryEngine(
+            compile_query(q, "q", n_groups=64), make_mesh(8))
+        ps, ss = plain.init_state(), sharded.init_state()
+        assert n_state_devices(ss) == 8
+        rng = np.random.default_rng(1)
+        for step in range(4):
+            n = int(rng.integers(5, 60))
+            cols = {
+                "sym": np.array(["x"] * n),
+                "v": rng.uniform(0, 50, n),
+                "k": rng.integers(0, 30, n).astype(np.int32),
+            }
+            ts = np.arange(n, dtype=np.int64) + 1000 + step * 1000
+            ps, prow = plain.process(ps, cols, ts)
+            ss, srow = sharded.process(ss, cols, ts)
+            assert len(prow) == len(srow)
+            for i, (a, b) in enumerate(zip(prow, srow)):
+                # int lanes bit-exact, float32 sums within tolerance
+                assert int(a["k"]) == int(b["k"])
+                assert int(a["c"]) == int(b["c"])
+                assert float(b["s"]) == pytest.approx(
+                    float(a["s"]), rel=1e-5)
+                assert float(b["mn"]) == float(a["mn"])
+                assert float(b["mx"]) == float(a["mx"])
+
+    def test_non_running_kind_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        q = (APP + "@info(name='q') from S#window.length(3) select k, "
+             "sum(v) as s group by k insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            ShardedDeviceQueryEngine(compile_query(q, "q"), make_mesh(8))
+
+
+class TestShardedProductPath:
+    def _app(self, devices):
+        return (
+            "@app:playback "
+            f"@app:execution('tpu', partitions='64', devices='{devices}') "
+            + APP +
+            "@info(name='gq') from S select k, sum(v) as s group by k "
+            "insert into Out;"
+        )
+
+    def test_group_state_on_8_devices_matches_host(self):
+        events = []
+        rng = np.random.default_rng(2)
+        for i in range(80):
+            events.append(([str(i % 3), float(rng.integers(0, 50)),
+                            int(rng.integers(0, 20))], 1000 + i))
+
+        def run(app):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(app)
+                got = []
+                rt.add_callback("Out", lambda evs: got.extend(
+                    tuple(e.data) for e in evs))
+                rt.start()
+                h = rt.get_input_handler("S")
+                for row, ts in events:
+                    h.send(row, timestamp=ts)
+                runtimes = [getattr(qr, "device_runtime", None)
+                            for qr in rt.query_runtimes.values()]
+                rt.shutdown()
+                return got, runtimes
+            finally:
+                m.shutdown()
+
+        host, _ = run("@app:playback " + APP +
+                      "@info(name='gq') from S select k, sum(v) as s "
+                      "group by k insert into Out;")
+        dev, runtimes = run(self._app(8))
+        dr = [r for r in runtimes if isinstance(r, DeviceQueryRuntime)]
+        assert dr, "query did not lower"
+        assert isinstance(dr[0].engine, ShardedDeviceQueryEngine)
+        assert n_state_devices(dr[0].state) == 8
+        assert len(host) == len(dev)
+        for a, b in zip(host, dev):
+            assert a[0] == b[0]
+            assert b[1] == pytest.approx(a[1], rel=1e-5)
+
+    def test_sharded_snapshot_restore(self):
+        from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+        app = "@app:name('shsnap') " + self._app(8)
+        m = SiddhiManager()
+        m.set_persistence_store(InMemoryPersistenceStore())
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send(["a", 10.0, 1], timestamp=1000)
+            h.send(["a", 20.0, 2], timestamp=1001)
+            rev = rt.persist()
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            got = []
+            rt2.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt2.start()
+            rt2.restore_revision(rev)
+            dr = [getattr(qr, "device_runtime", None)
+                  for qr in rt2.query_runtimes.values()]
+            assert n_state_devices(dr[0].state) == 8  # placement restored
+            h2 = rt2.get_input_handler("S")
+            h2.send(["a", 5.0, 1], timestamp=1002)  # k=1: 10 + 5
+            rt2.shutdown()
+            assert got == [(1, 15.0)], got
+        finally:
+            m.shutdown()
+
+
+class TestShardedPurge:
+    def test_partitioned_purge_reclaims_sharded_rows(self):
+        # composed-group form (inner group-by): wgroups must still
+        # intern so the idle purge sees last-use times
+        app = (
+            "@app:playback "
+            "@app:execution('tpu', partitions='16', devices='8') "
+            + APP +
+            "@purge(enable='true', interval='1 sec', idle.period='2 sec') "
+            "partition with (sym of S) begin "
+            "@info(name='pq') from S select sym, k, sum(v) as s "
+            "group by k insert into Out; end;"
+        )
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            pr = rt.partitions["partition_0"]
+            assert pr.is_dense
+            got = []
+            rt.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, u in enumerate(["a", "b", "c"]):
+                h.send([u, 1.0, 0], timestamp=1000 + i)
+            qr = next(iter(pr.dense_query_runtimes.values()))
+            eng = qr.device_runtime.engine
+            assert isinstance(eng, ShardedDeviceQueryEngine)
+            assert len(eng._wgrp_last) == 3  # wgroups interned
+            # watermark jump purges all three idle keys...
+            h.send(["a", 5.0, 0], timestamp=60_000)
+            assert len(eng._wgrp_ids) == 1  # ...then 'a' re-interned
+            rt.shutdown()
+            # 'a' restarted from scratch: purged row was zeroed
+            assert got[-1] == ("a", 0, 5.0), got
+        finally:
+            m.shutdown()
+
+
+class TestShardedPartitionedProduct:
+    def test_partitioned_running_sharded(self):
+        # partition key composes into the sharded group axis
+        app = (
+            "@app:playback "
+            "@app:execution('tpu', partitions='64', devices='8') "
+            + APP +
+            "partition with (sym of S) begin "
+            "@info(name='pq') from S select sym, sum(v) as s "
+            "insert into Out; end;"
+        )
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            pr = rt.partitions["partition_0"]
+            assert pr.is_dense
+            got = []
+            rt.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i in range(16):
+                h.send([f"u{i % 5}", 1.0, 0], timestamp=1000 + i)
+            qr = next(iter(pr.dense_query_runtimes.values()))
+            assert isinstance(qr.device_runtime.engine,
+                              ShardedDeviceQueryEngine)
+            assert n_state_devices(qr.device_runtime.state) == 8
+            rt.shutdown()
+            # per-key running sums: u0 hits 1,2,3,4 over its 4 events...
+            per_key = {}
+            expect = []
+            for i in range(16):
+                k = f"u{i % 5}"
+                per_key[k] = per_key.get(k, 0.0) + 1.0
+                expect.append((k, per_key[k]))
+            assert got == expect, (got, expect)
+        finally:
+            m.shutdown()
