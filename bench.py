@@ -306,6 +306,20 @@ def main():
     kernel = bench_kernel()
     product = bench_product()
     host = bench_host_baseline()
+    workload_rows = None
+    if "--workloads" in sys.argv:
+        # secondary matrix: the reference perf-harness workloads
+        # (BASELINE.md) measured host vs device — emitted as a SECOND
+        # JSON line so the driver's one-line contract holds by default
+        import os as _os
+
+        sys.path.insert(0, _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "samples", "performance"))
+        from workloads import workloads as _wl
+
+        secs = 2.0
+        workload_rows = _wl(secs)
     events_per_sec = kernel["events_per_sec"]
     host_rate = host["events_per_sec"]
     print(json.dumps({
@@ -329,6 +343,8 @@ def main():
         "n_partitions": N_PARTITIONS,
         "n_states": N_STATES,
     }))
+    if workload_rows is not None:
+        print(json.dumps({"workloads": workload_rows}))
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ import numpy as np
 
 from siddhi_tpu.core import event as ev
 from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
 
 import logging
 
@@ -126,6 +127,18 @@ class DeviceQueryRuntime:
         if hasattr(eng, "put_state"):  # sharded: restore the placement
             self.state = eng.put_state(state["device_state"])
         else:
+            # row-count guard: a snapshot persisted under a SHARDED
+            # layout (@app:execution devices='N') has N extra scratch
+            # rows and a shard-major row bijection — restoring it here
+            # would silently cross-wire group rows
+            expect = {k: v.shape for k, v in eng.init_state_host().items()}
+            for k, v in state["device_state"].items():
+                if k in expect and np.asarray(v).shape != expect[k]:
+                    raise SiddhiAppRuntimeError(
+                        f"device-query snapshot '{k}' has shape "
+                        f"{np.asarray(v).shape}; this engine expects "
+                        f"{expect[k]} — persist and restore must use "
+                        "the same @app:execution devices count")
             jnp = eng.jnp
             self.state = {
                 k: jnp.asarray(v) for k, v in state["device_state"].items()

@@ -117,9 +117,25 @@ class StreamJunction:
     def stop(self):
         self._running = False
         if self._worker is not None:
-            self._queue.put(None)
+            # the worker exits via the _running flag after its current
+            # dispatch; the sentinel only matters when it is parked in
+            # get() on an EMPTY queue — so never block on a FULL one
+            # (a blocking put here deadlocks: the flagged worker stops
+            # consuming and the queue never drains)
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass
             self._worker.join(timeout=5)
             self._worker = None
+            # free ring slots so producer threads blocked in put() on a
+            # full queue complete their (discarded — pending batches are
+            # dropped at stop) send instead of blocking forever
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
 
     def subscribe(self, receiver):
         if receiver not in self.receivers:

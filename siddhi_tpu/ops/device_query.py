@@ -105,6 +105,7 @@ from siddhi_tpu.query_api import (
     IsNull,
     NotOp,
     OrOp,
+    OutputAttribute,
     Query,
     SingleInputStream,
     Variable,
@@ -400,6 +401,21 @@ class DeviceQueryEngine:
 
         # select items: rewrite aggregators, classify outputs
         rewriter = _DeviceAggRewrite(scope, compiler)
+        if sel.selection is None and getattr(sel, "is_select_all", False):
+            # select *: every input attribute passes through at native
+            # width (stream functions never reach the device chain, so
+            # the flowing schema IS the stream definition)
+            sel = type(sel)(
+                selection=[
+                    OutputAttribute(Variable(attribute=a.name))
+                    for a in stream_def.attributes
+                ],
+                group_by=list(sel.group_by or []),
+                having=sel.having,
+                order_by=list(sel.order_by or []),
+                limit=sel.limit,
+                offset=sel.offset,
+            )
         if sel.selection is None:
             raise SiddhiAppCreationError(
                 "device query path needs an explicit select list")

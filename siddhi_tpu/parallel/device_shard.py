@@ -31,7 +31,10 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
+)
 from siddhi_tpu.parallel.mesh import route_to_shards
 
 
@@ -166,14 +169,15 @@ class ShardedDeviceQueryEngine:
             eng.base_ts = int(ts[0]) - 1
         rel64 = ts - eng.base_ts
         if int(rel64.max()) >= eng._REL_LIMIT:
-            # running kind holds no timestamp state; only the anchor moves
-            eng.base_ts += int(rel64.min()) - 1
-            rel64 = ts - eng.base_ts
+            # the engine's re-anchor: running kind has no timestamp
+            # state, but the representability guard (one batch spanning
+            # the whole int32 range) must still apply
+            state, rel64 = eng._re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
         now = int(ts.max())
         if eng.partition_mode:
             if part_keys is None:
-                raise SiddhiAppCreationError(
+                raise SiddhiAppRuntimeError(
                     "partitioned device query needs per-row partition keys")
             pk = np.asarray(part_keys)
             # wgroup interning runs unconditionally: _wgrp_last drives

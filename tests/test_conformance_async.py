@@ -103,3 +103,43 @@ class TestAsyncJunction:
             assert len(got) == 300
         finally:
             m.shutdown()
+
+
+def test_stop_with_full_queue_does_not_deadlock():
+    """Shutdown while the async ring is FULL must not block: the worker
+    exits via the running flag after its current dispatch, so stop()
+    must never wait for queue space (regression: a producer-saturated
+    @async junction deadlocked shutdown)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@async(buffer.size='4', batch.size.max='8') "
+            "define stream S (v double); "
+            "from S select v insert into Out;")
+        rt.add_callback("Out", lambda evs: _time.sleep(0.01))
+        rt.start()
+        h = rt.get_input_handler("S")
+        b = EventBatch("S", ["v"], {"v": np.ones(64)},
+                       np.zeros(64, dtype=np.int64))
+        # saturate the 4-slot ring faster than the 10ms/dispatch consumer
+        for _ in range(32):
+            h.send_batch(b)
+        done = threading.Event()
+
+        def shut():
+            rt.shutdown()
+            done.set()
+
+        t = threading.Thread(target=shut, daemon=True)
+        t.start()
+        assert done.wait(timeout=10), "shutdown deadlocked on a full ring"
+    finally:
+        m.shutdown()
