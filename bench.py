@@ -318,7 +318,10 @@ def main():
             "samples", "performance"))
         from workloads import workloads as _wl
 
-        secs = 2.0
+        secs = 2.0  # override with --workload-secs=N
+        for a in sys.argv:
+            if a.startswith("--workload-secs="):
+                secs = float(a.split("=", 1)[1])
         workload_rows = _wl(secs)
     events_per_sec = kernel["events_per_sec"]
     host_rate = host["events_per_sec"]

@@ -97,6 +97,132 @@ class JoinSide:
         return f"{self.ref}.{attr}"
 
 
+class DeviceJoinProbe:
+    """Jitted cross-product condition mask — the join hot loop on
+    device (reference: JoinProcessor.java:45 probing the opposite
+    window via compiledCondition.find per event).
+
+    Window buffering, expiry and outer-join fill stay with the host
+    JoinRuntime; the O(B*W) condition evaluation runs as a static-shape
+    [B, W] device kernel (arriving rows broadcast down columns, buffered
+    rows across rows), and matched pairs materialize host-side from the
+    mask in O(matches).
+
+    Lane policy matches the device query engine (ops/device_query.py):
+    INT rides int32 (bit-exact), BOOL bool, FLOAT/DOUBLE float32 — a
+    documented precision subset of the host's float64 condition
+    evaluation.  Conditions touching STRING or LONG attributes, or the
+    event timestamp (whose epoch-ms magnitude exceeds device int32
+    lanes), keep the numpy path — enforced by tracing the kernel env at
+    plan time, which simply lacks those keys.  Batches whose numeric
+    columns carry nulls (object dtype) fall back per batch.
+    """
+
+    MAX_ROWS = 2048  # [B, W] work bound per kernel call; both axes chunk
+    MAX_BUF = 8192
+
+    def __init__(self, condition: CompiledExpression,
+                 left: JoinSide, right: JoinSide):
+        import jax
+
+        self.jax = jax
+        self.condition = condition
+        self._lanes: Dict[str, Dict[str, np.dtype]] = {}
+        for side in (left, right):
+            lanes = {}
+            for a in side.definition.attributes:
+                if a.type == AttrType.INT:
+                    lanes[side.qualified_key(a.name)] = np.dtype(np.int32)
+                elif a.type == AttrType.BOOL:
+                    lanes[side.qualified_key(a.name)] = np.dtype(np.bool_)
+                elif a.type.is_numeric and a.type != AttrType.LONG:
+                    lanes[side.qualified_key(a.name)] = np.dtype(np.float32)
+            self._lanes[side.ref] = lanes
+        self._kernels: Dict[Tuple[int, int], object] = {}
+        self._trace_check(left, right)
+
+    def _trace_check(self, left, right):
+        """Plan-time eligibility: the condition must trace over the 2-D
+        lane env (raises SiddhiAppCreationError -> numpy probe kept).
+        The env deliberately has NO timestamp key and no STRING/LONG
+        lanes, so conditions touching those KeyError here and stay on
+        the null-safe host evaluation."""
+        import jax
+
+        env = {}
+        for ref, lanes in self._lanes.items():
+            for k, dt in lanes.items():
+                shape = (4, 1) if ref == left.ref else (1, 4)
+                env[k] = jax.ShapeDtypeStruct(shape, dt)
+        env[N_KEY] = 16
+        try:
+            jax.eval_shape(lambda e: self.condition.fn(e), env)
+        except Exception as e:
+            raise SiddhiAppCreationError(
+                f"join condition not device-traceable: {e}") from e
+
+    def _kernel(self, B: int, W: int):
+        k = self._kernels.get((B, W))
+        if k is None:
+            import jax.numpy as jnp
+
+            def mask_fn(a_lanes, b_lanes):
+                env = {key: v[:, None] for key, v in a_lanes.items()}
+                env.update({key: v[None, :] for key, v in b_lanes.items()})
+                env[N_KEY] = B * W
+                return jnp.broadcast_to(
+                    jnp.asarray(self.condition.fn(env)).astype(bool),
+                    (B, W))
+
+            k = self.jax.jit(mask_fn)
+            self._kernels[(B, W)] = k
+        return k
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        return max(1 << (max(n, 1) - 1).bit_length(), 16)
+
+    def _side_lanes(self, side: JoinSide, batch: EventBatch,
+                    idx0: int, n: int, pad: int):
+        """Device lanes for rows [idx0, idx0+n); None when a numeric
+        column carries nulls (object dtype) — caller then falls back to
+        the null-safe numpy probe for this batch."""
+        import jax.numpy as jnp
+
+        out = {}
+        for key, dt in self._lanes[side.ref].items():
+            attr = key.split(".", 1)[1]
+            src = np.asarray(batch.columns[attr])[idx0:idx0 + n]
+            if src.dtype.kind == "O":
+                return None
+            col = np.zeros(pad, dtype=dt)
+            col[:n] = src.astype(dt, copy=False)
+            out[key] = jnp.asarray(col)
+        return out
+
+    def mask(self, side: JoinSide, rows: EventBatch, other: JoinSide,
+             buf: EventBatch) -> Optional[np.ndarray]:
+        """[n_a, n_b] condition mask, or None when this batch is not
+        device-evaluable (nulls in a numeric column)."""
+        n_a, n_b = len(rows), len(buf)
+        out = np.empty((n_a, n_b), dtype=bool)
+        for bs in range(0, n_b, self.MAX_BUF):
+            nb = min(self.MAX_BUF, n_b - bs)
+            W = self._pow2(nb)
+            b_lanes = self._side_lanes(other, buf, bs, nb, W)
+            if b_lanes is None:
+                return None
+            for as_ in range(0, n_a, self.MAX_ROWS):
+                na = min(self.MAX_ROWS, n_a - as_)
+                B = self._pow2(na)
+                a_lanes = self._side_lanes(side, rows, as_, na, B)
+                if a_lanes is None:
+                    return None
+                m = self._kernel(B, W)(a_lanes, b_lanes)
+                out[as_:as_ + na, bs:bs + nb] = np.asarray(m)[:na, :nb]
+        return out
+
+
 class JoinRuntime:
     """Drives both sides and emits joined batches to the query's selector
     (via ``emit``).  Registered as a scheduler task for time-window
@@ -117,6 +243,10 @@ class JoinRuntime:
         self.condition = condition
         self.emit = emit
         self.out_stream_id = out_stream_id
+        # set by the planner under @app:execution('tpu') when the
+        # condition is device-traceable: jitted [B, W] probe kernel
+        self.device_probe: Optional[DeviceJoinProbe] = None
+        self.probe_invocations = 0  # proof the device probe ran (tests)
         self._out_names = [
             left.qualified_key(a.name) for a in left.definition.attributes
         ] + [right.qualified_key(a.name) for a in right.definition.attributes]
@@ -205,30 +335,49 @@ class JoinRuntime:
                 return None
             return self._with_nulls(side, rows, other, out_type)
 
-        # cross-product condition evaluation: A-rows repeated, B-rows tiled
-        env: Dict[str, np.ndarray] = {}
-        for a in side.definition.attributes:
-            env[side.qualified_key(a.name)] = np.repeat(rows.columns[a.name], n_b)
-        for a in other.definition.attributes:
-            env[other.qualified_key(a.name)] = np.tile(buf.columns[a.name], n_a)
-        env[TS_KEY] = np.repeat(rows.timestamps, n_b)
-        env[N_KEY] = n_a * n_b
+        # condition mask [n_a, n_b]: all-pairs, device probe, or the
+        # numpy repeat/tile cross product (also the per-batch fallback
+        # when the probe sees null-carrying numeric columns)
+        mask2: Optional[np.ndarray] = None
         if self.condition is None:
-            mask = np.ones(n_a * n_b, dtype=bool)
-        else:
-            mask = np.broadcast_to(np.asarray(self.condition.fn(env)), (n_a * n_b,))
+            mask2 = np.ones((n_a, n_b), dtype=bool)
+        elif self.device_probe is not None:
+            mask2 = self.device_probe.mask(side, rows, other, buf)
+            if mask2 is not None:
+                self.probe_invocations += 1
+        if mask2 is None:
+            env: Dict[str, np.ndarray] = {}
+            for a in side.definition.attributes:
+                env[side.qualified_key(a.name)] = np.repeat(
+                    rows.columns[a.name], n_b)
+            for a in other.definition.attributes:
+                env[other.qualified_key(a.name)] = np.tile(
+                    buf.columns[a.name], n_a)
+            env[TS_KEY] = np.repeat(rows.timestamps, n_b)
+            env[N_KEY] = n_a * n_b
+            mask2 = np.broadcast_to(
+                np.asarray(self.condition.fn(env)),
+                (n_a * n_b,)).reshape(n_a, n_b)
 
-        cols = {k: v[mask] for k, v in env.items() if k not in (TS_KEY, N_KEY)}
-        ts = env[TS_KEY][mask]
+        # matched pairs materialize in O(matches), row-major (arriving
+        # row order, buffer order within a row)
+        ai, bi = np.nonzero(mask2)
+        cols: Dict[str, np.ndarray] = {}
+        for a in side.definition.attributes:
+            cols[side.qualified_key(a.name)] = np.asarray(
+                rows.columns[a.name])[ai]
+        for a in other.definition.attributes:
+            cols[other.qualified_key(a.name)] = np.asarray(
+                buf.columns[a.name])[bi]
         out = EventBatch(
             self.out_stream_id,
             self._out_names,
             {k: cols[k] for k in self._out_names},
-            ts,
-            np.full(int(mask.sum()), out_type, dtype=np.int8),
+            np.asarray(rows.timestamps)[ai],
+            np.full(len(ai), out_type, dtype=np.int8),
         )
         if is_outer:
-            matched_any = mask.reshape(n_a, n_b).any(axis=1)
+            matched_any = mask2.any(axis=1)
             if not matched_any.all():
                 unmatched = rows.mask(~matched_any)
                 out = EventBatch.concat(

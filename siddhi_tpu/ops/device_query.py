@@ -401,10 +401,11 @@ class DeviceQueryEngine:
 
         # select items: rewrite aggregators, classify outputs
         rewriter = _DeviceAggRewrite(scope, compiler)
-        if sel.selection is None and getattr(sel, "is_select_all", False):
-            # select *: every input attribute passes through at native
-            # width (stream functions never reach the device chain, so
-            # the flowing schema IS the stream definition)
+        if sel.selection is None:
+            # select * (selection=None IS the parser's select-all form):
+            # every input attribute passes through at native width
+            # (stream functions never reach the device chain, so the
+            # flowing schema IS the stream definition)
             sel = type(sel)(
                 selection=[
                     OutputAttribute(Variable(attribute=a.name))
@@ -416,9 +417,6 @@ class DeviceQueryEngine:
                 limit=sel.limit,
                 offset=sel.offset,
             )
-        if sel.selection is None:
-            raise SiddhiAppCreationError(
-                "device query path needs an explicit select list")
         # out_spec entries: ("expr", compiled) | ("group_key", key_index)
         # | ("passthrough", attr_name) — passthroughs gather the input
         # column host-side at native width (any type, incl. LONG/STRING)

@@ -14,10 +14,29 @@ def distributed_initialize(coordinator_address: Optional[str] = None,
                            process_id: Optional[int] = None):
     """Multi-host bring-up: one JAX process per host, ICI within a slice,
     DCN across slices (the reference has no analog — its clustering is
-    an external k8s operator).  Safe to call once per process before any
-    other JAX call."""
+    an external k8s operator).  Call once per process before any other
+    JAX call.
+
+    On CPU platforms (tests, local multi-process validation) the gloo
+    cross-process collective backend is selected automatically — without
+    it, collectives over a multi-process CPU mesh fail at dispatch.
+    Exercised by tests/test_distributed.py with a real 2-process mesh.
+    """
+    import os
+
     import jax
 
+    # CPU detection must not touch a backend (distributed.initialize
+    # must run first), so check the two explicit selection channels;
+    # a no-accelerator implicit CPU fallback isn't detectable here —
+    # set JAX_PLATFORMS=cpu explicitly in that case
+    plat = (os.environ.get("JAX_PLATFORMS", "")
+            or str(getattr(jax.config, "jax_platforms", None) or ""))
+    if plat.startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jax: option absent; collectives may
+            pass           # still work via the default implementation
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -351,6 +351,26 @@ class QueryPlanner:
             out_stream_id=f"#join_{name}",
         )
         qr.join_runtime = jr
+        # @app:execution('tpu'): run the O(B*W) cross-product condition
+        # as a jitted device kernel (buffering/expiry/materialization
+        # keep the host runtime's exact semantics — SURVEY §7 step 7's
+        # masked in-batch cross products)
+        if (self.app.app_context.execution_mode == "tpu"
+                and condition is not None):
+            import logging
+
+            from siddhi_tpu.core.join import DeviceJoinProbe
+
+            try:
+                jr.device_probe = DeviceJoinProbe(condition, left, right)
+                qr.lowered_to = "device_probe"
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': join condition lowered to the jitted "
+                    "device probe", name)
+            except SiddhiAppCreationError as e:
+                logging.getLogger("siddhi_tpu").warning(
+                    "query '%s': join device probe unavailable (%s); "
+                    "numpy probe used", name, e)
         if any(s.window is not None and getattr(s.window, "needs_scheduler", False) for s in sides):
             self.app.scheduler.register_task(jr)
         for side, src, is_left in ((left, j.left, True), (right, j.right, False)):
