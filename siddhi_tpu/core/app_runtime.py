@@ -85,7 +85,10 @@ class SiddhiAppRuntime:
         for t in self.tables.values():
             if hasattr(t, "start"):
                 t.start()  # record tables connect their stores
-        # sinks connect before sources so output paths exist when events flow
+        # sinks connect before sources so output paths exist when events
+        # flow; the running gate opens BEFORE sources connect — a source
+        # may deliver on its transport thread the instant it subscribes
+        self.app_context.app_running = True
         for s in self.sinks:
             s.start()
         for s in self.sources:
@@ -157,6 +160,7 @@ class SiddhiAppRuntime:
             mgr.unregister(element_id)
         self._handler_registrations = []
         self.running = False
+        self.app_context.app_running = False
         if self._manager is not None:
             # identity-guarded: an unregistered or replaced runtime must
             # not evict a different runtime registered under this name

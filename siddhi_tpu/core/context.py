@@ -80,6 +80,9 @@ class SiddhiAppContext:
         # BASELINE.json north-star gate); tpu_partitions sizes the
         # partition axis of dense pattern state, tpu_instances its
         # per-(partition, node) pending-instance capacity
+        # reference contract: InputHandler.send before start()/after
+        # shutdown() raises "app is not running" (InputHandler.java:50)
+        self.app_running = False
         self.execution_mode = "host"
         self.tpu_partitions = 65536
         self.tpu_instances = 4

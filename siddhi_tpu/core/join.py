@@ -146,9 +146,36 @@ class DeviceJoinProbe:
         lane env (raises SiddhiAppCreationError -> numpy probe kept).
         The env deliberately has NO timestamp key and no STRING/LONG
         lanes, so conditions touching those KeyError here and stay on
-        the null-safe host evaluation."""
+        the null-safe host evaluation.  Key accesses are recorded so
+        only condition-REFERENCED attributes ride device lanes — an
+        unrelated nullable column must neither ship to the device nor
+        force a host fallback."""
         import jax
 
+        # pass 1: record which env keys the condition actually reads
+        # (small numpy evaluation through the dual-backend expression)
+        class _Recorder(dict):
+            def __getitem__(self, k):
+                self.used.add(k)
+                return super().__getitem__(k)
+
+        rec = _Recorder()
+        rec.used = set()
+        for ref, lanes in self._lanes.items():
+            for k, dt in lanes.items():
+                shape = (4, 1) if ref == left.ref else (1, 4)
+                rec[k] = np.ones(shape, dtype=dt)
+        rec[N_KEY] = 16
+        try:
+            self.condition.fn(rec)
+            for ref in self._lanes:
+                self._lanes[ref] = {
+                    k: dt for k, dt in self._lanes[ref].items()
+                    if k in rec.used
+                }
+        except Exception:
+            pass  # pass 2 below decides eligibility with full lanes
+        # pass 2: the condition must trace over the (pruned) lane env
         env = {}
         for ref, lanes in self._lanes.items():
             for k, dt in lanes.items():

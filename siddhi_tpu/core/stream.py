@@ -224,7 +224,16 @@ class InputHandler:
         self.app_context = app_context
         self.definition = junction.definition
 
+    def _check_running(self):
+        # reference: InputHandler.send throws when the app is not
+        # running (InputHandler.java:50-97 "cannot send event")
+        if not getattr(self.app_context, "app_running", True):
+            raise SiddhiAppRuntimeError(
+                f"Siddhi app '{self.app_context.name}' is not running, "
+                "cannot send events")
+
     def send(self, data: Union[Event, Sequence, List[Event]], timestamp: Optional[int] = None):
+        self._check_running()
         tsgen = self.app_context.timestamp_generator
         if isinstance(data, Event):
             events = [data]
@@ -245,6 +254,7 @@ class InputHandler:
             self.junction.send(batch)
 
     def send_batch(self, batch: EventBatch):
+        self._check_running()
         if len(batch):
             # event time is monotone-max; one update per batch suffices
             self.app_context.timestamp_generator.set_event_time(

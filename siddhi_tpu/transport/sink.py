@@ -15,7 +15,10 @@ import logging
 from typing import Dict, List, Optional
 
 from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
-from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+from siddhi_tpu.core.exceptions import (
+    ConnectionUnavailableError,
+    SiddhiAppRuntimeError,
+)
 from siddhi_tpu.extension.registry import extension
 from siddhi_tpu.transport.broker import InMemoryBroker
 from siddhi_tpu.transport.retry import ConnectRetryMixin
@@ -67,11 +70,17 @@ class Sink(ConnectRetryMixin):
     """Transport publisher SPI (reference: Sink.java:59)."""
 
     def init(self, definition, options: Dict[str, str], mapper: SinkMapper, app_context):
+        import threading
+
         self.definition = definition
         self.options = options
         self.mapper = mapper
         self.app_context = app_context
         self.connected = False
+        # per-THREAD dynamic-option context: sync junctions deliver on
+        # the caller's thread, so two senders may traverse one sink
+        # concurrently — instance state would cross their topics
+        self._tls = threading.local()
         self._init_retry(options)
 
     # -- SPI ---------------------------------------------------------------
@@ -108,8 +117,46 @@ class Sink(ConnectRetryMixin):
         events = self._intercepted_events(batch)
         if not events:
             return
-        for payload in self.mapper.map(events):
-            self.publish_with_reconnect(payload)
+        payloads = self.mapper.map(events)
+        if len(payloads) == len(events):
+            # 1:1 mappers carry per-event context for dynamic options
+            # ('{{attr}}' templates, reference: util/transport/Option +
+            # TemplateBuilder — e.g. @sink(topic='{{symbol}}'))
+            for e, payload in zip(events, payloads):
+                self._tls.event = e
+                try:
+                    self.publish_with_reconnect(payload)
+                finally:
+                    self._tls.event = None
+        else:
+            for payload in payloads:
+                self.publish_with_reconnect(payload)
+
+    _TEMPLATE_RE = None
+
+    def resolve_option(self, name: str, default: Optional[str] = None):
+        """Option value with '{{attr}}' placeholders substituted from
+        the event being published (static values pass through)."""
+        v = self.options.get(name, default)
+        if v is None or "{{" not in v:
+            return v
+        import re
+
+        if Sink._TEMPLATE_RE is None:
+            Sink._TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
+        e = getattr(self._tls, "event", None)
+        names = self.definition.attribute_names
+
+        def sub(m):
+            attr = m.group(1)
+            if e is None or attr not in names:
+                raise SiddhiAppRuntimeError(
+                    f"sink option '{name}': cannot resolve "
+                    f"'{{{{{attr}}}}}' (no per-event context or unknown "
+                    "attribute)")
+            return str(e.data[names.index(attr)])
+
+        return Sink._TEMPLATE_RE.sub(sub, v)
 
     def publish_with_reconnect(self, payload):
         """Publish one payload; on connection failure route to
@@ -150,7 +197,7 @@ class InMemorySink(Sink):
     (reference: InMemorySink.java)."""
 
     def publish(self, payload):
-        topic = self.options.get("topic")
+        topic = self.resolve_option("topic")
         InMemoryBroker.publish(topic, payload)
 
 
@@ -174,13 +221,25 @@ class LogSink(Sink):
 
 
 class DistributionStrategy:
-    """Chooses destination indices per event
-    (reference: stream/output/sink/distributed/DistributionStrategy.java)."""
+    """Chooses destination indices per event among the ACTIVE
+    destinations — failed endpoints leave the rotation until their
+    reconnect succeeds (reference: stream/output/sink/distributed/
+    DistributionStrategy.java:71 destinationFailed /
+    destinationAvailable)."""
 
     def init(self, n_destinations: int, options: Dict[str, str], definition):
         self.n = n_destinations
         self.options = options
         self.definition = definition
+        self.active: List[int] = list(range(n_destinations))
+
+    def destination_failed(self, d: int):
+        if d in self.active:
+            self.active = [x for x in self.active if x != d]
+
+    def destination_available(self, d: int):
+        if d not in self.active:
+            self.active = sorted(self.active + [d])
 
     def destinations_for(self, event: Event) -> List[int]:
         raise NotImplementedError
@@ -192,7 +251,9 @@ class RoundRobinDistributionStrategy(DistributionStrategy):
         self._i = 0
 
     def destinations_for(self, event: Event) -> List[int]:
-        d = self._i % self.n
+        if not self.active:
+            return []
+        d = self.active[self._i % len(self.active)]
         self._i += 1
         return [d]
 
@@ -216,12 +277,25 @@ class PartitionedDistributionStrategy(DistributionStrategy):
     def destinations_for(self, event: Event) -> List[int]:
         import zlib
 
-        return [zlib.crc32(str(event.data[self._idx]).encode()) % self.n]
+        if event is None:
+            raise SiddhiAppRuntimeError(
+                "partitioned distribution needs per-event context "
+                "(a 1:1 sink mapper)")
+        if not self.active:
+            return []
+        # sticky primary over the TOTAL destination count: keys on
+        # healthy endpoints keep their affinity through another
+        # endpoint's outage; only the failed endpoint's keys redirect
+        h = zlib.crc32(str(event.data[self._idx]).encode())
+        primary = h % self.n
+        if primary in self.active:
+            return [primary]
+        return [self.active[h % len(self.active)]]
 
 
 class BroadcastDistributionStrategy(DistributionStrategy):
     def destinations_for(self, event: Event) -> List[int]:
-        return list(range(self.n))
+        return list(self.active)
 
 
 _STRATEGIES = {
@@ -275,7 +349,27 @@ class DistributedSink(Sink):
         events = self._intercepted_events(batch)
         if not events:
             return
+        # sync the rotation with observable endpoint health: re-admit
+        # reconnected children, evict already-down ones (e.g. a failed
+        # initial connect) BEFORE routing so their events go to healthy
+        # endpoints instead of the drop path
+        for d, c in enumerate(self.children):
+            if c.connected and d not in self.strategy.active:
+                self.strategy.destination_available(d)
+            elif not c.connected and d in self.strategy.active:
+                self.strategy.destination_failed(d)
         payloads = self.mapper.map(events)
-        for event, payload in zip(events, payloads):
+        pairs = (zip(events, payloads) if len(payloads) == len(events)
+                 else ((None, p) for p in payloads))
+        for event, payload in pairs:
             for d in self.strategy.destinations_for(event):
-                self.children[d].publish_with_reconnect(payload)
+                child = self.children[d]
+                child._tls.event = event  # dynamic-option context
+                try:
+                    child.publish_with_reconnect(payload)
+                finally:
+                    child._tls.event = None
+                if not child.connected:
+                    # endpoint down: drop it from rotation until its
+                    # reconnect chain succeeds
+                    self.strategy.destination_failed(d)

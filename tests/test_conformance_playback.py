@@ -1,0 +1,271 @@
+"""Playback / state / start-stop / sandbox conformance, ported from the
+reference `managment/` suites (PlaybackTestCase.java,
+StateTestCase.java, StartStopTestCase.java, SandboxTestCase.java):
+event-time windows under @app:playback, heartbeat idle-time flushes,
+out-of-order arrivals, stateful restarts.
+"""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def query_counts(rt, qname):
+    counts = {"in": 0, "out": 0, "first_remove_before_in": False}
+
+    def cb(ts, in_events, out_events):
+        if counts["in"] == 0 and out_events:
+            counts["first_remove_before_in"] = True
+        counts["in"] += len(in_events or [])
+        counts["out"] += len(out_events or [])
+
+    rt.add_callback(qname, cb)
+    return counts
+
+
+class TestPlaybackWindows:
+    def test_time_batch_window_event_time(self, manager):
+        """reference: playbackTest1:48 — a timeBatch window under
+        playback flushes on EVENT time; remove events only appear from
+        the second pane on."""
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback "
+            "define stream cseEventStream (symbol string, price float, "
+            "volume int); "
+            "@info(name='query1') from cseEventStream#window.timeBatch(1 sec) "
+            "select * insert all events into outputStream;")
+        counts = query_counts(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        ts = 1_600_000_000_000
+        h.send(["IBM", 700.0, 0], timestamp=ts)
+        h.send(["WSO2", 60.5, 1], timestamp=ts + 500)
+        h.send(["GOOGLE", 85.0, 1], timestamp=ts + 1000)   # closes pane 1
+        h.send(["ORACLE", 90.5, 1], timestamp=ts + 2000)   # closes pane 2
+        rt.shutdown()
+        assert counts["in"] == 3
+        assert counts["out"] == 2
+        assert not counts["first_remove_before_in"]
+
+    def test_time_window_all_events(self, manager):
+        """reference: playbackTest3-ish — sliding time window expiry on
+        event time with `insert all events`."""
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback "
+            "define stream S (symbol string, price float); "
+            "@info(name='q') from S#window.time(1 sec) select * "
+            "insert all events into Out;")
+        counts = query_counts(rt, "q")
+        rt.start()
+        h = rt.get_input_handler("S")
+        ts = 1_600_000_000_000
+        h.send(["A", 1.0], timestamp=ts)
+        h.send(["B", 2.0], timestamp=ts + 500)
+        h.send(["C", 3.0], timestamp=ts + 1100)  # A expired by now
+        rt.shutdown()
+        assert counts["in"] == 3
+        assert counts["out"] >= 1  # A (and possibly B) expired
+
+    def test_heartbeat_idle_time_flushes(self, manager):
+        """reference: playbackTest7/8 — @app:playback(idle.time,
+        increment): when no events arrive, the playback clock
+        auto-increments and closes panes."""
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback(idle.time='50 millisecond', increment='1 sec') "
+            "define stream S (symbol string, price float); "
+            "@info(name='q') from S#window.timeBatch(1 sec) select * "
+            "insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["A", 1.0], timestamp=1_600_000_000_000)
+        deadline = time.time() + 3
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        rt.shutdown()
+        # the idle heartbeat advanced event time past the pane boundary
+        assert got and got[0][0] == "A"
+
+    def test_out_of_order_event_below_watermark(self, manager):
+        """reference: playbackTest11 — an event older than the playback
+        clock still processes (watermark does not reject it)."""
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback "
+            "define stream S (symbol string, price float); "
+            "@info(name='q') from S select symbol insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        ts = 1_600_000_000_000
+        h.send(["A", 1.0], timestamp=ts)
+        h.send(["B", 2.0], timestamp=ts - 5000)  # older than watermark
+        rt.shutdown()
+        assert [g[0] for g in got] == ["A", "B"]
+
+    def test_invalid_increment_constant_rejected(self, manager):
+        """reference: playbackTest9 — a non-time increment constant is
+        a parse/creation error (the reference throws
+        SiddhiParserException)."""
+        from siddhi_tpu.compiler.parser import SiddhiParserError
+
+        with pytest.raises((SiddhiAppCreationError, SiddhiParserError)):
+            manager.create_siddhi_app_runtime(
+                "@app:playback(idle.time='100 millisecond', increment='x') "
+                "define stream S (v long); "
+                "from S#window.time(2 sec) select v insert into Out;")
+
+    def test_length_batch_under_playback(self, manager):
+        """reference: playbackTest13-ish — count-based windows are
+        unaffected by the playback clock."""
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback "
+            "define stream S (v long); "
+            "@info(name='q') from S#window.lengthBatch(2) "
+            "select sum(v) as t insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, ts in enumerate([10, 5, 30, 2]):  # wildly non-monotone
+            h.send([i + 1], timestamp=1_000_000 + ts)
+        rt.shutdown()
+        assert got == [[3], [7]]
+
+
+class TestStateAcrossRestart:
+    """reference: StateTestCase.java — stateful elements resume after
+    persist + fresh-runtime restore."""
+
+    def test_count_window_sum_resumes(self, manager):
+        from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+        manager.set_persistence_store(InMemoryPersistenceStore())
+        app = ("@app:name('stateApp') @app:playback "
+               "define stream S (symbol string, price float); "
+               "@info(name='q') from S#window.length(4) "
+               "select symbol, sum(price) as total insert into Out;")
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["IBM", 100.0], timestamp=1000)
+        h.send(["IBM", 200.0], timestamp=1001)
+        rev = rt.persist()
+        rt.shutdown()
+
+        rt2 = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt2.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt2.start()
+        rt2.restore_revision(rev)
+        rt2.get_input_handler("S").send(["IBM", 50.0], timestamp=1002)
+        rt2.shutdown()
+        assert got == [["IBM", 350.0]]
+
+    def test_pattern_half_match_resumes(self, manager):
+        from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+        manager.set_persistence_store(InMemoryPersistenceStore())
+        app = ("@app:name('patState') @app:playback "
+               "define stream S (k string, v double); "
+               "@info(name='q') from every a=S[v > 10.0] -> b=S[v > a.v] "
+               "within 1 min select a.v as av, b.v as bv insert into Out;")
+        rt = manager.create_siddhi_app_runtime(app)
+        rt.start()
+        rt.get_input_handler("S").send(["x", 20.0], timestamp=1000)  # arms
+        rev = rt.persist()
+        rt.shutdown()
+
+        rt2 = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt2.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt2.start()
+        rt2.restore_revision(rev)
+        rt2.get_input_handler("S").send(["x", 25.0], timestamp=2000)
+        rt2.shutdown()
+        assert got == [[20.0, 25.0]]
+
+
+class TestStartStop:
+    def test_events_before_start_and_after_shutdown_ignored(self, manager):
+        """reference: StartStopTestCase — sends before start() do not
+        crash or emit."""
+        from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
+
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback define stream S (v long); "
+            "@info(name='q') from S[v > 0] select v insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        h = rt.get_input_handler("S")
+        with pytest.raises(SiddhiAppRuntimeError):
+            h.send([1], timestamp=1000)  # before start: app not running
+        rt.start()
+        h.send([2], timestamp=1001)
+        rt.shutdown()
+        with pytest.raises(SiddhiAppRuntimeError):
+            h.send([3], timestamp=1002)  # after shutdown
+        assert got == [[2]]
+
+    def test_restartable(self, manager):
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback define stream S (v long); "
+            "@info(name='q') from S select v insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        rt.get_input_handler("S").send([1], timestamp=1000)
+        rt.shutdown()
+        rt.start()
+        rt.get_input_handler("S").send([2], timestamp=2000)
+        rt.shutdown()
+        assert got == [[1], [2]]
+
+
+class TestSandbox:
+    def test_sandbox_strips_non_inmemory_transports(self, manager):
+        """reference: SandboxTestCase.java:56 +
+        SiddhiManager.removeSourceSinkAndStoreAnnotations:121 —
+        non-inMemory @source/@sink are removed (the stream stays
+        drivable via its input handler); inMemory transports SURVIVE
+        sandboxing."""
+        from siddhi_tpu.transport.source import Source
+
+        class ExternalSource(Source):
+            def connect(self):
+                raise AssertionError("sandbox must not connect this")
+
+        manager.set_extension("externalThing", ExternalSource, kind="source")
+        app = (
+            "define stream S (v long); "
+            "@source(type='externalThing', topic='x', "
+            "@map(type='passThrough')) "
+            "define stream T (v long); "
+            "@sink(type='log') "
+            "@sink(type='inMemory', topic='sandbox-out', "
+            "@map(type='passThrough')) "
+            "define stream Out (v long); "
+            "from S select v insert into Out; "
+            "from T select v insert into Out;")
+        rt = manager.create_sandbox_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        assert rt.sources == []  # externalThing stripped
+        assert len(rt.sinks) == 1  # log stripped, inMemory kept
+        rt.get_input_handler("S").send([7])
+        # T lost its source but is still drivable via its input handler
+        rt.get_input_handler("T").send([8])
+        rt.shutdown()
+        assert got == [[7], [8]]

@@ -1,0 +1,357 @@
+"""Transport conformance, ported from the reference suites
+(`transport/InMemoryTransportTestCase.java`,
+`MultiClientDistributedSinkTestCase.java`, with the
+`TestFailingInMemorySink`/`TestFailingInMemorySource` doubles):
+dynamic sink options, failing-sink retry/backoff/drop accounting,
+failing-source connect retries, multi-sink streams, and distributed
+endpoint failover.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+from siddhi_tpu.transport.broker import InMemoryBroker, Subscriber
+from siddhi_tpu.transport.sink import Sink
+from siddhi_tpu.transport.source import Source
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+class _Topic(Subscriber):
+    def __init__(self, topic):
+        self.topic = topic
+        self.messages = []
+
+    def get_topic(self):
+        return self.topic
+
+    def on_message(self, msg):
+        self.messages.append(msg)
+
+
+class TestDynamicSinkOptions:
+    def test_per_event_topic_routing(self, manager):
+        """@sink(topic='{{symbol}}') routes each event by its own
+        attribute value (reference:
+        inMemorySinkAndEventMappingWithSiddhiQLDynamicParams:57)."""
+        wso2, ibm = _Topic("WSO2"), _Topic("IBM")
+        InMemoryBroker.subscribe(wso2)
+        InMemoryBroker.subscribe(ibm)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream FooStream (symbol string, price float, "
+                "volume long); "
+                "@sink(type='inMemory', topic='{{symbol}}', "
+                "@map(type='passThrough')) "
+                "define stream BarStream (symbol string, price float, "
+                "volume long); "
+                "from FooStream select * insert into BarStream;")
+            rt.start()
+            h = rt.get_input_handler("FooStream")
+            h.send(["WSO2", 55.6, 100])
+            h.send(["IBM", 75.6, 100])
+            h.send(["WSO2", 57.6, 100])
+            rt.shutdown()
+            assert len(wso2.messages) == 2
+            assert len(ibm.messages) == 1
+            assert ibm.messages[0].data[1] == pytest.approx(75.6)
+        finally:
+            InMemoryBroker.unsubscribe(wso2)
+            InMemoryBroker.unsubscribe(ibm)
+
+    def test_static_topic_unchanged(self, manager):
+        t = _Topic("fixed")
+        InMemoryBroker.subscribe(t)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='inMemory', topic='fixed', "
+                "@map(type='passThrough')) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            rt.start()
+            rt.get_input_handler("S").send([1])
+            rt.shutdown()
+            assert len(t.messages) == 1
+        finally:
+            InMemoryBroker.unsubscribe(t)
+
+    def test_unknown_template_attribute_errors(self, manager):
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); "
+            "@sink(type='inMemory', topic='{{nope}}', "
+            "@map(type='passThrough')) "
+            "define stream Out (v long); "
+            "from S select v insert into Out;")
+        errors = []
+        rt.add_exception_listener(errors.append)
+        rt.start()
+        rt.get_input_handler("S").send([1])
+        rt.shutdown()
+        assert errors, "unresolvable template must surface an error"
+
+
+class TestFailingSink:
+    """The TestFailingInMemorySink contract: while the transport is
+    down, publishes drop (counted), a single backoff reconnect chain
+    runs, and delivery resumes after reconnection (reference:
+    inMemoryWithFailingSink:511, inMemoryWithFailingSink1:579)."""
+
+    def _failing_sink_cls(self, state):
+        class FailingInMemorySink(Sink):
+            def connect(self):
+                if state["fail"]:
+                    state["errors"] += 1
+                    raise ConnectionUnavailableError("connect failed")
+
+            def publish(self, payload):
+                if state["fail"]:
+                    state["errors"] += 1
+                    raise ConnectionUnavailableError("transport down")
+                InMemoryBroker.publish(self.resolve_option("topic"), payload)
+
+        return FailingInMemorySink
+
+    def test_temporary_failure_drops_then_recovers(self, manager):
+        state = {"fail": False, "errors": 0}
+        manager.set_extension("testFailingInMemory",
+                              self._failing_sink_cls(state), kind="sink")
+        wso2, ibm = _Topic("WSO2"), _Topic("IBM")
+        InMemoryBroker.subscribe(wso2)
+        InMemoryBroker.subscribe(ibm)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream FooStream (symbol string, price float, "
+                "volume long); "
+                "@sink(type='testFailingInMemory', topic='{{symbol}}', "
+                "retry.scale='0.0001', @map(type='passThrough')) "
+                "define stream BarStream (symbol string, price float, "
+                "volume long); "
+                "from FooStream select * insert into BarStream;")
+            rt.start()
+            h = rt.get_input_handler("FooStream")
+            h.send(["WSO2", 55.6, 100])
+            h.send(["IBM", 75.6, 100])
+            state["fail"] = True
+            h.send(["WSO2", 57.6, 100])  # publish fails, dropped
+            h.send(["WSO2", 57.6, 100])  # not connected, dropped
+            state["fail"] = False
+            deadline = time.time() + 2
+            while not rt.sinks[0].connected and time.time() < deadline:
+                time.sleep(0.005)
+            h.send(["IBM", 75.6, 100])
+            rt.shutdown()
+            # reference assertions: 1 WSO2 delivery, 2 IBM deliveries,
+            # both down-window WSO2 events dropped
+            assert len(wso2.messages) == 1
+            assert len(ibm.messages) == 2
+            assert state["errors"] >= 1
+        finally:
+            InMemoryBroker.unsubscribe(wso2)
+            InMemoryBroker.unsubscribe(ibm)
+
+    def test_always_failing_delivers_nothing(self, manager):
+        state = {"fail": True, "errors": 0}
+        manager.set_extension("testFailingInMemory",
+                              self._failing_sink_cls(state), kind="sink")
+        t = _Topic("T")
+        InMemoryBroker.subscribe(t)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='testFailingInMemory', topic='T', "
+                "retry.scale='0.0001', @map(type='passThrough')) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i in range(4):
+                h.send([i])
+            time.sleep(0.05)
+            rt.shutdown()
+            assert t.messages == []
+            assert state["errors"] >= 4  # every attempt errored
+        finally:
+            InMemoryBroker.unsubscribe(t)
+
+
+class TestFailingSource:
+    def test_source_connects_after_failures_then_flows(self, manager):
+        """reference: inMemoryWithFailingSource:650 — events sent while
+        the source cannot connect are lost; flow resumes after the
+        retry chain connects."""
+        state = {"failures_left": 2, "attempts": 0}
+
+        class FailingInMemorySource(Source):
+            def connect(self):
+                state["attempts"] += 1
+                if state["failures_left"] > 0:
+                    state["failures_left"] -= 1
+                    raise ConnectionUnavailableError("broker down")
+                self._sub = type("S", (Subscriber,), {
+                    "get_topic": lambda s: self.options.get("topic"),
+                    "on_message": lambda s, msg: self.deliver(msg),
+                })()
+                InMemoryBroker.subscribe(self._sub)
+
+            def disconnect(self):
+                sub = getattr(self, "_sub", None)
+                if sub is not None:
+                    InMemoryBroker.unsubscribe(sub)
+
+        manager.set_extension("testFailingInMemorySource",
+                              FailingInMemorySource, kind="source")
+        rt = manager.create_siddhi_app_runtime(
+            "@source(type='testFailingInMemorySource', topic='IN', "
+            "retry.scale='0.0001', @map(type='passThrough')) "
+            "define stream S (v long); "
+            "from S select v insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        deadline = time.time() + 2
+        while state["attempts"] < 3 and time.time() < deadline:
+            time.sleep(0.005)
+        assert rt.sources[0].connected
+        from siddhi_tpu.core.event import Event
+
+        InMemoryBroker.publish("IN", Event(data=[42]))
+        rt.shutdown()
+        assert state["attempts"] == 3  # 2 failures + 1 success
+        assert got == [[42]]
+
+
+class TestMultiSinkStream:
+    def test_two_sinks_same_stream(self, manager):
+        """reference: inMemoryTestCase3:367 — two @sink annotations on
+        one stream publish every event to both topics."""
+        t1, t2 = _Topic("topic1"), _Topic("topic2")
+        InMemoryBroker.subscribe(t1)
+        InMemoryBroker.subscribe(t2)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='inMemory', topic='topic1', "
+                "@map(type='passThrough')) "
+                "@sink(type='inMemory', topic='topic2', "
+                "@map(type='passThrough')) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1])
+            h.send([2])
+            rt.shutdown()
+            assert len(t1.messages) == 2
+            assert len(t2.messages) == 2
+        finally:
+            InMemoryBroker.unsubscribe(t1)
+            InMemoryBroker.unsubscribe(t2)
+
+
+class TestDistributedSinkFailover:
+    def test_roundrobin_skips_failed_endpoint(self, manager):
+        """reference: MultiClientDistributedSinkTestCase — when one
+        endpoint fails, round-robin continues over the remaining
+        endpoints; the endpoint rejoins after its reconnect."""
+        state = {"fail_topic": None, "errors": 0}
+
+        class FlakyInMemorySink(Sink):
+            def connect(self):
+                if self.resolve_option("topic") == state["fail_topic"]:
+                    raise ConnectionUnavailableError("endpoint down")
+
+            def publish(self, payload):
+                topic = self.resolve_option("topic")
+                if topic == state["fail_topic"]:
+                    state["errors"] += 1
+                    raise ConnectionUnavailableError("endpoint down")
+                InMemoryBroker.publish(topic, payload)
+
+        manager.set_extension("flakyInMemory", FlakyInMemorySink,
+                              kind="sink")
+        t1, t2 = _Topic("d1"), _Topic("d2")
+        InMemoryBroker.subscribe(t1)
+        InMemoryBroker.subscribe(t2)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='flakyInMemory', retry.scale='0.0001', "
+                "@map(type='passThrough'), "
+                "@distribution(strategy='roundRobin', "
+                "@destination(topic='d1'), @destination(topic='d2'))) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1])  # -> d1
+            h.send([2])  # -> d2
+            state["fail_topic"] = "d2"
+            h.send([3])  # -> d1 (rotation counter)
+            h.send([4])  # -> d2 fails (dropped); d2 leaves rotation
+            h.send([5])  # -> d1 (only active endpoint)
+            state["fail_topic"] = None
+            deadline = time.time() + 2
+            sink = rt.sinks[0]
+            while (not all(c.connected for c in sink.children)
+                   and time.time() < deadline):
+                time.sleep(0.005)
+            h.send([6])  # d2 re-admitted: round robin over both again
+            h.send([7])
+            rt.shutdown()
+            d1_vals = [m.data[0] for m in t1.messages]
+            d2_vals = [m.data[0] for m in t2.messages]
+            assert d1_vals[:3] == [1, 3, 5], d1_vals
+            assert 4 not in d1_vals + d2_vals  # dropped while down
+            assert d2_vals[0] == 2 and len(d2_vals) == 2, d2_vals
+            # post-recovery, 6 and 7 alternate over both endpoints
+            assert sorted(d1_vals[3:] + d2_vals[1:]) == [6, 7]
+            assert state["errors"] == 1
+        finally:
+            InMemoryBroker.unsubscribe(t1)
+            InMemoryBroker.unsubscribe(t2)
+
+    def test_broadcast_excludes_failed_endpoint(self, manager):
+        state = {"fail_topic": None}
+
+        class FlakySink(Sink):
+            def publish(self, payload):
+                topic = self.resolve_option("topic")
+                if topic == state["fail_topic"]:
+                    raise ConnectionUnavailableError("down")
+                InMemoryBroker.publish(topic, payload)
+
+        manager.set_extension("flaky2", FlakySink, kind="sink")
+        t1, t2 = _Topic("b1"), _Topic("b2")
+        InMemoryBroker.subscribe(t1)
+        InMemoryBroker.subscribe(t2)
+        try:
+            rt = manager.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='flaky2', retry.scale='100000', "
+                "@map(type='passThrough'), "
+                "@distribution(strategy='broadcast', "
+                "@destination(topic='b1'), @destination(topic='b2'))) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1])  # both
+            state["fail_topic"] = "b2"
+            h.send([2])  # b2 fails and leaves the broadcast set
+            h.send([3])  # b1 only
+            rt.shutdown()
+            assert [m.data[0] for m in t1.messages] == [1, 2, 3]
+            assert [m.data[0] for m in t2.messages] == [1]
+        finally:
+            InMemoryBroker.unsubscribe(t1)
+            InMemoryBroker.unsubscribe(t2)

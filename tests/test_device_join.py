@@ -167,6 +167,37 @@ class TestDeviceJoinFallbacks:
         dev = run_nullable("@app:execution('tpu') ")
         assert host == dev and len(host) == 2, (host, dev)
 
+    def test_nullable_unrelated_column_keeps_probe(self):
+        # only condition-REFERENCED attributes ride lanes: nulls in a
+        # column the condition never reads must not force a fallback
+        from siddhi_tpu.core.event import EventBatch
+
+        app = (DEFS + "@info(name='j') from A#window.length(3) join "
+               "B#window.length(3) on A.n == B.m "
+               "select A.n as n, B.m as m insert into O;")
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') " + app)
+            got = []
+            rt.add_callback("O", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            rt.get_input_handler("B").send(["b", 1.0, 3], timestamp=1)
+            xs = np.empty(2, dtype=object)
+            xs[:] = [None, 2.0]  # nulls in x, which the condition ignores
+            rt.get_input_handler("A").send_batch(EventBatch(
+                "A", ["sym", "x", "n"],
+                {"sym": np.array(["a1", "a2"], dtype=object),
+                 "x": xs, "n": np.array([3, 9], dtype=np.int32)},
+                np.array([2, 3], dtype=np.int64)))
+            jr = next(iter(rt.query_runtimes.values())).join_runtime
+            assert jr.probe_invocations > 0  # probe ran despite nulls
+            rt.shutdown()
+            assert got == [(3, 3)], got
+        finally:
+            m.shutdown()
+
 
 class TestDeviceJoinFuzz:
     @pytest.mark.parametrize("seed", range(3))
