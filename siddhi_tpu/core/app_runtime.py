@@ -87,12 +87,18 @@ class SiddhiAppRuntime:
                 t.start()  # record tables connect their stores
         # sinks connect before sources so output paths exist when events
         # flow; the running gate opens BEFORE sources connect — a source
-        # may deliver on its transport thread the instant it subscribes
+        # may deliver on its transport thread the instant it subscribes.
+        # Rolled back if a transport start raises, so a failed start()
+        # leaves the InputHandler gate closed.
         self.app_context.app_running = True
-        for s in self.sinks:
-            s.start()
-        for s in self.sources:
-            s.start()
+        try:
+            for s in self.sinks:
+                s.start()
+            for s in self.sources:
+                s.start()
+        except Exception:
+            self.app_context.app_running = False
+            raise
         from siddhi_tpu.util.statistics import Level
 
         sm = self.app_context.statistics_manager

@@ -226,12 +226,13 @@ class DensePatternRuntime:
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None],
                  key_fn: Optional[Callable] = None,
-                 mesh=None):
+                 mesh=None, app_context=None):
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.key_fn = key_fn
         self.mesh = mesh
+        self._app_context = app_context  # exception-listener channel
         self._sharded: Optional[Dict[str, object]] = None
         if mesh is not None:
             from siddhi_tpu.parallel.mesh import ShardedPatternEngine
@@ -597,12 +598,24 @@ class DensePatternRuntime:
     def _check_overflow(self):
         total = self.overflow_total()
         if total > self._ovf_warned:
-            log.warning(
-                "dense pattern '%s': %d pending instance(s) dropped — "
-                "instance lanes full; matches may be missing vs the host "
-                "engine.  Raise @app:execution('tpu', instances='N') "
-                "(current %d per partition/node).",
-                self.out_stream_id, total, self.engine.I)
+            msg = (
+                f"dense pattern '{self.out_stream_id}': "
+                f"{total} pending instance(s) dropped — instance lanes "
+                "full; matches may be missing vs the host engine.  Raise "
+                "@app:execution('tpu', instances='N') (current "
+                f"{self.engine.I} per partition/node).")
+            log.warning("%s", msg)
+            # user-visible signal beyond the log: app exception
+            # listeners observe lost-match capacity pressure (the
+            # reference's runtime ExceptionListener channel,
+            # SiddhiAppRuntimeImpl.handleRuntimeExceptionWith:827)
+            listeners = getattr(self._app_context, "exception_listeners",
+                                None) if self._app_context else None
+            for listener in listeners or ():
+                try:
+                    listener(SiddhiAppRuntimeError(msg))
+                except Exception:  # a bad listener must not kill the flow
+                    log.exception("exception listener failed")
             self._ovf_warned = total
 
     def close(self):

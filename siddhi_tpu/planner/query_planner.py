@@ -577,7 +577,7 @@ class QueryPlanner:
             mesh = self._get_mesh(nd)
         runtime = DensePatternRuntime(
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
-            key_fn=key_fn, mesh=mesh,
+            key_fn=key_fn, mesh=mesh, app_context=self.app.app_context,
         )
         if getattr(selector, "partition_axis", False):
             # idle-key purges must also drop the shared selector's

@@ -12,6 +12,7 @@ the junction's @OnError handling).
 from __future__ import annotations
 
 import logging
+import re
 from typing import Dict, List, Optional
 
 from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
@@ -22,6 +23,10 @@ from siddhi_tpu.core.exceptions import (
 from siddhi_tpu.extension.registry import extension
 from siddhi_tpu.transport.broker import InMemoryBroker
 from siddhi_tpu.transport.retry import ConnectRetryMixin
+
+# '{{attr}}' dynamic-option placeholders (reference: util/transport/
+# Option + TemplateBuilder)
+_TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
 
 log = logging.getLogger(__name__)
 
@@ -132,18 +137,12 @@ class Sink(ConnectRetryMixin):
             for payload in payloads:
                 self.publish_with_reconnect(payload)
 
-    _TEMPLATE_RE = None
-
     def resolve_option(self, name: str, default: Optional[str] = None):
         """Option value with '{{attr}}' placeholders substituted from
         the event being published (static values pass through)."""
         v = self.options.get(name, default)
         if v is None or "{{" not in v:
             return v
-        import re
-
-        if Sink._TEMPLATE_RE is None:
-            Sink._TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
         e = getattr(self._tls, "event", None)
         names = self.definition.attribute_names
 
@@ -156,7 +155,7 @@ class Sink(ConnectRetryMixin):
                     "attribute)")
             return str(e.data[names.index(attr)])
 
-        return Sink._TEMPLATE_RE.sub(sub, v)
+        return _TEMPLATE_RE.sub(sub, v)
 
     def publish_with_reconnect(self, payload):
         """Publish one payload; on connection failure route to
@@ -362,7 +361,13 @@ class DistributedSink(Sink):
         pairs = (zip(events, payloads) if len(payloads) == len(events)
                  else ((None, p) for p in payloads))
         for event, payload in pairs:
-            for d in self.strategy.destinations_for(event):
+            dests = self.strategy.destinations_for(event)
+            if not dests:
+                # every destination down: the drop must stay diagnosable
+                self.on_error(payload, ConnectionUnavailableError(
+                    "no active destinations"))
+                continue
+            for d in dests:
                 child = self.children[d]
                 child._tls.event = event  # dynamic-option context
                 try:

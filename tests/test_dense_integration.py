@@ -502,3 +502,43 @@ class TestGroupEveryDense:
         rt = manager.create_siddhi_app_runtime(app)
         assert not isinstance(
             rt.query_runtimes["q"].pattern_processor, DensePatternRuntime)
+
+
+class TestOverflowSignal:
+    def test_dropped_instances_reach_exception_listeners(self, manager):
+        """Instance-lane overflow (real matches possibly lost) must be a
+        USER-VISIBLE signal — a WARNING log plus the app's exception
+        listeners — not just an internal counter (the overflow policy
+        is documented at ops/dense_nfa.py:39-47)."""
+        import logging
+
+        app = (
+            "@app:playback @app:execution('tpu', instances='1') "
+            "define stream S (k string, v double); "
+            "@info(name='q') from every a=S[v > 0.0] -> b=S[v > 100.0] "
+            "within 10 min select a.v as av, b.v as bv insert into Out;"
+        )
+        rt = manager.create_siddhi_app_runtime(app)
+        seen = []
+        rt.add_exception_listener(seen.append)
+        rt.start()
+        h = rt.get_input_handler("S")
+        logger = logging.getLogger("siddhi_tpu")
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r)
+        logger.addHandler(handler)
+        try:
+            # 'every' arms a new pending instance per event; with a
+            # single lane, the second arm drops a pending instance
+            for i in range(400):
+                h.send(["u", 1.0 + i], timestamp=1000 + i)
+            rt.shutdown()  # close() runs the final overflow check
+        finally:
+            logger.removeHandler(handler)
+        qr = rt.query_runtimes["q"]
+        stats = qr.pattern_processor.stats()
+        assert stats["dropped_instances"] > 0  # overflow really happened
+        assert seen, "exception listeners must observe dropped matches"
+        assert "dropped" in str(seen[0])
+        assert any("dropped" in r.getMessage() for r in records)
