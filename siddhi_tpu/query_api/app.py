@@ -22,8 +22,13 @@ from siddhi_tpu.query_api.definition import (
 from siddhi_tpu.query_api.execution import Partition, Query
 
 
-class DuplicateDefinitionError(Exception):
-    pass
+from siddhi_tpu.core.exceptions import SiddhiAppValidationError
+
+
+class DuplicateDefinitionError(SiddhiAppValidationError):
+    """reference: DuplicateDefinitionException extends
+    SiddhiAppValidationException (extends SiddhiAppCreationException) —
+    so callers catching creation errors see duplicates too."""
 
 
 @dataclass

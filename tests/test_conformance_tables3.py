@@ -1,0 +1,224 @@
+"""Table condition-operator matrices, ported from the reference
+`query/table/PrimaryKeyTableTestCase.java` (76 cases) /
+`IndexTableTestCase.java` (63) / `LogicalTableTestCase.java` /
+`DeleteFromTableTestCase.java` / `UpdateFromTableTestCase.java`.
+
+The reference's per-case assertions mostly pin that INDEXED lookups
+(compiled CollectionExecutor probes) return the same rows an exhaustive
+scan would.  That contract is tested here directly: every (operator x
+condition-shape x operation) cell runs on a PLAIN table, a @primaryKey
+table, and an @index table, and all three must agree — plus absolute
+assertions on representative cells.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEFS = (
+    "define stream StockStream (symbol string, price double, volume long); "
+    "define stream Check (symbol string, price double, volume long); "
+    "define stream Del (symbol string, price double, volume long); "
+    "define stream Upd (symbol string, price double, volume long); "
+)
+
+ROWS = [
+    ["A", 10.0, 100], ["B", 20.0, 200], ["C", 30.0, 300],
+    ["D", 40.0, 400], ["E", 50.0, 500],
+]
+
+
+def run(table_ann, body, sends):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback " + DEFS + table_ann +
+            "define table T (symbol string, price double, volume long); "
+            "from StockStream insert into T; " + body)
+        got = []
+        if "insert into Out" in body:
+            rt.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+        rt.start()
+        ts = 1000
+        for row in ROWS:
+            rt.get_input_handler("StockStream").send(list(row), timestamp=ts)
+            ts += 1
+        for sid, row in sends:
+            rt.get_input_handler(sid).send(list(row), timestamp=ts)
+            ts += 1
+        batch = rt.tables["T"].rows_batch()
+        if batch is None or len(batch) == 0:
+            table_rows = []
+        else:
+            cols = [np.asarray(batch.columns[c]).tolist()
+                    for c in ("symbol", "price", "volume")]
+            table_rows = sorted(tuple(r) for r in zip(*cols))
+        rt.shutdown()
+        return got, table_rows
+    finally:
+        m.shutdown()
+
+
+ANNS = ["", "@primaryKey('symbol') ", "@index('volume') "]
+
+
+def agree(body, sends):
+    """Run under all three table layouts; results must be identical."""
+    results = [run(a, body, sends) for a in ANNS]
+    base_got, base_rows = results[0]
+    for (g, r), a in zip(results[1:], ANNS[1:]):
+        assert g == base_got, (a, base_got, g)
+        assert r == base_rows, (a, base_rows, r)
+    return base_got, base_rows
+
+
+class TestJoinProbeOperators:
+    """reference: PrimaryKeyTableTestCase / IndexTableTestCase — every
+    compare operator against the key/indexed column, probe == scan."""
+
+    @pytest.mark.parametrize("op,expect_syms", [
+        ("==", ["C"]),
+        ("!=", ["A", "B", "D", "E"]),
+        ("<", ["A", "B"]),
+        ("<=", ["A", "B", "C"]),
+        (">", ["D", "E"]),
+        (">=", ["C", "D", "E"]),
+    ])
+    def test_volume_operator(self, op, expect_syms):
+        body = (f"from Check join T on T.volume {op} 300 "
+                "select T.symbol as s insert into Out;")
+        got, _ = agree(body, [("Check", ["x", 0.0, 0])])
+        assert sorted(g[0] for g in got) == expect_syms
+
+    @pytest.mark.parametrize("op,expect_syms", [
+        ("==", ["B"]), ("<", ["A"]), (">=", ["B", "C", "D", "E"]),
+    ])
+    def test_symbol_pk_operator(self, op, expect_syms):
+        body = (f"from Check join T on T.symbol {op} 'B' "
+                "select T.symbol as s insert into Out;")
+        got, _ = agree(body, [("Check", ["x", 0.0, 0])])
+        assert sorted(g[0] for g in got) == expect_syms
+
+    def test_dynamic_probe_value_from_stream(self):
+        body = ("from Check join T on T.symbol == Check.symbol "
+                "select T.symbol as s, T.volume as v insert into Out;")
+        got, _ = agree(body, [("Check", ["D", 0.0, 0]),
+                              ("Check", ["Z", 0.0, 0])])
+        assert got == [("D", 400)]
+
+
+class TestLogicalConditions:
+    """reference: LogicalTableTestCase — and/or/not combinations must
+    plan identically across layouts."""
+
+    @pytest.mark.parametrize("cond,expect", [
+        ("T.symbol == 'B' and T.volume == 200", ["B"]),
+        ("T.symbol == 'B' and T.volume == 999", []),
+        ("T.symbol == 'B' or T.volume == 400", ["B", "D"]),
+        ("not (T.volume > 200)", ["A", "B"]),
+        ("T.volume > 100 and T.volume < 400", ["B", "C"]),
+        ("T.symbol == 'A' or T.symbol == 'E' or T.volume == 300",
+         ["A", "C", "E"]),
+    ])
+    def test_compound(self, cond, expect):
+        body = (f"from Check join T on {cond} "
+                "select T.symbol as s insert into Out;")
+        got, _ = agree(body, [("Check", ["x", 0.0, 0])])
+        assert sorted(g[0] for g in got) == expect
+
+
+class TestDeleteOperators:
+    """reference: DeleteFromTableTestCase — delete conditions over each
+    layout leave identical table contents."""
+
+    @pytest.mark.parametrize("cond,left", [
+        ("T.symbol == Del.symbol", ["A", "C", "D", "E"]),
+        ("T.volume < 300", ["C", "D", "E"]),
+        ("T.volume >= Del.volume", ["A"]),
+        ("T.symbol != 'C'", ["C"]),
+    ])
+    def test_delete(self, cond, left):
+        body = f"from Del delete T on {cond};"
+        _got, rows = agree(body, [("Del", ["B", 20.0, 200])])
+        assert [r[0] for r in rows] == left
+
+
+class TestUpdateOperators:
+    """reference: UpdateFromTableTestCase / UpdateOrInsertTableTestCase."""
+
+    def test_update_set_with_expression(self):
+        body = ("from Upd update T set T.price = T.price + Upd.price "
+                "on T.symbol == Upd.symbol;")
+        _got, rows = agree(body, [("Upd", ["B", 5.0, 0])])
+        assert [r for r in rows if r[0] == "B"][0][1] == 25.0
+
+    def test_update_condition_on_non_key(self):
+        body = ("from Upd update T set T.price = 0.0 on T.volume > 300;")
+        _got, rows = agree(body, [("Upd", ["x", 0.0, 0])])
+        assert sorted(r[0] for r in rows if r[1] == 0.0) == ["D", "E"]
+
+    def test_update_or_insert_both_paths(self):
+        body = ("from Upd update or insert into T "
+                "set T.price = Upd.price on T.symbol == Upd.symbol;")
+        _got, rows = agree(body, [("Upd", ["B", 99.0, 0]),
+                                  ("Upd", ["Z", 7.0, 700])])
+        assert [r for r in rows if r[0] == "B"][0][1] == 99.0
+        assert [r for r in rows if r[0] == "Z"][0] == ("Z", 7.0, 700)
+
+
+class TestInOperatorLayouts:
+    """reference: the `in T` membership probe across layouts."""
+
+    def test_value_membership(self):
+        body = ("from Check[Check.symbol in T] select symbol "
+                "insert into Out;")
+        # value-membership needs a single-attr primary key; plain/index
+        # layouts use the condition form instead, so compare pk against
+        # the explicit-condition equivalents
+        got_pk, _ = run("@primaryKey('symbol') ", body,
+                        [("Check", ["C", 0.0, 0]), ("Check", ["Z", 0.0, 0])])
+        body2 = ("from Check[(Check.symbol == T.symbol) in T] "
+                 "select symbol insert into Out;")
+        for ann in ANNS:
+            got, _ = run(ann, body2, [("Check", ["C", 0.0, 0]),
+                                      ("Check", ["Z", 0.0, 0])])
+            assert got == got_pk == [("C",)]
+
+
+class TestDefineTableEdges:
+    """reference: DefineTableTestCase — definition-level contracts."""
+
+    def test_duplicate_table_definition_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        m = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError):
+                m.create_siddhi_app_runtime(
+                    "define table T (a string); define table T (b long);")
+        finally:
+            m.shutdown()
+
+    def test_table_and_stream_name_collision_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        m = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError):
+                m.create_siddhi_app_runtime(
+                    "define stream T (a string); define table T (a string);")
+        finally:
+            m.shutdown()
+
+    def test_unknown_pk_attribute_rejected(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        m = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError):
+                m.create_siddhi_app_runtime(
+                    "@primaryKey('nope') define table T (a string);")
+        finally:
+            m.shutdown()
