@@ -367,7 +367,14 @@ class AppPlanner:
             size = int(cache_ann.element("size") or cache_ann.element("max.size") or "50")
             policy = (cache_ann.element("cache.policy")
                       or cache_ann.element("policy") or "FIFO")
-            cache = TableCache(size, policy)
+            retention = cache_ann.element("retention.period")
+            if retention:
+                from siddhi_tpu.compiler.parser import parse_time_string
+
+                retention_ms = parse_time_string(retention)
+            else:
+                retention_ms = None
+            cache = TableCache(size, policy, retention_ms=retention_ms)
         return RecordTableRuntime(td, store, cache=cache, handler=handler)
 
     def build(self):

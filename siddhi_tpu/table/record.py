@@ -18,6 +18,7 @@ vectorized predicate, so a store may ignore the IR entirely and scan.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -341,10 +342,14 @@ class InMemoryRecordStore(AbstractRecordTable):
 
 
 class TableCache:
-    """Primary-key row cache with FIFO / LRU / LFU eviction
-    (reference: CacheTable.java + CacheTableFIFO/LRU/LFU)."""
+    """Primary-key row cache with FIFO / LRU / LFU eviction and
+    optional time-based retention (reference: CacheTable.java +
+    CacheTableFIFO/LRU/LFU with retention.period from @cache; unlike
+    the reference's CacheExpirer thread, expired entries are dropped
+    lazily on access and swept on insert)."""
 
-    def __init__(self, max_size: int, policy: str = "FIFO"):
+    def __init__(self, max_size: int, policy: str = "FIFO",
+                 retention_ms: Optional[int] = None, now_fn=None):
         policy = policy.upper()
         if policy not in ("FIFO", "LRU", "LFU"):
             raise SiddhiAppCreationError(f"unknown cache policy '{policy}'")
@@ -353,12 +358,22 @@ class TableCache:
                 f"@cache size must be >= 1, got {max_size}")
         self.max_size = max_size
         self.policy = policy
+        self.retention_ms = retention_ms
+        self._now = now_fn or (lambda: int(time.time() * 1000))
         self._d: "OrderedDict" = OrderedDict()
         self._freq: Dict = {}
+        self._added: Dict = {}  # key -> insert ms (retention)
         self.hits = 0
         self.misses = 0
 
+    def _expired(self, key) -> bool:
+        return (self.retention_ms is not None
+                and self._now() - self._added.get(key, 0)
+                >= self.retention_ms)
+
     def get(self, key):
+        if key in self._d and self._expired(key):
+            self.invalidate(key)
         if key not in self._d:
             self.misses += 1
             return None
@@ -370,6 +385,12 @@ class TableCache:
         return self._d[key]
 
     def put(self, key, row):
+        if self.retention_ms is not None:
+            now = self._now()
+            for k in [k for k, t in self._added.items()
+                      if now - t >= self.retention_ms]:
+                self.invalidate(k)
+            self._added[key] = now
         if key in self._d:
             self._d[key] = row
             if self.policy == "LRU":
@@ -387,15 +408,18 @@ class TableCache:
             self._d.pop(victim)
             self._freq.pop(victim, None)
         else:  # FIFO inserts at the back; LRU moves hits to the back
-            self._d.popitem(last=False)
+            victim, _ = self._d.popitem(last=False)
+        self._added.pop(victim, None)
 
     def invalidate(self, key):
         self._d.pop(key, None)
         self._freq.pop(key, None)
+        self._added.pop(key, None)
 
     def clear(self):
         self._d.clear()
         self._freq.clear()
+        self._added.clear()
 
     def __len__(self):
         return len(self._d)

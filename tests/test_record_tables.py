@@ -201,3 +201,63 @@ class TestTableCache:
         finally:
             InMemoryRecordStore._shared.pop("SharedLockT", None)
             InMemoryRecordStore._shared_locks.pop("SharedLockT", None)
+
+
+class TestCacheRetention:
+    """@cache(retention.period=...) — entries expire by wall time
+    (reference: table/cache/CacheExpireTestCase.java; expiry is lazy on
+    access + swept on insert)."""
+
+    def test_entries_expire(self):
+        from siddhi_tpu.table.record import TableCache
+
+        clock = [1000]
+        c = TableCache(10, "FIFO", retention_ms=500,
+                       now_fn=lambda: clock[0])
+        c.put("a", [1])
+        assert c.get("a") == [1]
+        clock[0] += 499
+        assert c.get("a") == [1]  # just inside retention
+        clock[0] += 1
+        assert c.get("a") is None  # expired at exactly retention
+        assert len(c) == 0
+
+    def test_put_sweeps_expired(self):
+        from siddhi_tpu.table.record import TableCache
+
+        clock = [0]
+        c = TableCache(10, "LRU", retention_ms=100,
+                       now_fn=lambda: clock[0])
+        c.put("a", [1])
+        c.put("b", [2])
+        clock[0] = 150
+        c.put("c", [3])  # sweep drops a and b
+        assert len(c) == 1 and c.get("c") == [3]
+
+    def test_product_cache_expiry_misses_fall_to_store(self, manager):
+        """Expired cache entries must re-fetch from the store (and the
+        row is still there — retention expires the CACHE, not the
+        table)."""
+        import time
+
+        app = ("@primaryKey('symbol') "
+               "@store(type='memory', @cache(size='10', "
+               "cache.policy='FIFO', retention.period='50 ms')) "
+               "define table T (symbol string, price double); "
+               "define stream S (symbol string, price double); "
+               "define stream C (symbol string); "
+               "from S insert into T; "
+               "from C join T on T.symbol == C.symbol "
+               "select T.symbol as s, T.price as p insert into Out;")
+        rt = manager.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        rt.get_input_handler("S").send(["IBM", 7.0])
+        rt.get_input_handler("C").send(["IBM"])
+        cache = rt.tables["T"].cache
+        assert len(cache) >= 1
+        time.sleep(0.08)  # past retention
+        rt.get_input_handler("C").send(["IBM"])  # cache miss -> store hit
+        rt.shutdown()
+        assert got == [["IBM", 7.0], ["IBM", 7.0]]
