@@ -1,0 +1,296 @@
+"""Associative-scan NFA: sequence parallelism for a single hot key.
+
+The dense engine (ops/dense_nfa.py) parallelizes over PARTITIONS; events
+of one partition are inherently sequential there (collision rounds), so
+a single hot key processes one event per jitted step.  This module is
+the long-context answer SURVEY §5 calls for: NFA transitions of a
+linear pattern chain compose ASSOCIATIVELY, so one key's event stream
+advances in O(log n) scan depth instead of n sequential steps —
+``jax.lax.associative_scan`` over per-event transition maps, the CEP
+analog of sequence parallelism.
+
+Design (max-plus affine algebra):
+- state vector ``v[j]`` = start timestamp of the YOUNGEST partial match
+  that has consumed pattern events ``1..j`` (−inf = none pending); lane
+  0 is the constant-0 lane that carries per-event timestamps into the
+  algebra (affine resets as one extra matrix column).
+- each event ``e`` becomes an (S x S) max-plus matrix ``M_e`` over
+  entries {0, −inf, ts_e}: advancing from node j−1 needs ``f_j(e)``;
+  an instance LEAVES its node when it advances (Siddhi pattern
+  semantics, StreamPostStateProcessor.java:64-83); an ``every`` head
+  arms a fresh start per matching event.
+- ``M_e`` compose under max-plus matmul — associative — so prefix
+  states come from one ``associative_scan``.
+- ``within`` prunes ONLY at emission: keeping the max (youngest) start
+  per node is exact, because any chain whose completion lies within W
+  of its start was within W at every intermediate event too (event
+  times are monotone), and any chain beyond W dies at the final check.
+
+Exactness contract: for an (optionally ``every``-headed) linear chain
+whose filters reference only the CURRENT event (no captures), the
+per-node youngest-start abstraction is exact — same-node instances are
+interchangeable — so the DETECTION output (which events complete a
+match, with the youngest qualifying start) equals the host engine's.
+The host/dense engines emit one match per pending chain and carry
+captures; this engine emits one detection per completing event.  Use it
+for the hot-key tail the partition axis cannot split.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.planner.expr import ExpressionCompiler, N_KEY, Scope, TS_KEY
+from siddhi_tpu.query_api import (
+    AttrType,
+    EveryStateElement,
+    NextStateElement,
+    SingleInputStream,
+    StateInputStream,
+    StreamStateElement,
+)
+
+NEG = -1e30  # −inf stand-in (float32-safe)
+
+
+def _chain_nodes(st: StateInputStream) -> Tuple[List, bool]:
+    """Flatten ``every a=S[...] -> b=S[...] -> ...`` into its
+    StreamStateElements; raises outside the linear-chain subset."""
+    nodes: List[StreamStateElement] = []
+    every_head = False
+
+    def walk(el, at_head):
+        nonlocal every_head
+        if isinstance(el, NextStateElement):
+            walk(el.element, at_head)
+            walk(el.next, False)
+            return
+        if isinstance(el, EveryStateElement):
+            if not at_head or nodes:
+                raise SiddhiAppCreationError(
+                    "scan NFA: only a leading 'every' is supported")
+            every_head = True
+            walk(el.element, False)
+            return
+        if isinstance(el, StreamStateElement):
+            nodes.append(el)
+            return
+        raise SiddhiAppCreationError(
+            f"scan NFA: unsupported state element {type(el).__name__} "
+            "(linear chains only — counts/logical/absent need the dense "
+            "or host engine)")
+
+    walk(st.state, True)
+    if len(nodes) < 2:
+        raise SiddhiAppCreationError("scan NFA: chain needs >= 2 nodes")
+    return nodes, every_head
+
+
+class ScanPatternEngine:
+    """One hot key's linear pattern chain as an associative scan.
+
+    Usage::
+
+        eng = compile_scan_pattern(app_str, "q")
+        state = eng.init_state()            # [S] start-ts vector
+        state, idx, starts = eng.process(state, cols, ts)
+        # idx: indices of events that completed a match (detections)
+    """
+
+    def __init__(self, st: StateInputStream, stream_def):
+        import jax
+        import jax.numpy as jnp
+
+        self.jax, self.jnp = jax, jnp
+        nodes, self.every_head = _chain_nodes(st)
+        if not self.every_head:
+            raise SiddhiAppCreationError(
+                "scan NFA: a non-'every' head arms exactly once, which "
+                "is history-dependent — use the dense/host engines")
+        self.within_ms = st.within_ms  # None = unbounded
+        self.n_nodes = len(nodes)
+        if self.n_nodes > 32:
+            raise SiddhiAppCreationError("scan NFA: > 32 chain nodes")
+
+        sid = nodes[0].stream.stream_id
+        for nd in nodes:
+            if nd.stream.stream_id != sid:
+                raise SiddhiAppCreationError(
+                    "scan NFA: one hot stream only (multi-stream chains "
+                    "need the dense engine)")
+        self.stream_id = sid
+        self.stream_def = stream_def
+
+        # filters see ONLY the current event (capture references would
+        # break same-node interchangeability — the exactness contract)
+        scope = Scope()
+        for a in stream_def.attributes:
+            scope.add(sid, a.name, a.name, a.type)
+        compiler = ExpressionCompiler(scope)
+        self.filters = []
+        for nd in nodes:
+            s = nd.stream
+            if not isinstance(s, SingleInputStream):
+                raise SiddhiAppCreationError("scan NFA: plain stream nodes")
+            exprs = [h.expression for h in s.handlers
+                     if type(h).__name__ == "Filter"]
+            if len(exprs) != len(s.handlers):
+                raise SiddhiAppCreationError(
+                    "scan NFA: only filters on chain nodes")
+            compiled = [compiler.compile(e) for e in exprs]
+            for c in compiled:
+                if c.type != AttrType.BOOL:
+                    raise SiddhiAppCreationError(
+                        "scan NFA: filters must be boolean")
+            self.filters.append(compiled)
+
+        self._lane_dtype: Dict[str, np.dtype] = {
+            a.name: (np.dtype(np.int32) if a.type == AttrType.INT
+                     else np.dtype(np.float32))
+            for a in stream_def.attributes
+            if (a.type.is_numeric or a.type == AttrType.BOOL)
+            and a.type != AttrType.LONG
+        }
+        self.base_ts: Optional[int] = None
+        self._trace_check()
+        self._scan_fn = None
+
+    # S = n_nodes: lanes 0..S-1 — lane 0 constant, lanes 1..S-1 the
+    # youngest start having consumed nodes 1..j.  The final node S
+    # completes at emission and never occupies a lane.
+
+    def _trace_check(self):
+        import jax
+
+        B = 8
+        env = {
+            a: jax.ShapeDtypeStruct((B,), dt)
+            for a, dt in self._lane_dtype.items()
+        }
+        env[TS_KEY] = jax.ShapeDtypeStruct((B,), np.float32)
+        env[N_KEY] = B
+        try:
+            for fs in self.filters:
+                for c in fs:
+                    jax.eval_shape(lambda e, c=c: c.fn(e), env)
+        except Exception as e:
+            raise SiddhiAppCreationError(
+                f"scan NFA: filter not device-traceable: {e}") from e
+
+    def init_state(self):
+        S = self.n_nodes
+        v = np.full(S, NEG, dtype=np.float32)
+        v[0] = 0.0  # constant lane
+        return self.jnp.asarray(v)
+
+    def _filter_matrix(self, env, n):
+        """[n, S] boolean: f_j holds for event i (f_0 unused)."""
+        jnp = self.jnp
+        cols = [jnp.ones(n, dtype=bool)]  # placeholder for index 0
+        for fs in self.filters:
+            m = jnp.ones(n, dtype=bool)
+            for c in fs:
+                m = m & jnp.broadcast_to(
+                    jnp.asarray(c.fn(env)).astype(bool), (n,))
+            cols.append(m)
+        return jnp.stack(cols, axis=1)  # [n, S+1]; col j = f_j
+
+    def make_scan(self):
+        """Jitted (state[S], cols{attr: [n]}, ts[n] f32-rel) ->
+        (state', match[n] bool, start[n] f32)."""
+        if self._scan_fn is not None:
+            return self._scan_fn
+        jax, jnp = self.jax, self.jnp
+        S = self.n_nodes
+        every = self.every_head
+        W = self.within_ms
+
+        def maxplus(a, b):
+            # compose: apply a (earlier) then b -> b ⊗ a, batched
+            return jnp.max(b[..., :, :, None] + a[..., None, :, :],
+                           axis=-2)
+
+        def scan(v0, cols, ts):
+            n = ts.shape[0]
+            env = dict(cols)
+            env[TS_KEY] = ts
+            env[N_KEY] = n
+            F = self._filter_matrix(env, n)  # [n, S+1]; col j = f_j
+            # per-event max-plus matrices M [n, S, S] over lanes
+            # 0..S-1 (lane 0 constant; lane j = consumed events 1..j)
+            M = jnp.full((n, S, S), NEG, dtype=jnp.float32)
+            M = M.at[:, 0, 0].set(0.0)  # constant lane persists
+            # arm a fresh chain per f_1 event ('every' head)
+            M = M.at[:, 1, 0].set(jnp.where(F[:, 1], ts, NEG))
+            for j in range(1, S):
+                # an instance at lane j advances out by consuming event
+                # j+1 (j+1 == S is completion) — it LEAVES either way
+                M = M.at[:, j, j].set(jnp.where(~F[:, j + 1], 0.0, NEG))
+                if j + 1 < S:
+                    M = M.at[:, j + 1, j].set(
+                        jnp.where(F[:, j + 1], 0.0, NEG))
+            # prefix products P_i = M_i ⊗ ... ⊗ M_1 in O(log n) depth
+            P = jax.lax.associative_scan(maxplus, M, axis=0)
+            after = jnp.max(P + v0[None, None, :], axis=-1)  # [n, S]
+            before = jnp.concatenate([v0[None, :], after[:-1]], axis=0)
+            # completion: event i matches f_S with a chain at lane S-1
+            start = before[:, S - 1]
+            match = F[:, S] & (start > NEG / 2)
+            if W is not None:
+                match = match & (start > ts - W)
+            return after[-1], match, start
+
+        self._scan_fn = jax.jit(scan)
+        return self._scan_fn
+
+    def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Host entry: (state, match_event_indices, match_starts_ms)."""
+        jnp = self.jnp
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(ts)
+        if n == 0:
+            return state, np.empty(0, np.int64), np.empty(0, np.int64)
+        if self.base_ts is None:
+            self.base_ts = int(ts[0]) - 1
+        rel = (ts - self.base_ts).astype(np.float32)
+        dev_cols = {}
+        for a, dt in self._lane_dtype.items():
+            if a in cols:
+                dev_cols[a] = jnp.asarray(
+                    np.asarray(cols[a])[:n].astype(dt, copy=False))
+        scan = self.make_scan()
+        state, match, start = scan(state, dev_cols, jnp.asarray(rel))
+        idx = np.flatnonzero(np.asarray(match))
+        starts = (np.asarray(start)[idx].astype(np.int64)
+                  + self.base_ts)
+        return state, idx, starts
+
+
+def compile_scan_pattern(app_str: str,
+                         query_name: Optional[str] = None
+                         ) -> ScanPatternEngine:
+    """Compile a SiddhiQL linear pattern into a ScanPatternEngine."""
+    from siddhi_tpu.compiler import SiddhiCompiler
+    from siddhi_tpu.query_api.annotation import find_annotation
+
+    app = SiddhiCompiler.parse(app_str)
+    query = None
+    for i, q in enumerate(app.queries):
+        info = find_annotation(q.annotations, "info")
+        nm = (info.element("name") if info else None) or f"query_{i}"
+        if query_name is None or nm == query_name:
+            query = q
+            break
+    if query is None:
+        raise SiddhiAppCreationError(f"query '{query_name}' not found")
+    st = query.input_stream
+    if not isinstance(st, StateInputStream):
+        raise SiddhiAppCreationError("compile_scan_pattern needs a pattern")
+    nodes, _ = _chain_nodes(st)
+    d = app.stream_definitions.get(nodes[0].stream.stream_id)
+    if d is None:
+        raise SiddhiAppCreationError("pattern stream is not defined")
+    return ScanPatternEngine(st, d)

@@ -362,7 +362,9 @@ class TableCache:
         self._now = now_fn or (lambda: int(time.time() * 1000))
         self._d: "OrderedDict" = OrderedDict()
         self._freq: Dict = {}
-        self._added: Dict = {}  # key -> insert ms (retention)
+        # key -> insert ms, kept oldest-first (puts stamp monotone
+        # times) so the retention sweep walks only the expired prefix
+        self._added: "OrderedDict" = OrderedDict()
         self.hits = 0
         self.misses = 0
 
@@ -387,10 +389,13 @@ class TableCache:
     def put(self, key, row):
         if self.retention_ms is not None:
             now = self._now()
-            for k in [k for k, t in self._added.items()
-                      if now - t >= self.retention_ms]:
+            while self._added:
+                k, t = next(iter(self._added.items()))
+                if now - t < self.retention_ms:
+                    break
                 self.invalidate(k)
             self._added[key] = now
+            self._added.move_to_end(key)  # refresh keeps oldest-first
         if key in self._d:
             self._d[key] = row
             if self.policy == "LRU":

@@ -1,0 +1,149 @@
+"""Associative-scan NFA (ops/nfa_scan.py) — the single-hot-key
+sequence-parallel engine — differentially against the host pattern
+engine: for capture-free linear chains the set of COMPLETING events
+(detections) must match exactly, including `within` pruning.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.ops.nfa_scan import compile_scan_pattern
+
+DEFS = "define stream S (v double, n int); "
+
+
+def host_detections(app, cols, ts):
+    """Timestamps of events where the host engine emitted >= 1 match."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        seen = []
+
+        def cb(cts, in_events, out_events):
+            if in_events:
+                seen.append(cts)
+
+        rt.add_callback("q", cb)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(len(ts)):
+            h.send([float(cols["v"][i]), int(cols["n"][i])],
+                   timestamp=int(ts[i]))
+        rt.shutdown()
+        return sorted(set(seen))
+    finally:
+        m.shutdown()
+
+
+def scan_detections(app, cols, ts, chunks=1):
+    eng = compile_scan_pattern(app, "q")
+    st = eng.init_state()
+    out = []
+    for part in np.array_split(np.arange(len(ts)), chunks):
+        if len(part) == 0:
+            continue
+        st, idx, _starts = eng.process(
+            st, {k: v[part] for k, v in cols.items()}, ts[part])
+        out.extend(int(ts[part[0] + i]) for i in idx)
+    return sorted(set(out))
+
+
+def mk(n=60, seed=0, t_step=300):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "v": rng.uniform(0, 50, n).round(1),
+        "n": rng.integers(0, 5, n),
+    }
+    ts = 1_000 + np.cumsum(rng.integers(1, t_step, n)).astype(np.int64)
+    return cols, ts
+
+
+class TestScanVsHost:
+    def test_three_node_chain(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > 20.0] -> c=S[v > 30.0] "
+               "select a.v as av insert into Out;")
+        cols, ts = mk()
+        assert scan_detections(app, cols, ts) == host_detections(
+            app, cols, ts)
+
+    def test_within_pruning(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > 20.0] -> c=S[v > 30.0] within 1 sec "
+               "select a.v as av insert into Out;")
+        cols, ts = mk(80, seed=1, t_step=700)  # many chains expire
+        host = host_detections(app, cols, ts)
+        assert scan_detections(app, cols, ts) == host
+        # the window must actually prune something for this to pin within
+        app_nw = app.replace(" within 1 sec", "")
+        assert host_detections(app_nw, cols, ts) != host
+
+    def test_two_node_chain(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 25.0] -> "
+               "b=S[v < 5.0] select a.v as av insert into Out;")
+        cols, ts = mk(50, seed=2)
+        assert scan_detections(app, cols, ts) == host_detections(
+            app, cols, ts)
+
+    def test_chunked_state_carry(self):
+        # chunk boundaries must be invisible (state carries across)
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > 20.0] -> c=S[v > 30.0] within 5 sec "
+               "select a.v as av insert into Out;")
+        cols, ts = mk(90, seed=3)
+        whole = scan_detections(app, cols, ts, chunks=1)
+        assert scan_detections(app, cols, ts, chunks=7) == whole
+        assert whole == host_detections(app, cols, ts)
+
+    def test_compound_filters(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0 and n != 2] "
+               "-> b=S[v > 20.0 or n == 4] -> c=S[v > 30.0] "
+               "select a.v as av insert into Out;")
+        cols, ts = mk(70, seed=4)
+        assert scan_detections(app, cols, ts) == host_detections(
+            app, cols, ts)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz(self, seed):
+        rng = np.random.default_rng(500 + seed)
+        s = int(rng.integers(2, 6))
+        thr = sorted(rng.uniform(5, 45, s).round(1))
+        chain = " -> ".join(
+            f"e{i}=S[v > {thr[i]}]" for i in range(s))
+        within = (f" within {int(rng.integers(1, 4))} sec"
+                  if rng.integers(2) else "")
+        app = (DEFS + f"@info(name='q') from every {chain}{within} "
+               "select e0.v as x insert into Out;")
+        cols, ts = mk(int(rng.integers(30, 100)), seed=900 + seed,
+                      t_step=int(rng.integers(100, 900)))
+        assert scan_detections(app, cols, ts, chunks=int(
+            rng.integers(1, 4))) == host_detections(app, cols, ts)
+
+
+class TestScanEligibility:
+    def test_capture_reference_rejected(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > a.v] select a.v as av insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_scan_pattern(app, "q")
+
+    def test_count_node_rejected(self):
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > 20.0]<2:3> select a.v as av insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_scan_pattern(app, "q")
+
+    def test_non_every_head_rejected(self):
+        app = (DEFS + "@info(name='q') from a=S[v > 10.0] -> "
+               "b=S[v > 20.0] select a.v as av insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_scan_pattern(app, "q")
+
+    def test_logical_rejected(self):
+        app = ("define stream A (v double); define stream B (v double); "
+               "@info(name='q') from every (a=A and b=B) "
+               "select a.v as av insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            compile_scan_pattern(app, "q")
