@@ -327,6 +327,22 @@ class AggregationRuntime:
             scope.add(ref, a.name, a.name, a.type)
         self.compiler = ExpressionCompiler(scope)
 
+        # input filters: `from S[cond] select ...` aggregates only
+        # passing rows (reference: AggregationParser wires the stream's
+        # filter chain ahead of the IncrementalExecutor;
+        # AggregationFilterTestCase.java:43) — the query chain's own
+        # FilterProcessor, so masking/type-check behavior stays shared
+        from siddhi_tpu.core.query import FilterProcessor
+
+        self.input_filters = []
+        for h in getattr(s, "handlers", []):
+            if type(h).__name__ != "Filter":
+                raise SiddhiAppCreationError(
+                    f"aggregation '{self.name}': only filters are "
+                    "supported on the input stream")
+            self.input_filters.append(
+                FilterProcessor(self.compiler.compile(h.expression)))
+
         # aggregate by <attr> (defaults to event arrival timestamp)
         self.ts_compiled: Optional[CompiledExpression] = None
         if definition.aggregate_by is not None:
@@ -496,6 +512,10 @@ class AggregationRuntime:
 
     def on_event(self, batch: EventBatch, now: int):
         batch = batch.only(ev.CURRENT)
+        for fp in self.input_filters:
+            if len(batch) == 0:
+                break
+            batch = fp.process(batch, now)
         if len(batch) == 0:
             self._advance(now)
             return

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from siddhi_tpu.core.exceptions import SiddhiAppCreationError
-from siddhi_tpu.planner.expr import ExpressionCompiler, N_KEY, Scope, TS_KEY
+from siddhi_tpu.planner.expr import ExpressionCompiler, N_KEY, Scope
 from siddhi_tpu.query_api import (
     AttrType,
     EveryStateElement,
@@ -166,11 +166,13 @@ class ScanPatternEngine:
         import jax
 
         B = 8
+        # NO timestamp key: a filter reading eventTimestamp() would see
+        # base-rebased relative float32 time here, silently diverging
+        # from the host engine — its KeyError rejects it instead
         env = {
             a: jax.ShapeDtypeStruct((B,), dt)
             for a, dt in self._lane_dtype.items()
         }
-        env[TS_KEY] = jax.ShapeDtypeStruct((B,), np.float32)
         env[N_KEY] = B
         try:
             for fs in self.filters:
@@ -178,7 +180,9 @@ class ScanPatternEngine:
                     jax.eval_shape(lambda e, c=c: c.fn(e), env)
         except Exception as e:
             raise SiddhiAppCreationError(
-                f"scan NFA: filter not device-traceable: {e}") from e
+                f"scan NFA: filter not device-evaluable (timestamp "
+                f"functions / host-only ops need the dense or host "
+                f"engine): {e}") from e
 
     def init_state(self):
         S = self.n_nodes
@@ -215,8 +219,7 @@ class ScanPatternEngine:
 
         def scan(v0, cols, ts):
             n = ts.shape[0]
-            env = dict(cols)
-            env[TS_KEY] = ts
+            env = dict(cols)  # no TS_KEY: _trace_check rejected ts use
             env[N_KEY] = n
             F = self._filter_matrix(env, n)  # [n, S+1]; col j = f_j
             # per-event max-plus matrices M [n, S, S] over lanes
@@ -247,14 +250,31 @@ class ScanPatternEngine:
         return self._scan_fn
 
     def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray):
-        """Host entry: (state, match_event_indices, match_starts_ms)."""
+        """Host entry: (state, match_event_indices, match_starts_ms).
+
+        The base is REBASED every batch so relative times stay small:
+        float32's 24-bit mantissa is millisecond-exact only below ~4.7h
+        of relative time, and carried starts shift with the base.  With
+        ``within W``, carried starts stay < W + batch span old, so
+        exactness holds while W + span < ~4.7h; without ``within``,
+        detection stays exact (only liveness is read) and reported
+        start times degrade to ~span/2^24 relative rounding."""
         jnp = self.jnp
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         if n == 0:
             return state, np.empty(0, np.int64), np.empty(0, np.int64)
+        new_base = int(ts[0]) - 1
         if self.base_ts is None:
-            self.base_ts = int(ts[0]) - 1
+            self.base_ts = new_base
+        elif new_base > self.base_ts:
+            delta = np.float32(new_base - self.base_ts)
+            s = np.asarray(state)
+            live = s > NEG / 2
+            live[0] = False  # constant lane stays 0
+            s = np.where(live, s - delta, s).astype(np.float32)
+            state = jnp.asarray(s)
+            self.base_ts = new_base
         rel = (ts - self.base_ts).astype(np.float32)
         dev_cols = {}
         for a, dt in self._lane_dtype.items():

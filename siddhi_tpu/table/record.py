@@ -362,9 +362,10 @@ class TableCache:
         self._now = now_fn or (lambda: int(time.time() * 1000))
         self._d: "OrderedDict" = OrderedDict()
         self._freq: Dict = {}
-        # key -> insert ms, kept oldest-first (puts stamp monotone
-        # times) so the retention sweep walks only the expired prefix
+        # key -> insert ms, kept oldest-first (stamps are clamped
+        # monotone) so the retention sweep walks only the expired prefix
         self._added: "OrderedDict" = OrderedDict()
+        self._last_stamp = 0
         self.hits = 0
         self.misses = 0
 
@@ -388,7 +389,10 @@ class TableCache:
 
     def put(self, key, row):
         if self.retention_ms is not None:
-            now = self._now()
+            # clamp against backwards clock steps so stamps stay
+            # monotone and the oldest-first prefix sweep stays sound
+            now = max(self._now(), self._last_stamp)
+            self._last_stamp = now
             while self._added:
                 k, t = next(iter(self._added.items()))
                 if now - t < self.retention_ms:

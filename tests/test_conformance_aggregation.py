@@ -244,3 +244,78 @@ class TestAggregatorBreadthAcrossDurations:
         finally:
             rt.shutdown()
             m.shutdown()
+
+
+class TestLatestAndFilteredAggregations:
+    """reference: LatestAggregationTestCase.java (non-aggregate select
+    items carry the LATEST value per bucket/group) and
+    AggregationFilterTestCase.java (filters on the aggregation input)."""
+
+    def _run(self, app, sends, query):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("@app:playback " + app)
+            rt.start()
+            h = rt.get_input_handler("stockStream")
+            for row, ts in sends:
+                h.send(row, timestamp=ts)
+            out = rt.query(query)
+            rt.shutdown()
+            return [list(e.data) for e in out]
+        finally:
+            m.shutdown()
+
+    BASE_TS = 1_496_289_950_000  # the reference suites' epoch anchor
+
+    def test_latest_value_per_bucket(self):
+        """reference: LatestAggregationTestCase:65 — `(price*quantity)
+        as latestPrice` keeps the LAST value seen in each bucket."""
+        app = ("define stream stockStream (symbol string, price double, "
+               "quantity int, timestamp long); "
+               "define aggregation A from stockStream "
+               "select symbol, avg(price) as ap, "
+               "(price * quantity) as latest "
+               "group by symbol aggregate by timestamp every sec...min;")
+        t = self.BASE_TS
+        rows = self._run(app, [
+            (["IBM", 10.0, 2, t], t),
+            (["IBM", 20.0, 3, t + 100], t + 100),   # same second
+            (["IBM", 30.0, 4, t + 2000], t + 2000),  # next bucket
+        ], "from A within %d, %d per 'seconds' select symbol, ap, latest;"
+           % (t - 1000, t + 10_000))
+        by_latest = sorted(r[2] for r in rows)
+        # bucket 1 latest = 20*3 = 60; bucket 2 latest = 30*4 = 120
+        assert by_latest == [60.0, 120.0], rows
+
+    def test_filtered_aggregation_input(self):
+        """reference: AggregationFilterTestCase:43 — only rows passing
+        the input filter aggregate."""
+        app = ("define stream stockStream (symbol string, price double, "
+               "quantity int, timestamp long); "
+               "define aggregation A from stockStream[price > 15.0] "
+               "select symbol, sum(price) as t "
+               "group by symbol aggregate by timestamp every sec...min;")
+        t = self.BASE_TS
+        rows = self._run(app, [
+            (["IBM", 10.0, 1, t], t),          # filtered out
+            (["IBM", 20.0, 1, t + 100], t + 100),
+            (["IBM", 30.0, 1, t + 200], t + 200),
+        ], "from A within %d, %d per 'seconds' select symbol, t;"
+           % (t - 1000, t + 10_000))
+        assert rows == [["IBM", 50.0]], rows
+
+    def test_distinct_count_aggregation(self):
+        """reference: DistinctCountAggregationTestCase."""
+        app = ("define stream stockStream (symbol string, price double, "
+               "quantity int, timestamp long); "
+               "define aggregation A from stockStream "
+               "select symbol, distinctCount(price) as d "
+               "group by symbol aggregate by timestamp every sec...min;")
+        t = self.BASE_TS
+        rows = self._run(app, [
+            (["IBM", 10.0, 1, t], t),
+            (["IBM", 10.0, 1, t + 50], t + 50),
+            (["IBM", 20.0, 1, t + 100], t + 100),
+        ], "from A within %d, %d per 'seconds' select symbol, d;"
+           % (t - 1000, t + 10_000))
+        assert rows == [["IBM", 2]], rows

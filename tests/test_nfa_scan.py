@@ -147,3 +147,31 @@ class TestScanEligibility:
                "select a.v as av insert into Out;")
         with pytest.raises(SiddhiAppCreationError):
             compile_scan_pattern(app, "q")
+
+
+class TestScanRebase:
+    def test_long_stream_time_rebases_exactly(self):
+        """Batches spanning days of stream time: per-batch rebasing
+        keeps within math millisecond-exact where a fixed float32 base
+        would round (2^24 ms ~ 4.7 h)."""
+        app = (DEFS + "@info(name='q') from every a=S[v > 10.0] -> "
+               "b=S[v > 30.0] within 1 sec "
+               "select a.v as av insert into Out;")
+        eng_det = []
+        eng = compile_scan_pattern(app, "q")
+        st = eng.init_state()
+        day = 86_400_000
+        for batch_i in range(4):  # 4 batches, one per day
+            t0 = 1_600_000_000_000 + batch_i * day
+            ts = np.array([t0 + 1, t0 + 500, t0 + 2_000, t0 + 2_300],
+                          dtype=np.int64)
+            cols = {"v": np.array([20.0, 40.0, 20.0, 40.0]),
+                    "n": np.zeros(4, np.int32)}
+            st, idx, starts = eng.process(st, cols, ts)
+            eng_det.extend(int(ts[i]) for i in idx)
+            # within 1 sec: (t0+1 -> t0+500) matches; the t0+2000 arm
+            # completes at t0+2300 — both inside the window
+            assert list(idx) == [1, 3], (batch_i, idx)
+            # starts exact to the millisecond despite days of offset
+            assert list(starts) == [t0 + 1, t0 + 2_000], (batch_i, starts)
+        assert len(eng_det) == 8
