@@ -192,16 +192,26 @@ class StreamJunction:
                 except Exception as e:  # noqa: BLE001
                     self._handle_error(batch, e)
 
+    def route_fault(self, batch: EventBatch, e: Exception) -> bool:
+        """Send ``batch`` + the error into this stream's ``!stream``
+        fault junction (the @OnError(action='STREAM') contract); False
+        when no STREAM fault route is configured.  Shared by the
+        processing chain (_handle_error) and sink publish failures
+        (Sink.on_error)."""
+        if self.on_error != OnErrorAction.STREAM or self.fault_junction is None:
+            return False
+        fd = self.fault_junction.definition
+        err = np.empty(len(batch), dtype=object)
+        err[:] = e
+        cols = dict(batch.columns)
+        cols["_error"] = err
+        self.fault_junction.send(
+            EventBatch(fd.id, fd.attribute_names, cols, batch.timestamps, batch.types)
+        )
+        return True
+
     def _handle_error(self, batch: EventBatch, e: Exception):
-        if self.on_error == OnErrorAction.STREAM and self.fault_junction is not None:
-            fd = self.fault_junction.definition
-            err = np.empty(len(batch), dtype=object)
-            err[:] = e
-            cols = dict(batch.columns)
-            cols["_error"] = err
-            self.fault_junction.send(
-                EventBatch(fd.id, fd.attribute_names, cols, batch.timestamps, batch.types)
-            )
+        if self.route_fault(batch, e):
             return
         log.error(
             "error processing events on stream '%s' in app '%s': %s",

@@ -279,6 +279,9 @@ class AppPlanner:
                     sink.handler = khm.generate(self.name, definition.id)
                     self.handler_registrations.append((khm, sink.handler.element_id))
                 sink.init(definition, opts, mapper, self.app_context)
+                # publish failures follow the stream's @OnError contract
+                # (reference: Sink.onError:354 routing into '!stream')
+                sink.stream_junction = junction
                 junction.subscribe(SinkStreamCallback(sink))
                 self.sinks.append(sink)
 

@@ -15,7 +15,14 @@ import logging
 import re
 from typing import Dict, List, Optional
 
-from siddhi_tpu.core.event import Event, EventBatch, events_from_batch
+import numpy as np
+
+from siddhi_tpu.core.event import (
+    Event,
+    EventBatch,
+    batch_from_events,
+    events_from_batch,
+)
 from siddhi_tpu.core.exceptions import (
     ConnectionUnavailableError,
     SiddhiAppRuntimeError,
@@ -59,8 +66,6 @@ class JsonSinkMapper(SinkMapper):
         names = self.definition.attribute_names
 
         def clean(v):
-            import numpy as np
-
             if isinstance(v, np.generic):
                 return v.item()
             return v
@@ -82,6 +87,9 @@ class Sink(ConnectRetryMixin):
         self.mapper = mapper
         self.app_context = app_context
         self.connected = False
+        # wired by the planner: the stream's junction, consulted for
+        # the @OnError publish-failure contract
+        self.stream_junction = None
         # per-THREAD dynamic-option context: sync junctions deliver on
         # the caller's thread, so two senders may traverse one sink
         # concurrently — instance state would cross their topics
@@ -171,9 +179,15 @@ class Sink(ConnectRetryMixin):
             self._connect_with_retry()
 
     def on_error(self, payload, e: Exception):
-        """Publish-failure hook: default logs and drops (reference
-        Sink.onError:354; the junction's @OnError handling covers
-        processing-chain failures)."""
+        """Publish-failure hook (reference Sink.onError:354): when the
+        sink's stream declares @OnError(action='STREAM'), the failing
+        EVENT routes into its '!stream' fault junction with the error
+        attached; otherwise log and drop."""
+        j = self.stream_junction
+        ev_ = getattr(self._tls, "event", None)
+        if j is not None and ev_ is not None and j.fault_junction is not None:
+            if j.route_fault(batch_from_events(self.definition, [ev_]), e):
+                return
         log.error(
             "sink %s on stream '%s' failed to publish: %s",
             type(self).__name__, self.definition.id, e,
@@ -338,6 +352,8 @@ class DistributedSink(Sink):
 
     def start(self):
         for c in self.children:
+            # children follow the same stream-level @OnError contract
+            c.stream_junction = self.stream_junction
             c.start()
 
     def shutdown(self):
@@ -364,8 +380,13 @@ class DistributedSink(Sink):
             dests = self.strategy.destinations_for(event)
             if not dests:
                 # every destination down: the drop must stay diagnosable
-                self.on_error(payload, ConnectionUnavailableError(
-                    "no active destinations"))
+                # (and fault-routable — keep the event context)
+                self._tls.event = event
+                try:
+                    self.on_error(payload, ConnectionUnavailableError(
+                        "no active destinations"))
+                finally:
+                    self._tls.event = None
                 continue
             for d in dests:
                 child = self.children[d]

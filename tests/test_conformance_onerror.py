@@ -117,3 +117,71 @@ class TestOnErrorLog:
             assert ok2 == [[1.0], [2.0]]
         finally:
             m.shutdown()
+
+
+class TestSinkPublishFaults:
+    """Publish failures follow the sink stream's @OnError contract
+    (reference: Sink.onError:354 + FaultStreamTestCase.java:604-943,
+    the sink-failure variants)."""
+
+    def _failing_sink(self, manager):
+        from siddhi_tpu.core.exceptions import ConnectionUnavailableError
+        from siddhi_tpu.transport.sink import Sink
+
+        class FailSink(Sink):
+            def publish(self, payload):
+                raise ConnectionUnavailableError("transport down")
+
+        manager.set_extension("alwaysFail", FailSink, kind="sink")
+
+    def test_stream_action_routes_failed_event(self):
+        m = SiddhiManager()
+        try:
+            self._failing_sink(m)
+            rt = m.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@OnError(action='STREAM') "
+                "@sink(type='alwaysFail', topic='t', "
+                "retry.scale='100000', @map(type='passThrough')) "
+                "define stream Out (v long); "
+                "from S select v insert into Out; "
+                "from !Out select v, _error insert into FaultOut;")
+            got = []
+            rt.add_callback("FaultOut", lambda evs: got.extend(
+                e.data for e in evs))
+            rt.start()
+            rt.get_input_handler("S").send([7])
+            rt.get_input_handler("S").send([8])
+            rt.shutdown()
+            assert [g[0] for g in got] == [7, 8]
+            assert "transport down" in str(got[0][1])
+        finally:
+            m.shutdown()
+
+    def test_log_action_drops_and_keeps_flowing(self, caplog):
+        import logging
+
+        m = SiddhiManager()
+        try:
+            self._failing_sink(m)
+            rt = m.create_siddhi_app_runtime(
+                "define stream S (v long); "
+                "@sink(type='alwaysFail', topic='t', "
+                "retry.scale='100000', @map(type='passThrough')) "
+                "define stream Out (v long); "
+                "from S select v insert into Out;")
+            got = []
+            rt.add_callback("Out", lambda evs: got.extend(
+                e.data for e in evs))
+            rt.start()
+            with caplog.at_level(logging.ERROR):
+                rt.get_input_handler("S").send([1])
+                rt.get_input_handler("S").send([2])
+            rt.shutdown()
+            # in-process callbacks still see the events; only the
+            # transport drop is logged
+            assert got == [[1], [2]]
+            assert any("failed to publish" in r.getMessage()
+                       for r in caplog.records)
+        finally:
+            m.shutdown()
