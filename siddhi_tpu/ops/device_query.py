@@ -515,7 +515,17 @@ class DeviceQueryEngine:
         self._wgrp_ids: Dict = {}
         self._wgrp_vals: List = []
         self._wgrp_free: List[int] = []
-        self._wgrp_last: Dict[int, int] = {}
+        # per-id last-use times as ARRAYS (vectorized batch touch +
+        # purge scan; a dict write per unique key was ~half the cost of
+        # a warm partitioned batch)
+        self._wgrp_last = np.zeros(self.n_wgroups, dtype=np.int64)
+        self._wgrp_in_use = np.zeros(self.n_wgroups, dtype=bool)
+        # sorted key index for the vectorized intern fast path (the
+        # dense runtime's technique, core/dense_pattern.py:317); falls
+        # back to dict probes on mixed/object key dtypes
+        self._wgrp_sorted_keys: Optional[np.ndarray] = None
+        self._wgrp_sorted_ids: Optional[np.ndarray] = None
+        self._wgrp_vector = True
         self.base_ts: Optional[int] = None
         self._pane_end: Optional[int] = None  # timeBatch
         self._pane_fill = 0  # passing events in the open pane
@@ -1347,21 +1357,93 @@ class DeviceQueryEngine:
             f"device query: group cardinality exceeded "
             f"n_groups={self.n_groups}", now)
 
+    _WGRP_CAP_MSG = (
+        "device query: partition-key cardinality exceeded "
+        "{cap} (raise @app:execution partitions or enable @purge)")
+
     def _intern_wgroups(self, pk: np.ndarray, now: int) -> np.ndarray:
-        """Partition-key values -> dense window-group ids."""
-        uniq, inv = np.unique(np.asarray(pk), return_inverse=True)
-        out_u = np.empty(len(uniq), dtype=np.int32)
-        for i, k in enumerate(uniq.tolist()):
-            out_u[i] = self._alloc_wgrp(k, now)
+        """Partition-key values -> dense window-group ids.
+
+        Vectorized: one np.unique per batch; EXISTING keys resolve with
+        one searchsorted against a sorted key index; only never-seen
+        keys take the python allocation path; last-use stamps update as
+        one array scatter.  Object/mixed key dtypes degrade permanently
+        to exact per-unique dict probes (same contract as
+        core/dense_pattern.py:317 intern_keys)."""
+        arr = np.asarray(pk)
+        if self._wgrp_vector:
+            sk = self._wgrp_sorted_keys
+            if arr.dtype.kind in ("O", "V"):
+                self._wgrp_vector = False
+            elif sk is not None and len(sk) and arr.dtype != sk.dtype:
+                if np.can_cast(arr.dtype, sk.dtype, "safe"):
+                    arr = arr.astype(sk.dtype)
+                elif np.can_cast(sk.dtype, arr.dtype, "safe"):
+                    self._wgrp_sorted_keys = sk.astype(arr.dtype)
+                else:
+                    self._wgrp_vector = False
+        if not self._wgrp_vector:
+            uniq, inv = np.unique(arr, return_inverse=True)
+            out_u = np.empty(len(uniq), dtype=np.int32)
+            for i, k in enumerate(uniq.tolist()):
+                out_u[i] = self._alloc_wgrp(k, now)
+            return out_u[inv].astype(np.int32, copy=False)
+
+        uniq, inv = np.unique(arr, return_inverse=True)
+        nu = len(uniq)
+        out_u = np.empty(nu, dtype=np.int32)
+        sk = self._wgrp_sorted_keys
+        if sk is not None and len(sk):
+            pos = np.searchsorted(sk, uniq)
+            pos_c = np.minimum(pos, len(sk) - 1)
+            found = sk[pos_c] == uniq
+            out_u[found] = self._wgrp_sorted_ids[pos_c[found]]
+            new_idx = np.flatnonzero(~found)
+        else:
+            new_idx = np.arange(nu)
+        if len(new_idx):
+            n_new = len(new_idx)
+            take_free = min(len(self._wgrp_free), n_new)
+            fresh = n_new - take_free
+            if len(self._wgrp_vals) + fresh > self.n_wgroups:
+                raise SiddhiAppRuntimeError(
+                    self._WGRP_CAP_MSG.format(cap=self.n_wgroups))
+            ids = np.empty(n_new, dtype=np.int32)
+            if take_free:
+                ids[:take_free] = self._wgrp_free[-take_free:][::-1]
+                del self._wgrp_free[-take_free:]
+            if fresh:
+                base = len(self._wgrp_vals)
+                ids[take_free:] = np.arange(base, base + fresh,
+                                            dtype=np.int32)
+                self._wgrp_vals.extend(uniq[new_idx][take_free:].tolist())
+            new_keys = uniq[new_idx]
+            for k, wid in zip(new_keys.tolist(), ids.tolist()):
+                self._wgrp_ids[k] = wid
+                self._wgrp_vals[wid] = k
+            out_u[new_idx] = ids
+            # merge the (sorted) new keys into the sorted index
+            if sk is None or not len(sk):
+                self._wgrp_sorted_keys = new_keys.copy()
+                self._wgrp_sorted_ids = ids.copy()
+            else:
+                ins = np.searchsorted(sk, new_keys)
+                self._wgrp_sorted_keys = np.insert(sk, ins, new_keys)
+                self._wgrp_sorted_ids = np.insert(
+                    self._wgrp_sorted_ids, ins, ids)
+        self._wgrp_last[out_u] = now
+        self._wgrp_in_use[out_u] = True
         return out_u[inv].astype(np.int32, copy=False)
 
     def _alloc_wgrp(self, k, now: int) -> int:
-        return self._alloc_id(
+        # the shared allocator writes last[wid] = now, which indexes the
+        # ndarray the same way it indexed the old dict
+        wid = self._alloc_id(
             k, self._wgrp_ids, self._wgrp_vals, self._wgrp_free,
             self._wgrp_last, self.n_wgroups,
-            f"device query: partition-key cardinality exceeded "
-            f"{self.n_wgroups} (raise @app:execution partitions or "
-            "enable @purge)", now)
+            self._WGRP_CAP_MSG.format(cap=self.n_wgroups), now)
+        self._wgrp_in_use[wid] = True
+        return wid
 
     def purge_idle_keys(self, state, now: int, idle_ms: Optional[int],
                         remap=None):
@@ -1373,8 +1455,9 @@ class DeviceQueryEngine:
         by default).  Returns ``(state, n_purged_keys)``."""
         if not self.partition_mode or idle_ms is None:
             return state, 0
-        dead_w = [w for w, t in self._wgrp_last.items()
-                  if now - t >= idle_ms]
+        dead_w = np.flatnonzero(
+            self._wgrp_in_use & (now - self._wgrp_last >= idle_ms)
+        ).tolist()
         if not dead_w:
             return state, 0
         jnp = self.jnp
@@ -1410,7 +1493,12 @@ class DeviceQueryEngine:
             del self._wgrp_ids[self._wgrp_vals[w]]
             self._wgrp_vals[w] = None
             self._wgrp_free.append(w)
-            del self._wgrp_last[w]
+        self._wgrp_in_use[dead_w] = False
+        if self._wgrp_sorted_keys is not None and len(self._wgrp_sorted_keys):
+            keep = ~np.isin(self._wgrp_sorted_ids,
+                            np.asarray(dead_w, dtype=np.int32))
+            self._wgrp_sorted_keys = self._wgrp_sorted_keys[keep]
+            self._wgrp_sorted_ids = self._wgrp_sorted_ids[keep]
         if self.group_exprs:
             for gid in dead_g:
                 del self._group_ids[self._group_vals[gid]]
@@ -1750,7 +1838,8 @@ class DeviceQueryEngine:
             "wgrp_ids": dict(self._wgrp_ids),
             "wgrp_vals": list(self._wgrp_vals),
             "wgrp_free": list(self._wgrp_free),
-            "wgrp_last": dict(self._wgrp_last),
+            "wgrp_last": self._wgrp_last.copy(),
+            "wgrp_in_use": self._wgrp_in_use.copy(),
             "pane_end": self._pane_end,
             "pane_fill": self._pane_fill,
             "prev_pane_fill": self._prev_pane_fill,
@@ -1765,7 +1854,42 @@ class DeviceQueryEngine:
         self._wgrp_ids = dict(s.get("wgrp_ids", {}))
         self._wgrp_vals = list(s.get("wgrp_vals", []))
         self._wgrp_free = list(s.get("wgrp_free", []))
-        self._wgrp_last = dict(s.get("wgrp_last", {}))
+        last = s.get("wgrp_last")
+        self._wgrp_last = np.zeros(self.n_wgroups, dtype=np.int64)
+        self._wgrp_in_use = np.zeros(self.n_wgroups, dtype=bool)
+        if isinstance(last, dict):
+            # legacy dict-format snapshot: convert so restored keys
+            # stay visible to the idle purge
+            for wid, t in last.items():
+                self._wgrp_last[wid] = t
+                self._wgrp_in_use[wid] = True
+        elif last is not None:
+            self._wgrp_last = np.asarray(last, dtype=np.int64).copy()
+            in_use = s.get("wgrp_in_use")
+            if in_use is not None:
+                self._wgrp_in_use = np.asarray(in_use, dtype=bool).copy()
+        # rebuild the sorted intern index from the restored key map.
+        # np.asarray over MIXED python key types silently stringifies
+        # (int 7 and '7' would alias in searchsorted), so mixed-type
+        # key sets pin the exact dict fallback instead.
+        self._wgrp_sorted_keys = None
+        self._wgrp_sorted_ids = None
+        self._wgrp_vector = True
+        if self._wgrp_ids:
+            if len({type(k) for k in self._wgrp_ids}) > 1:
+                self._wgrp_vector = False
+            else:
+                try:
+                    keys = np.asarray(list(self._wgrp_ids.keys()))
+                    if keys.dtype.kind in ("O", "V"):
+                        raise TypeError("object keys")
+                    order = np.argsort(keys)
+                    self._wgrp_sorted_keys = keys[order]
+                    self._wgrp_sorted_ids = np.asarray(
+                        list(self._wgrp_ids.values()),
+                        dtype=np.int32)[order]
+                except Exception:
+                    self._wgrp_vector = False
         self._pane_end = s["pane_end"]
         self._pane_fill = s["pane_fill"]
         self._prev_pane_fill = s["prev_pane_fill"]
