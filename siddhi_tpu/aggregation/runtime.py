@@ -327,6 +327,14 @@ class AggregationRuntime:
             scope.add(ref, a.name, a.name, a.type)
         self.compiler = ExpressionCompiler(scope)
 
+        # tpu mode: float base fields reduce on the device (bucketed
+        # scatter-adds, SURVEY §7 step 5); the host store stays the
+        # single source of truth (flushed per batch, so the snapshot,
+        # rollup and on-demand surfaces are untouched)
+        self._device_segments = (
+            app_planner.app_context.execution_mode == "tpu")
+        self._device_fn = None
+
         # input filters: `from S[cond] select ...` aggregates only
         # passing rows (reference: AggregationParser wires the stream's
         # filter chain ahead of the IncrementalExecutor;
@@ -529,12 +537,14 @@ class AggregationRuntime:
         finest = self.durations[0]
         buckets = bucket_starts(ts, finest)
 
-        # group keys (host tuples; numeric keys stay scalar)
-        if self.group_by:
-            gcols = [np.broadcast_to(np.asarray(g(env)), (n,)) for g in self.group_by]
-            keys = [tuple(c[i] for c in gcols) for i in range(n)]
-        else:
-            keys = [()] * n
+        # group keys (gcols columns; tuples built only per unique
+        # segment below — not per row)
+        gcols = ([np.broadcast_to(np.asarray(g(env)), (n,))
+                  for g in self.group_by] if self.group_by else [])
+
+        def key_at(i: int) -> Tuple:
+            return tuple(c[i] for c in gcols)
+
         # base-field per-event values
         fvals: Dict[str, np.ndarray] = {}
         for f in self.base_fields:
@@ -543,44 +553,189 @@ class AggregationRuntime:
             else:
                 fvals[f.name] = np.broadcast_to(np.asarray(f.arg(env)), (n,))
 
-        # segment by (bucket, key) via sort over a combined id
-        combo = {}
-        order: List[Tuple[int, Tuple]] = []
-        ids = np.empty(n, dtype=np.int64)
-        for i in range(n):
-            k = (int(buckets[i]), keys[i])
-            j = combo.get(k)
-            if j is None:
-                j = combo[k] = len(order)
-                order.append(k)
-            ids[i] = j
+        # segment by (bucket, key): one combined-code np.unique replaces
+        # the former O(n * unique-segments) per-segment masking loop
+        # (SURVEY §7 step 5 — bucketed scatter-adds; float fields ride a
+        # jitted device scatter under @app:execution('tpu')).  Falls
+        # back to the exact per-row probe on unorderable key values
+        # (nulls in object columns) or radix overflow.
+        try:
+            key_ids = np.zeros(n, dtype=np.int64)
+            radix = 1
+            for c in gcols:
+                u, inv = np.unique(c, return_inverse=True)
+                radix *= len(u) + 1
+                if radix > 2**31:
+                    raise OverflowError("group-key radix")
+                key_ids = key_ids * (len(u) + 1) + inv
+            _bu, binv = np.unique(buckets, return_inverse=True)
+            if (len(_bu) + 1) * radix > 2**62:
+                raise OverflowError("bucket x key radix")
+            codes = (binv.astype(np.int64) * (int(key_ids.max()) + 1)
+                     + key_ids)
+            _uc, uidx, ids = np.unique(codes, return_index=True,
+                                       return_inverse=True)
+        except (TypeError, OverflowError):
+            combo: Dict = {}
+            uidx_l: List[int] = []
+            ids = np.empty(n, dtype=np.int64)
+            for i in range(n):
+                k = (int(buckets[i]), key_at(i))
+                j = combo.get(k)
+                if j is None:
+                    j = combo[k] = len(uidx_l)
+                    uidx_l.append(i)
+                ids[i] = j
+            uidx = np.asarray(uidx_l, dtype=np.int64)
+        U = len(uidx)
+        seg_vals, seg_last = self._reduce_segments(ids, U, fvals, ts, n)
         store = self.stores[finest]
-        for k, j in combo.items():
-            m = ids == j
-            seg_ts = ts[m]
-            last_i = int(np.argmax(seg_ts))
-            values: Dict[str, object] = {}
-            for f in self.base_fields:
-                seg = fvals[f.name][m]
-                if f.op in ("sum", "count"):
-                    values[f.name] = seg.sum().item() if seg.dtype != object else sum(seg)
-                elif f.op == "min":
-                    values[f.name] = seg.min().item() if seg.dtype != object else min(seg)
-                elif f.op == "max":
-                    values[f.name] = seg.max().item() if seg.dtype != object else max(seg)
-                elif f.op == "set":
-                    values[f.name] = set(seg.tolist())
-                else:  # last
-                    values[f.name] = seg[last_i] if seg.dtype == object else seg[last_i].item()
+        wm_bucket = int(bucket_starts(
+            np.asarray([self.watermark]), finest)[0])
+        for u in range(U):
+            i0 = int(uidx[u])
+            k = (int(buckets[i0]), key_at(i0))
+            values = {f.name: seg_vals[f.name][u] for f in self.base_fields}
+            last_ts = int(seg_last[u])
             # out-of-order below the watermark: merge straight into the
             # finished store (the reference's OutOfOrderEventsDataAggregator)
-            if k[0] < bucket_starts(np.asarray([self.watermark]), finest)[0]:
-                self._merge_out_of_order(k, values, int(seg_ts.max()))
+            if k[0] < wm_bucket:
+                self._merge_out_of_order(k, values, last_ts)
             else:
-                store.merge_into(store.running, k, values, int(seg_ts.max()), self.field_ops)
+                store.merge_into(store.running, k, values, last_ts,
+                                 self.field_ops)
         self.watermark = max(self.watermark, int(ts.max()))
         self._advance(now)
         self._purge(now)
+
+    def _reduce_segments(self, ids: np.ndarray, U: int,
+                         fvals: Dict[str, np.ndarray], ts: np.ndarray,
+                         n: int):
+        """Per-segment field reductions: {name: [U] python-typed
+        values}, seg_last_ts [U].  Numeric sum/count/min/max fields
+        reduce with np scatter ufuncs (or one jitted device scatter in
+        tpu mode); 'last'/'set'/object fields walk sorted segment
+        slices."""
+        seg_vals: Dict[str, List] = {}
+        # min-init (not zero): pre-epoch/negative timestamps must win
+        seg_last = np.full(U, np.iinfo(np.int64).min, dtype=np.int64)
+        np.maximum.at(seg_last, ids, ts)
+
+        scatter_fields = []
+        slice_fields = []
+        for f in self.base_fields:
+            v = fvals[f.name]
+            if (f.op in ("sum", "count", "min", "max")
+                    and v.dtype.kind in "iuf"):
+                scatter_fields.append(f)
+            else:
+                slice_fields.append(f)
+
+        # float fields may ride the jitted device scatter in tpu mode
+        # (float32 lanes = the device precision policy); int fields stay
+        # on exact numpy scatter ufuncs at native width
+        dev = [f for f in scatter_fields
+               if self._device_segments and n >= 512
+               and fvals[f.name].dtype.kind == "f"]
+        for f, col in zip(dev, self._device_reduce(ids, U, fvals, dev)):
+            seg_vals[f.name] = [x.item() for x in col]
+        for f in scatter_fields:
+            if f.name in seg_vals:
+                continue
+            v = fvals[f.name]
+            if f.op in ("sum", "count"):
+                # integer sums widen to int64 (np.sum's promotion rule;
+                # an int32 accumulator would silently wrap)
+                acc_dt = np.int64 if v.dtype.kind in "iu" else v.dtype
+                acc = np.zeros(U, dtype=acc_dt)
+                np.add.at(acc, ids, v)
+            elif f.op == "min":
+                acc = np.full(U, np.inf if v.dtype.kind == "f"
+                              else np.iinfo(v.dtype).max, dtype=v.dtype)
+                np.minimum.at(acc, ids, v)
+            else:
+                acc = np.full(U, -np.inf if v.dtype.kind == "f"
+                              else np.iinfo(v.dtype).min, dtype=v.dtype)
+                np.maximum.at(acc, ids, v)
+            seg_vals[f.name] = [x.item() for x in acc]
+
+        if slice_fields:
+            # sorted segment slices; within a segment the stable sort
+            # keeps arrival order, so 'last' tie-breaks like the
+            # cross-batch merge (later arrival wins at equal ts)
+            order = np.argsort(ids, kind="stable")
+            bounds = np.searchsorted(ids[order], np.arange(U + 1))
+            ts_sorted = ts[order]
+            for f in slice_fields:
+                v = fvals[f.name][order]
+                vals: List = []
+                for u in range(U):
+                    seg = v[bounds[u]:bounds[u + 1]]
+                    if f.op == "set":
+                        vals.append(set(seg.tolist()))
+                    elif f.op in ("sum", "count"):
+                        vals.append(sum(seg))
+                    elif f.op == "min":
+                        vals.append(min(seg))
+                    elif f.op == "max":
+                        vals.append(max(seg))
+                    else:  # last: latest ts, later arrival wins ties
+                        sts = ts_sorted[bounds[u]:bounds[u + 1]]
+                        li = len(sts) - 1 - int(np.argmax(sts[::-1]))
+                        x = seg[li]
+                        vals.append(x.item() if hasattr(x, "item")
+                                    and not isinstance(x, (str, bytes))
+                                    else x)
+                seg_vals[f.name] = vals
+        return seg_vals, seg_last
+
+    def _device_reduce(self, ids: np.ndarray, U: int,
+                       fvals: Dict[str, np.ndarray], fields) -> List:
+        """One jitted scatter over the float fields: [n] values +
+        segment ids -> [U] per-op reductions on float32 device lanes
+        (int fields keep native width on the numpy path — see
+        _reduce_segments gating)."""
+        if not fields:
+            return []
+        import jax
+        import jax.numpy as jnp
+
+        if self._device_fn is None:
+            def reduce_fn(ids_d, vals, ops, U_static):
+                outs = []
+                for op, v in zip(ops, vals):
+                    if op in ("sum", "count"):
+                        outs.append(jnp.zeros(U_static, v.dtype)
+                                    .at[ids_d].add(v))
+                    elif op == "min":
+                        outs.append(jnp.full(U_static, jnp.inf, v.dtype)
+                                    .at[ids_d].min(v))
+                    else:
+                        outs.append(jnp.full(U_static, -jnp.inf, v.dtype)
+                                    .at[ids_d].max(v))
+                return outs
+
+            self._device_fn = jax.jit(reduce_fn, static_argnums=(2, 3))
+        # pow-2 padding on BOTH axes bounds jit shape variety (streaming
+        # n and U vary per batch); padded rows scatter identities into
+        # the padded dump segment
+        n = len(ids)
+        n_pad = max(1 << (n - 1).bit_length(), 512)
+        U_pad = max(1 << U.bit_length(), 16)  # U real segments + dump
+        ids_p = np.full(n_pad, U_pad - 1, dtype=np.int32)
+        ids_p[:n] = ids
+        vals = []
+        for f in fields:
+            col = np.zeros(n_pad, dtype=np.float32)
+            col[:n] = fvals[f.name].astype(np.float32)
+            if f.op == "min":
+                col[n:] = np.inf
+            elif f.op == "max":
+                col[n:] = -np.inf
+            vals.append(jnp.asarray(col))
+        ops = tuple(f.op for f in fields)
+        out = self._device_fn(jnp.asarray(ids_p), tuple(vals), ops, U_pad)
+        return [np.asarray(o)[:U] for o in out]
 
     def _merge_out_of_order(self, key: Tuple[int, Tuple], values: Dict, last_ts: int):
         """Late event: fold into the finished bucket of every duration.

@@ -7,6 +7,7 @@ on-demand `within ... per ...` stitching, and joins against
 aggregations.
 """
 
+import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager
@@ -319,3 +320,124 @@ class TestLatestAndFilteredAggregations:
         ], "from A within %d, %d per 'seconds' select symbol, d;"
            % (t - 1000, t + 10_000))
         assert rows == [["IBM", 2]], rows
+
+
+class TestVectorizedIngest:
+    """The segmented ingest reductions (np scatter ufuncs + the tpu-mode
+    device scatter) must match the per-segment reference semantics on
+    large mixed batches."""
+
+    def _run(self, mode, n=2048, seed=7):
+        from siddhi_tpu.core.event import EventBatch
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback " + mode +
+                "define stream S (sym string, price double, vol long, "
+                "timestamp long); "
+                "define aggregation A from S select sym, sum(price) as sp, "
+                "min(price) as mn, max(price) as mx, count() as c, "
+                "sum(vol) as sv group by sym "
+                "aggregate by timestamp every sec...min;")
+            rt.start()
+            rng = np.random.default_rng(seed)
+            t0 = 1_496_289_950_000
+            ts = t0 + rng.integers(0, 5_000, n)
+            order = np.argsort(ts, kind="stable")  # in-order arrival
+            ts = ts[order].astype(np.int64)
+            syms = np.asarray(
+                [f"s{int(i)}" for i in rng.integers(0, 40, n)],
+                dtype=object)[order]
+            price = rng.uniform(1, 100, n)[order]
+            vol = rng.integers(1, 10**10, n)[order].astype(np.int64)
+            rt.get_input_handler("S").send_batch(EventBatch(
+                "S", ["sym", "price", "vol", "timestamp"],
+                {"sym": syms, "price": price, "vol": vol,
+                 "timestamp": ts.copy()}, ts))
+            out = rt.query(
+                f"from A within {t0 - 1000}, {t0 + 100_000} per 'seconds' "
+                "select sym, sp, mn, mx, c, sv;")
+            rt.shutdown()
+            return sorted([list(e.data) for e in out],
+                          key=lambda r: r[0])
+        finally:
+            m.shutdown()
+
+    def test_host_vectorized_matches_semantics(self):
+        rows = self._run("")
+        assert rows and all(r[2] <= r[3] for r in rows)  # min <= max
+        # int sums exact at > 2^32 magnitudes (native-width scatter)
+        assert all(isinstance(r[5], int) and r[5] > 2**32 for r in rows)
+
+    def test_tpu_device_scatter_matches_host(self):
+        host = self._run("")
+        dev = self._run("@app:execution('tpu') ")
+        assert len(host) == len(dev)
+        for a, b in zip(host, dev):
+            assert a[0] == b[0] and a[4] == b[4] and a[5] == b[5]
+            for i in (1, 2, 3):  # float32 device lanes: rel tolerance
+                assert b[i] == pytest.approx(a[i], rel=1e-4), (a, b)
+
+
+class TestIngestFallbacks:
+    def test_null_group_key_falls_back_exactly(self):
+        """Nulls in an object group-by column are unorderable for
+        np.unique; ingest must fall back to the exact per-row probe."""
+        from siddhi_tpu.core.event import EventBatch
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "define stream S (sym string, price double, "
+                "timestamp long); "
+                "define aggregation A from S select sym, sum(price) as sp "
+                "group by sym aggregate by timestamp every sec...min;")
+            rt.start()
+            t0 = 1_496_289_950_000
+            syms = np.empty(4, dtype=object)
+            syms[:] = ["a", None, "a", None]
+            ts = np.full(4, t0, dtype=np.int64)
+            rt.get_input_handler("S").send_batch(EventBatch(
+                "S", ["sym", "price", "timestamp"],
+                {"sym": syms, "price": np.array([1.0, 2.0, 3.0, 4.0]),
+                 "timestamp": ts.copy()}, ts))
+            out = rt.query(
+                f"from A within {t0 - 1000}, {t0 + 10_000} per 'seconds' "
+                "select sym, sp;")
+            rt.shutdown()
+            rows = sorted([list(e.data) for e in out],
+                          key=lambda r: repr(r[0]))
+            assert rows == [["a", 4.0], [None, 6.0]], rows
+        finally:
+            m.shutdown()
+
+    def test_int_sum_does_not_wrap(self):
+        """int32 attribute sums exceed 2^31 within one bucket: the
+        scatter accumulator must widen like np.sum does."""
+        from siddhi_tpu.core.event import EventBatch
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "define stream S (k string, v int, timestamp long); "
+                "define aggregation A from S select k, sum(v) as sv "
+                "group by k aggregate by timestamp every sec...min;")
+            rt.start()
+            t0 = 1_496_289_950_000
+            n = 3
+            ts = np.full(n, t0, dtype=np.int64)
+            rt.get_input_handler("S").send_batch(EventBatch(
+                "S", ["k", "v", "timestamp"],
+                {"k": np.asarray(["x"] * n, dtype=object),
+                 "v": np.full(n, 2**30, dtype=np.int32),
+                 "timestamp": ts.copy()}, ts))
+            out = rt.query(
+                f"from A within {t0 - 1000}, {t0 + 10_000} per 'seconds' "
+                "select k, sv;")
+            rt.shutdown()
+            assert [list(e.data) for e in out] == [["x", 3 * 2**30]]
+        finally:
+            m.shutdown()
