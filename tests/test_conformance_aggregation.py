@@ -370,6 +370,30 @@ class TestVectorizedIngest:
         # int sums exact at > 2^32 magnitudes (native-width scatter)
         assert all(isinstance(r[5], int) and r[5] > 2**32 for r in rows)
 
+    def test_fast_path_equals_exact_fallback(self, monkeypatch):
+        """The combined-code segmentation must agree VALUE-FOR-VALUE
+        with the exact per-row fallback (the semantic reference)."""
+        import siddhi_tpu.aggregation.runtime as agg_rt
+
+        fast = self._run("")
+        real_unique = np.unique
+
+        def poisoned(*a, **kw):
+            raise TypeError("force the exact per-row segmentation")
+
+        # poison only the segmentation uniques inside on_event; the
+        # fallback path itself uses no np.unique
+        monkeypatch.setattr(agg_rt.np, "unique", poisoned)
+        try:
+            exact = self._run("")
+        finally:
+            monkeypatch.setattr(agg_rt.np, "unique", real_unique)
+        assert len(fast) == len(exact)
+        for a, b in zip(fast, exact):
+            assert a[0] == b[0] and a[4] == b[4] and a[5] == b[5], (a, b)
+            for i in (1, 2, 3):
+                assert b[i] == pytest.approx(a[i], rel=1e-12), (a, b)
+
     def test_tpu_device_scatter_matches_host(self):
         host = self._run("")
         dev = self._run("@app:execution('tpu') ")

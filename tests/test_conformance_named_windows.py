@@ -1,0 +1,247 @@
+"""Named-window conformance, ported from the reference `window/`
+suites (CustomJoinWindowTestCase.java, SessionWindowTestCase.java,
+ExternalTimeBatchWindowTestCase.java, DelayWindowTestCase.java,
+LengthBatchWindowTestCase.java): shared `define window` instances
+joined with tables/streams, session/externalTimeBatch/delay named
+forms, and multi-reader fan-in.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run(manager, app, sends, out="OutputStream"):
+    rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(list(e.data) for e in evs))
+    rt.start()
+    for sid, row, ts in sends:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    rt.shutdown()
+    return got
+
+
+class TestJoinWindowWithTable:
+    def test_named_window_joins_table(self, manager):
+        """reference: CustomJoinWindowTestCase.testJoinWindowWithTable:55"""
+        app = (
+            "define stream StockStream (symbol string, price float, "
+            "volume long); "
+            "define stream CheckStockStream (symbol string); "
+            "define window CheckStockWindow(symbol string) length(1) "
+            "output all events; "
+            "define table StockTable (symbol string, price float, "
+            "volume long); "
+            "from StockStream insert into StockTable; "
+            "from CheckStockStream insert into CheckStockWindow; "
+            "@info(name='q2') from CheckStockWindow join StockTable "
+            "on CheckStockWindow.symbol == StockTable.symbol "
+            "select CheckStockWindow.symbol as checkSymbol, "
+            "StockTable.symbol as symbol, StockTable.volume as volume "
+            "insert into OutputStream;")
+        got = run(manager, app, [
+            ("StockStream", ["WSO2", 55.6, 100], 1000),
+            ("StockStream", ["IBM", 75.6, 10], 1001),
+            ("CheckStockStream", ["WSO2"], 1002),
+        ])
+        assert got == [["WSO2", "WSO2", 100]]
+
+    def test_two_queries_share_one_window(self, manager):
+        """reference: CustomJoinWindowTestCase — multiple readers of
+        one shared window instance see the SAME buffer."""
+        app = (
+            "define stream S (symbol string, v double); "
+            "define window W (symbol string, v double) length(2); "
+            "from S insert into W; "
+            "@info(name='qa') from W select symbol, sum(v) as t "
+            "insert into OutA; "
+            "@info(name='qb') from W select symbol, count() as c "
+            "insert into OutB;")
+        rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+        a, b = [], []
+        rt.add_callback("OutA", lambda evs: a.extend(list(e.data) for e in evs))
+        rt.add_callback("OutB", lambda evs: b.extend(list(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["x", 1.0], timestamp=1000)
+        h.send(["x", 2.0], timestamp=1001)
+        h.send(["x", 3.0], timestamp=1002)  # expires the 1.0 row
+        rt.shutdown()
+        assert [r[1] for r in a] == [1.0, 3.0, 5.0]
+        assert [r[1] for r in b] == [1, 2, 2]
+
+
+class TestSessionNamedWindow:
+    def test_session_gap_closes(self, manager):
+        """reference: SessionWindowTestCase — events within the session
+        gap aggregate; a gap closes the session (emitting expired)."""
+        app = (
+            "define stream S (user string, v double); "
+            "@info(name='q') from S#window.session(100 ms, user) "
+            "select user, sum(v) as total insert all events into Out;")
+        rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+        cur, exp = [], []
+
+        def cb(ts, ins, outs):
+            cur.extend(list(e.data) for e in (ins or []))
+            exp.extend(list(e.data) for e in (outs or []))
+
+        rt.add_callback("q", cb)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["u", 1.0], timestamp=1000)
+        h.send(["u", 2.0], timestamp=1050)   # same session
+        h.send(["u", 5.0], timestamp=1500)   # gap: prior session closed
+        rt.shutdown()
+        assert [r[1] for r in cur] == [1.0, 3.0, 5.0]
+        assert exp, "closed session must emit expired rows"
+
+
+class TestExternalTimeBatchNamed:
+    def test_external_time_batch_flushes_on_event_time_column(self, manager):
+        """reference: ExternalTimeBatchWindowTestCase — panes keyed off
+        an ATTRIBUTE timestamp, not arrival time."""
+        app = (
+            "define stream S (ts long, v double); "
+            "@info(name='q') from S#window.externalTimeBatch(ts, 1 sec) "
+            "select sum(v) as total insert into Out;")
+        got = run(manager, app, [
+            ("S", [1_000, 1.0], 50_000),   # arrival time irrelevant
+            ("S", [1_500, 2.0], 50_001),
+            ("S", [2_100, 4.0], 50_002),   # crosses the 1s pane -> flush
+        ], out="Out")
+        assert got == [[3.0]]
+
+
+class TestDelayNamed:
+    def test_delay_window_holds_events(self, manager):
+        """reference: DelayWindowTestCase — events surface only after
+        the delay elapses (event time under playback)."""
+        app = (
+            "define stream S (v double); "
+            "@info(name='q') from S#window.delay(1 sec) "
+            "select v insert into Out;")
+        got = run(manager, app, [
+            ("S", [1.0], 1000),
+            ("S", [2.0], 1100),
+            ("S", [0.0], 2200),  # watermark passes 1000+1s and 1100+1s
+        ], out="Out")
+        assert [g[0] for g in got][:2] == [1.0, 2.0]
+
+
+class TestNamedWindowOutputToTable:
+    def test_window_feeds_table(self, manager):
+        """Window-expired rows can drive table mutations downstream."""
+        app = (
+            "define stream S (symbol string, v long); "
+            "define window W (symbol string, v long) lengthBatch(2); "
+            "define table T (symbol string, v long); "
+            "from S insert into W; "
+            "from W insert into T;")
+        rt = manager.create_siddhi_app_runtime("@app:playback " + app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["a", 1], timestamp=1000)
+        h.send(["b", 2], timestamp=1001)  # pane flush -> T
+        h.send(["c", 3], timestamp=1002)
+        batch = rt.tables["T"].rows_batch()
+        rt.shutdown()
+        syms = sorted(np.asarray(batch.columns["symbol"]).tolist())
+        assert syms == ["a", "b"]
+
+
+class TestJunctionTopologies:
+    """reference: stream/JunctionTestCase.java — fan-in/fan-out and
+    multi-hop chains through stream junctions, plus concurrent
+    producers."""
+
+    def test_fan_out_fan_in(self, manager):
+        app = (
+            "define stream S (v long); "
+            "@info(name='q1') from S[v > 0] select v insert into Mid1; "
+            "@info(name='q2') from S[v > 0] select v insert into Mid2; "
+            "@info(name='q3') from Mid1 select v insert into Sink; "
+            "@info(name='q4') from Mid2 select v insert into Sink;")
+        got = run(manager, app, [("S", [1], 1000), ("S", [2], 1001)],
+                  out="Sink")
+        assert sorted(g[0] for g in got) == [1, 1, 2, 2]
+
+    def test_three_hop_chain(self, manager):
+        app = (
+            "define stream S (v long); "
+            "from S select v + 1 as v insert into A; "
+            "from A select v * 10 as v insert into B; "
+            "from B select v - 5 as v insert into C;")
+        got = run(manager, app, [("S", [1], 1000)], out="C")
+        assert got == [[15]]  # ((1+1)*10)-5
+
+    def test_multithreaded_producers(self, manager):
+        """reference: multiThreadedTest1 — concurrent senders through
+        one junction; every event is delivered exactly once."""
+        import threading
+
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); "
+            "from S select v insert into Out;")
+        got = []
+        lock = threading.Lock()
+
+        def cb(evs):
+            with lock:
+                got.extend(e.data[0] for e in evs)
+
+        rt.add_callback("Out", cb)
+        rt.start()
+        h = rt.get_input_handler("S")
+
+        def pump(base):
+            for i in range(200):
+                h.send([base + i])
+
+        threads = [threading.Thread(target=pump, args=(k * 1000,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rt.shutdown()
+        assert sorted(got) == sorted(
+            k * 1000 + i for k in range(4) for i in range(200))
+
+
+class TestCallbackContracts:
+    """reference: stream/CallbackTestCase.java — stream vs query
+    callbacks and their error surfaces."""
+
+    def test_stream_and_query_callbacks_both_fire(self, manager):
+        rt = manager.create_siddhi_app_runtime(
+            "@app:playback define stream S (v long); "
+            "@info(name='q') from S[v > 1] select v insert into Out;")
+        stream_got, query_got = [], []
+        rt.add_callback("Out", lambda evs: stream_got.extend(
+            e.data for e in evs))
+        rt.add_callback("q", lambda ts, ins, outs: query_got.extend(
+            e.data for e in (ins or [])))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([1], timestamp=1000)
+        h.send([2], timestamp=1001)
+        rt.shutdown()
+        assert stream_got == [[2]] and query_got == [[2]]
+
+    def test_unknown_callback_target_rejected(self, manager):
+        from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
+
+        rt = manager.create_siddhi_app_runtime(
+            "define stream S (v long); from S select v insert into Out;")
+        with pytest.raises(SiddhiAppRuntimeError):
+            rt.add_callback("nope", lambda evs: None)
