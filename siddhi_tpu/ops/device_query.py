@@ -288,6 +288,7 @@ class DeviceQueryEngine:
         window_capacity: int = 1024,
         partition_mode: bool = False,
         n_wgroups: Optional[int] = None,
+        defer_order_by: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -459,9 +460,19 @@ class DeviceQueryEngine:
                 _subst_aliases(sel.having, alias_map)))
             if sel.having is not None else None
         )
-        if sel.order_by or sel.limit is not None or sel.offset is not None:
+        # order by / limit / offset are never evaluated by this engine.
+        # The PLANNER path applies them in its host-side passthrough
+        # selector over each emitted chunk (defer_order_by=True, same
+        # pipeline position as the host engine's per-chunk
+        # _order_limit); direct-API callers have no such selector, so
+        # silently dropping the clauses would corrupt results
+        if not defer_order_by and (
+                sel.order_by or sel.limit is not None
+                or sel.offset is not None):
             raise SiddhiAppCreationError(
-                "device query path does not support order by/limit yet")
+                "device query engine: order by/limit/offset need the "
+                "planner's host-side selector (SiddhiManager path) — "
+                "the direct compile_query API does not apply them")
         if self.mode == PER_FLUSH:
             for kind, _v, name in self.out_spec:
                 if kind == "passthrough":

@@ -184,6 +184,26 @@ class QueryPlanner:
     def __init__(self, app_planner):
         self.app = app_planner  # AppPlanner
 
+    def _passthrough_selector(self, sel: Selector, out_names: List[str],
+                              out_target: str) -> QuerySelector:
+        """Column-passthrough selector applying only the query's
+        order by / limit / offset over each chunk — the host tail of a
+        device-lowered query (dense or device-single)."""
+        order_by = []
+        for ob in sel.order_by:
+            if ob.variable.attribute not in out_names:
+                raise SiddhiAppCreationError(
+                    f"order by attribute '{ob.variable.attribute}' not "
+                    "in select output")
+            order_by.append((ob.variable.attribute, ob.ascending))
+        const_compiler = ExpressionCompiler(Scope())
+        limit = self._const_int(sel.limit, const_compiler, "limit")
+        offset = self._const_int(sel.offset, const_compiler, "offset")
+        return QuerySelector(
+            out_target, None, out_names, [], [], None, order_by, limit,
+            offset,
+        )
+
     def _get_mesh(self, nd: int):
         """One app-wide device mesh, built on first use (shared by the
         dense pattern axis and the device-query group axis)."""
@@ -550,19 +570,7 @@ class QueryPlanner:
             out_attrs = [
                 Attribute(nm, t) for nm, t in zip(out_names, output_attr_types(engine))
             ]
-            order_by = []
-            for ob in sel.order_by:
-                if ob.variable.attribute not in out_names:
-                    raise SiddhiAppCreationError(
-                        f"order by attribute '{ob.variable.attribute}' not in select output"
-                    )
-                order_by.append((ob.variable.attribute, ob.ascending))
-            const_compiler = ExpressionCompiler(Scope())
-            limit = self._const_int(sel.limit, const_compiler, "limit")
-            offset = self._const_int(sel.offset, const_compiler, "offset")
-            selector = QuerySelector(
-                out_target, None, out_names, [], [], None, order_by, limit, offset,
-            )
+            selector = self._passthrough_selector(sel, out_names, out_target)
             out_def = StreamDefinition(id=out_target, attributes=out_attrs)
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)
@@ -712,6 +720,15 @@ class QueryPlanner:
             raise SiddhiAppCreationError(
                 "partitioned queries with output rate limits need "
                 "per-key limiters — host instances used")
+        if partition_mode and (
+                query.selector.order_by
+                or query.selector.limit is not None
+                or query.selector.offset is not None):
+            # per-key instances slice order-by/limit PER KEY; a shared
+            # chunk mixes keys and would slice across them
+            raise SiddhiAppCreationError(
+                "partitioned queries with order by/limit need per-key "
+                "chunks — host instances used")
         definition = self.app.resolve_stream_definition(s)
         engine = DeviceQueryEngine(
             query, definition,
@@ -719,6 +736,7 @@ class QueryPlanner:
             partition_mode=partition_mode,
             n_wgroups=(self.app.app_context.tpu_partitions
                        if partition_mode else None),
+            defer_order_by=True,  # applied by the selector built below
         )
         # @app:execution('tpu', devices='N'): shard the group axis of
         # running-kind queries over an N-device mesh (same treatment as
@@ -739,9 +757,10 @@ class QueryPlanner:
             Attribute(nm, t)
             for nm, t in zip(engine.output_names, engine.out_types)
         ]
-        selector = QuerySelector(
-            out_target, None, engine.output_names, [], [], None, [], None, None,
-        )
+        # order by / limit / offset run host-side over each emitted
+        # chunk (the host engine's per-chunk _order_limit position)
+        selector = self._passthrough_selector(
+            query.selector, engine.output_names, out_target)
         out_def = StreamDefinition(id=out_target, attributes=out_attrs)
         output = self._plan_output(query, out_def)
         rate_limiter = self._plan_rate_limiter(query)

@@ -313,3 +313,20 @@ class TestAdvisorRegressions:
         assert eng.base_ts > base0  # re-anchored
         assert [r["s"] for r in rows1] == [1.0]
         assert [r["s"] for r in rows2] == [2.0]  # old event left the window
+
+
+def test_direct_api_rejects_order_by():
+    """compile_query has no host-side selector downstream, so order
+    by/limit must RAISE there (silently dropping them would corrupt
+    results); the SiddhiManager path applies them host-side instead
+    (tests/test_device_wide_aggs.py TestOrderByLimitOnDevicePath)."""
+    import pytest
+
+    from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+    from siddhi_tpu.ops.device_query import compile_query
+
+    with pytest.raises(SiddhiAppCreationError):
+        compile_query(
+            "define stream S (k int, v double); "
+            "@info(name='q') from S select k, sum(v) as s group by k "
+            "order by s desc limit 1 insert into O;", "q")

@@ -233,8 +233,13 @@ class TestFallbacks:
         self.fallback(DEFINE + "from S#window.length(3) select k, v "
                                "insert expired events into OutputStream;")
 
-    def test_order_by_falls_back(self):
-        self.fallback(DEFINE + "from S select k, v order by v "
+    def test_snapshot_rate_falls_back(self):
+        # round 5: order by/limit now ride the host-side passthrough
+        # selector (tests/test_device_wide_aggs.py
+        # TestOrderByLimitOnDevicePath); snapshot rates still need the
+        # host selector
+        self.fallback(DEFINE + "from S select k, v "
+                               "output snapshot every 1 sec "
                                "insert into OutputStream;")
 
     def test_fallback_still_correct(self):

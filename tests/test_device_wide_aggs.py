@@ -237,3 +237,21 @@ class TestFloat32DriftContract:
             if i < 2:
                 continue  # n<2: stddev ~ 0, relative error meaningless
             assert d[0] == pytest.approx(h[0], rel=2e-3), f"row {i}"
+
+
+class TestOrderByLimitOnDevicePath:
+    """Round 5: order by / limit / offset ride the host passthrough
+    selector over device-emitted chunks — per-chunk semantics identical
+    to the host engine's _order_limit position."""
+
+    def test_order_by_lowers_and_matches(self):
+        differential(
+            DEFS + "@info(name='q') from S#window.lengthBatch(4) select "
+            "k, sum(v) as s group by k order by s desc "
+            "insert into O;", mk_sends(32))
+
+    def test_limit_offset(self):
+        differential(
+            DEFS + "@info(name='q') from S#window.lengthBatch(6) select "
+            "k, count() as c group by k order by c desc, k asc limit 2 "
+            "insert into O;", mk_sends(36))
