@@ -97,6 +97,19 @@ class DeviceQueryRuntime:
             self.out_stream_id, self.engine.output_names, out_cols,
             out_ts, np.full(len(out_ts), ev.CURRENT, dtype=np.int8),
         )
+        keys = getattr(self.engine, "last_group_keys", None)
+        if keys is not None:
+            if len(keys) != len(mb):
+                # a misaligned side channel is a wiring bug: degrading
+                # to one global group would be silently wrong per-group
+                # output (the host limiter's loud-failure contract,
+                # core/query.py GroupBy*RateLimiter)
+                raise SiddhiAppRuntimeError(
+                    f"device query emitted {len(mb)} rows but "
+                    f"{len(keys)} group keys")
+            # group-key side channel: per-group/snapshot rate limiters
+            # read it exactly like the host selector's
+            mb.aux["group_keys"] = list(keys)
         self.emit_cb(mb)
 
     # -- scheduler task (timeBatch pane flushes) -----------------------------

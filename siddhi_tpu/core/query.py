@@ -40,6 +40,22 @@ def build_env(batch: EventBatch, key_map: Optional[Dict[str, str]] = None) -> Di
     return env
 
 
+def format_group_keys(key_cols: List[np.ndarray], rows) -> List:
+    """Host group-key IDENTITY format, shared by the selector and the
+    device engines (key equality drives per-group state and rate-limit
+    dedup): scalar for one key column, tuple otherwise, numpy scalars
+    unboxed."""
+    if len(key_cols) == 1:
+        c = key_cols[0]
+        return [c[i].item() if isinstance(c[i], np.generic) else c[i]
+                for i in rows]
+    return [
+        tuple(c[i].item() if isinstance(c[i], np.generic) else c[i]
+              for c in key_cols)
+        for i in rows
+    ]
+
+
 class Processor:
     def process(self, batch: EventBatch, now: int) -> EventBatch:
         raise NotImplementedError
@@ -176,16 +192,7 @@ class QuerySelector:
             base = [None] * n
         else:
             key_cols = [np.broadcast_to(np.asarray(k.fn(env)), (n,)) for k in self.group_keys]
-            if len(key_cols) == 1:
-                col = key_cols[0]
-                base = [col[i].item() if isinstance(col[i], np.generic) else col[i] for i in range(n)]
-            else:
-                base = [
-                    tuple(
-                        c[i].item() if isinstance(c[i], np.generic) else c[i] for c in key_cols
-                    )
-                    for i in range(n)
-                ]
+            base = format_group_keys(key_cols, range(n))
         if pkeys is None:
             return base
         return [(pk, k) for pk, k in zip(pkeys, base)]
@@ -295,6 +302,13 @@ class QuerySelector:
                     "partition-axis selector received rows without the "
                     "partition-key side channel")
         keys = self._group_ids(env, n, pkeys)
+        if not self.group_keys and not self.aggregations:
+            # passthrough selector over a device-lowered query: adopt
+            # the upstream group-key side channel so per-group/snapshot
+            # rate limiters downstream still see it
+            incoming = run.aux.get("group_keys")
+            if incoming is not None and len(incoming) == n:
+                keys = list(incoming)
         env.update(self._agg_outputs(env, n, keys, is_remove=(rtype == ev.EXPIRED)))
         if self.items is None:
             out_cols = {nm: run.columns[nm] for nm in self.output_attribute_names}

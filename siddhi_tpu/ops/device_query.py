@@ -1609,10 +1609,32 @@ class DeviceQueryEngine:
             for oi, (_k, _v, name) in enumerate(self.out_spec)
         }
 
+    # group-key side channel of the MOST RECENT process_batch call
+    # (host-format scalars/tuples, aligned with its output rows) — the
+    # product runtime attaches it as batch.aux['group_keys'] so
+    # per-group rate limiters work on device-lowered queries.  None
+    # when the query has no group-by (or in partition mode, whose rate
+    # limiters are rejected at plan time).
+    last_group_keys: Optional[List] = None
+
+    def _keys_for_gids(self, gids) -> List:
+        return [self._group_vals[int(g)] for g in gids]
+
+    def _host_group_keys(self, host_env, n: int, sel) -> List:
+        """Host-evaluated group keys at rows ``sel`` (the filter kind
+        interns nothing), in the shared host key-identity format."""
+        from siddhi_tpu.core.query import format_group_keys
+
+        key_cols = [np.broadcast_to(np.asarray(g.fn(host_env)), (n,))
+                    for g in self.group_exprs]
+        return format_group_keys(key_cols, sel)
+
     def _concat_chunks(self, chunks) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
-        """chunks: [(cols, ts_scalar, n_rows)] -> (cols, ts)."""
+        """chunks: [(cols, ts_scalar, n_rows, keys|None)] -> (cols, ts);
+        also sets ``last_group_keys`` from the chunk key lists."""
         chunks = [c for c in chunks if c[2]]
         if not chunks:
+            self.last_group_keys = [] if self.group_exprs else None
             return self._empty_cols(), np.empty(0, dtype=np.int64)
         names = self.output_names
         out_cols = {
@@ -1620,6 +1642,10 @@ class DeviceQueryEngine:
         }
         out_ts = np.concatenate(
             [np.full(c[2], c[1], dtype=np.int64) for c in chunks])
+        if self.group_exprs:
+            self.last_group_keys = [k for c in chunks for k in (c[3] or [])]
+        else:
+            self.last_group_keys = None
         return out_cols, out_ts
 
     def process_batch(self, state, cols: Dict[str, np.ndarray],
@@ -1643,16 +1669,22 @@ class DeviceQueryEngine:
         # the stateless filter kind is purely per-row — one dispatch
         if n > MAX_DEVICE_BATCH and self.kind not in ("tumbling", "filter"):
             chunks = []
+            all_keys: List = []
             for i in range(0, n, MAX_DEVICE_BATCH):
                 sl = slice(i, i + MAX_DEVICE_BATCH)
                 state, oc, ot = self.process_batch(
                     state, {k: np.asarray(v)[sl] for k, v in cols.items()},
                     ts[sl], pk[sl] if pk is not None else None)
                 chunks.append((oc, ot))
+                if self.last_group_keys is not None:
+                    all_keys.extend(self.last_group_keys)
             out_cols = {
                 nm: np.concatenate([c[0][nm] for c in chunks])
                 for nm in self.output_names
             }
+            self.last_group_keys = (
+                all_keys if self.group_exprs and not self.partition_mode
+                else None)
             return state, out_cols, np.concatenate([c[1] for c in chunks])
         if self.base_ts is None:
             self.base_ts = int(ts[0]) - 1
@@ -1679,11 +1711,18 @@ class DeviceQueryEngine:
             idx = np.flatnonzero(np.asarray(ov)[:n])
             out_np = {k: np.asarray(col)[:n] for k, col in out.items()}
             if self.kind == "filter":
+                host_env = self._host_env(cols, ts, n)
                 out_cols = self._out_columns(
-                    out_np, idx, None, cols, idx,
-                    host_env=self._host_env(cols, ts, n))
+                    out_np, idx, None, cols, idx, host_env=host_env)
+                self.last_group_keys = (
+                    self._host_group_keys(host_env, n, idx)
+                    if self.group_exprs else None)
             else:
                 out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
+                self.last_group_keys = (
+                    self._keys_for_gids(grp[idx])
+                    if self.group_exprs and not self.partition_mode
+                    else None)
             return state, out_cols, ts[idx]
         state, out_cols, out_ts = self._process_tumbling(
             state, cols, rel, grp, n)
@@ -1714,13 +1753,14 @@ class DeviceQueryEngine:
                 out[r, ki] = np.float32(v)
         return out
 
-    def _flush_cols(self, state) -> Tuple[object, Dict[str, np.ndarray], int]:
+    def _flush_cols(self, state):
         flush = self.make_flush_step()
         state, ov, out = flush(state)
         gidx = np.flatnonzero(np.asarray(ov))
         out_np = {k: np.asarray(col) for k, col in out.items()}
         out_cols = self._out_columns(out_np, gidx, gidx, None, None)
-        return state, out_cols, len(gidx)
+        keys = self._keys_for_gids(gidx) if self.group_exprs else None
+        return state, out_cols, len(gidx), keys
 
     def _advance_pane(self):
         """Post-flush timeBatch pane bookkeeping (mirrors the host
@@ -1751,8 +1791,8 @@ class DeviceQueryEngine:
             w = self.pane_wakeup()
             if w is None or w > now:
                 break
-            state, fcols, nf = self._flush_cols(state)
-            chunks.append((fcols, w, nf))
+            state, fcols, nf, keys = self._flush_cols(state)
+            chunks.append((fcols, w, nf, keys))
             self._advance_pane()
         out_cols, out_ts = self._concat_chunks(chunks)
         return state, out_cols, out_ts
@@ -1795,8 +1835,8 @@ class DeviceQueryEngine:
                     i = j
                 if i < n:  # boundary crossed by remaining events
                     boundary = self.base_ts + self._pane_end
-                    state, fcols, nf = self._flush_cols(state)
-                    chunks.append((fcols, boundary, nf))
+                    state, fcols, nf, keys = self._flush_cols(state)
+                    chunks.append((fcols, boundary, nf, keys))
                     self._advance_pane()
             out_cols, out_ts = self._concat_chunks(chunks)
             return state, out_cols, out_ts
@@ -1816,8 +1856,8 @@ class DeviceQueryEngine:
             j = i + int(pass_pos[remaining - 1]) + 1
             state, _ = self._acc_segment(state, cols, rel, grp,
                                          np.arange(i, j))
-            state, fcols, nf = self._flush_cols(state)
-            chunks.append((fcols, self.base_ts + int(rel[j - 1]), nf))
+            state, fcols, nf, keys = self._flush_cols(state)
+            chunks.append((fcols, self.base_ts + int(rel[j - 1]), nf, keys))
             self._pane_fill = 0
             i = j
         out_cols, out_ts = self._concat_chunks(chunks)

@@ -154,16 +154,22 @@ class ShardedDeviceQueryEngine:
             pk_all = (np.asarray(part_keys)
                       if part_keys is not None else None)
             chunks = []
+            all_keys = []
             for i in range(0, n, MAX_DEVICE_BATCH):
                 sl = slice(i, i + MAX_DEVICE_BATCH)
                 state, oc, ot = self.process_batch(
                     state, {k: np.asarray(v)[sl] for k, v in cols.items()},
                     ts[sl], pk_all[sl] if pk_all is not None else None)
                 chunks.append((oc, ot))
+                if eng.last_group_keys is not None:
+                    all_keys.extend(eng.last_group_keys)
             out_cols = {
                 nm: np.concatenate([c[0][nm] for c in chunks])
                 for nm in eng.output_names
             }
+            eng.last_group_keys = (
+                all_keys if eng.group_exprs and not eng.partition_mode
+                else None)
             return state, out_cols, np.concatenate([c[1] for c in chunks])
         if eng.base_ts is None:
             eng.base_ts = int(ts[0]) - 1
@@ -203,6 +209,9 @@ class ShardedDeviceQueryEngine:
         idx = np.flatnonzero(ov_np)
         out_np = {k: np.asarray(col)[pos] for k, col in out.items()}
         out_cols = eng._out_columns(out_np, idx, grp[idx], cols, idx)
+        eng.last_group_keys = (
+            eng._keys_for_gids(grp[idx])
+            if eng.group_exprs and not eng.partition_mode else None)
         return state, out_cols, ts[idx]
 
     def _route_part(self, gid: np.ndarray) -> np.ndarray:

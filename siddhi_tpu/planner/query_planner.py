@@ -687,23 +687,14 @@ class QueryPlanner:
             _DeviceQueryReceiver,
         )
         from siddhi_tpu.ops.device_query import DeviceQueryEngine
-        from siddhi_tpu.query_api import SnapshotOutputRate
 
         out = query.output_stream
         if out is not None and getattr(out, "event_type", "current") != "current":
             raise SiddhiAppCreationError(
                 "device path emits CURRENT events only")
-        if isinstance(query.output_rate, SnapshotOutputRate):
-            raise SiddhiAppCreationError(
-                "snapshot output rate needs the host selector")
-        from siddhi_tpu.query_api import EventOutputRate as _EOR
-
-        if (isinstance(query.output_rate, _EOR)
-                and query.output_rate.type in ("first", "last")
-                and query.selector.group_by):
-            raise SiddhiAppCreationError(
-                "per-group first/last rate limiting needs the host "
-                "selector's group-key side channel")
+        # per-group first/last and snapshot rate limiters work: the
+        # device runtime attaches the same group-key side channel the
+        # host selector does (engine.last_group_keys -> batch.aux)
         if not (s.is_inner or s.is_fault):
             if s.stream_id in self.app.named_windows:
                 raise SiddhiAppCreationError(

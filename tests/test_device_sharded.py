@@ -216,3 +216,41 @@ class TestShardedPartitionedProduct:
             assert got == expect, (got, expect)
         finally:
             m.shutdown()
+
+
+class TestShardedGroupKeySideChannel:
+    def test_big_batch_chunking_keeps_group_keys(self):
+        """>MAX_DEVICE_BATCH sharded batches must accumulate the
+        group-key side channel across chunks (regression: only the last
+        chunk's keys survived, collapsing per-group rate limiting)."""
+        import numpy as np
+
+        from siddhi_tpu.core.event import EventBatch
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "@app:execution('tpu', partitions='16', devices='8') "
+                + APP +
+                "@info(name='gq') from S select k, sum(v) as s group by k "
+                "output first every 5000 events insert into Out;")
+            got = []
+            rt.add_callback("Out", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            n = 3000
+            rng = np.random.default_rng(0)
+            ks = rng.integers(0, 4, n).astype(np.int32)
+            rt.get_input_handler("S").send_batch(EventBatch(
+                "S", ["sym", "v", "k"],
+                {"sym": np.asarray(["x"] * n, dtype=object),
+                 "v": np.ones(n), "k": ks},
+                1000 + np.arange(n, dtype=np.int64)))
+            rt.shutdown()
+            # per-group FIRST within the 5000-event period: exactly one
+            # row per distinct k (a global-group collapse emits just 1)
+            assert len(got) == 4, got
+            assert sorted(g[0] for g in got) == [0, 1, 2, 3]
+        finally:
+            m.shutdown()

@@ -233,14 +233,12 @@ class TestFallbacks:
         self.fallback(DEFINE + "from S#window.length(3) select k, v "
                                "insert expired events into OutputStream;")
 
-    def test_snapshot_rate_falls_back(self):
-        # round 5: order by/limit now ride the host-side passthrough
-        # selector (tests/test_device_wide_aggs.py
-        # TestOrderByLimitOnDevicePath); snapshot rates still need the
-        # host selector
-        self.fallback(DEFINE + "from S select k, v "
-                               "output snapshot every 1 sec "
-                               "insert into OutputStream;")
+    def test_expired_events_output_falls_back(self):
+        # round 5: order by/limit and per-group/snapshot rates now ride
+        # the device path; non-CURRENT output (window expiry consumers)
+        # is the representative remaining host-only surface
+        self.fallback(DEFINE + "from S#window.length(2) select k, v "
+                               "insert expired events into OutputStream;")
 
     def test_fallback_still_correct(self):
         app = ("define stream S (sym string, v double); "

@@ -255,3 +255,56 @@ class TestOrderByLimitOnDevicePath:
             DEFS + "@info(name='q') from S#window.lengthBatch(6) select "
             "k, count() as c group by k order by c desc, k asc limit 2 "
             "insert into O;", mk_sends(36))
+
+
+class TestRateLimitersOnDevicePath:
+    """Round 5: per-group first/last and snapshot output rates lower —
+    the device runtime attaches the host selector's group-key side
+    channel (batch.aux['group_keys']) to emitted chunks."""
+
+    def test_per_group_first_every_n(self):
+        differential(
+            DEFS + "@info(name='q') from S select k, sum(v) as s "
+            "group by k output first every 3 events insert into O;",
+            mk_sends(40))
+
+    def test_per_group_last_every_time(self):
+        differential(
+            DEFS + "@info(name='q') from S select k, sum(v) as s "
+            "group by k output last every 500 ms insert into O;",
+            mk_sends(40))
+
+    def test_snapshot_rate(self):
+        differential(
+            DEFS + "@info(name='q') from S select k, sum(v) as s "
+            "group by k output snapshot every 400 ms insert into O;",
+            mk_sends(40))
+
+    def test_group_keys_aux_reaches_rate_limiter(self):
+        """The side channel must be visible at the rate-limiter position
+        (the same place the host selector's aux is consumed)."""
+        from siddhi_tpu import SiddhiManager
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:execution('tpu') " + DEFS +
+                "@info(name='q') from S select k, sum(v) as s group by k "
+                "insert into O;")
+            qr = rt.query_runtimes["q"]
+            seen = []
+            orig = qr.rate_limiter.process
+
+            def spy(batch, now):
+                seen.append(list(batch.aux.get("group_keys") or []))
+                return orig(batch, now)
+
+            qr.rate_limiter.process = spy
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([7, 1.0, 0, True], timestamp=1000)
+            h.send([9, 2.0, 0, True], timestamp=1001)
+            rt.shutdown()
+            assert seen == [[7], [9]], seen
+        finally:
+            m.shutdown()

@@ -119,8 +119,8 @@ def test_query_lowering_endpoint(service):
         "@app:execution('tpu', partitions='16') "
         "define stream S (user string, v double); "
         "@info(name='dev') from S select user, sum(v) as t insert into A; "
-        "@info(name='hostq') from S select user, v "
-        "output snapshot every 1 sec insert into B; "
+        "@info(name='hostq') from S#window.length(2) select user, v "
+        "insert expired events into B; "
         "partition with (user of S) begin "
         "@info(name='pq') from S[v > 1.0] select user, v insert into C; "
         "end;"
@@ -168,8 +168,8 @@ def test_fallback_warns(caplog):
             rt = m.create_siddhi_app_runtime(
                 "@app:playback @app:execution('tpu') "
                 "define stream S (user string, v double); "
-                "@info(name='hq') from S select user, v "
-                "output snapshot every 1 sec insert into Out;")
+                "@info(name='hq') from S#window.length(2) select user, v "
+                "insert expired events into Out;")
         assert rt.lowering() == {"hq": "host"}
         assert any("device query path unavailable" in r.getMessage()
                    for r in caplog.records), caplog.records
