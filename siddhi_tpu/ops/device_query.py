@@ -1567,7 +1567,7 @@ class DeviceQueryEngine:
             jnp.asarray(valid), B
 
     def _out_columns(self, vals, sel, gids, in_cols, in_sel,
-                     host_env=None) -> Dict[str, np.ndarray]:
+                     host_env=None, key_cols=None) -> Dict[str, np.ndarray]:
         """Assemble output columns (declared dtypes) for the selected
         rows.  ``vals``: {name: [*]} device column dict; ``sel``: row
         indices into it; ``gids``: group id per output row (None for the
@@ -1580,10 +1580,15 @@ class DeviceQueryEngine:
             t = self.out_types[oi]
             if kind == "group_key":
                 if gids is None:
-                    # no interned ids: evaluate the key expr directly
-                    n = host_env[N_KEY]
-                    col = np.broadcast_to(
-                        np.asarray(self.group_exprs[v].fn(host_env)), (n,))
+                    # no interned ids: use the precomputed key columns
+                    # (or evaluate the key expr directly)
+                    if key_cols is not None:
+                        col = key_cols[v]
+                    else:
+                        n = host_env[N_KEY]
+                        col = np.broadcast_to(
+                            np.asarray(self.group_exprs[v].fn(host_env)),
+                            (n,))
                     cols[name] = col[in_sel].astype(t.np_dtype, copy=False)
                     continue
                 comp = [self._group_vals[int(g)] for g in gids]
@@ -1619,15 +1624,6 @@ class DeviceQueryEngine:
 
     def _keys_for_gids(self, gids) -> List:
         return [self._group_vals[int(g)] for g in gids]
-
-    def _host_group_keys(self, host_env, n: int, sel) -> List:
-        """Host-evaluated group keys at rows ``sel`` (the filter kind
-        interns nothing), in the shared host key-identity format."""
-        from siddhi_tpu.core.query import format_group_keys
-
-        key_cols = [np.broadcast_to(np.asarray(g.fn(host_env)), (n,))
-                    for g in self.group_exprs]
-        return format_group_keys(key_cols, sel)
 
     def _concat_chunks(self, chunks) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
         """chunks: [(cols, ts_scalar, n_rows, keys|None)] -> (cols, ts);
@@ -1712,11 +1708,19 @@ class DeviceQueryEngine:
             out_np = {k: np.asarray(col)[:n] for k, col in out.items()}
             if self.kind == "filter":
                 host_env = self._host_env(cols, ts, n)
-                out_cols = self._out_columns(
-                    out_np, idx, None, cols, idx, host_env=host_env)
-                self.last_group_keys = (
-                    self._host_group_keys(host_env, n, idx)
+                key_cols = ([np.broadcast_to(
+                    np.asarray(g.fn(host_env)), (n,))
+                    for g in self.group_exprs]
                     if self.group_exprs else None)
+                out_cols = self._out_columns(
+                    out_np, idx, None, cols, idx, host_env=host_env,
+                    key_cols=key_cols)
+                if key_cols and not self.partition_mode:
+                    from siddhi_tpu.core.query import format_group_keys
+
+                    self.last_group_keys = format_group_keys(key_cols, idx)
+                else:
+                    self.last_group_keys = None
             else:
                 out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
                 self.last_group_keys = (
@@ -1810,7 +1814,7 @@ class DeviceQueryEngine:
         return state, int(n_pass)
 
     def _process_tumbling(self, state, cols, rel, grp, n):
-        chunks = []  # (cols, abs_ts, n_rows)
+        chunks = []  # (cols, abs_ts, n_rows, keys|None)
         if self.window_name == "timeBatch":
             # pane bookkeeping mirrors the host TimeBatchWindow: the
             # first event anchors the boundary, boundaries advance by T
