@@ -192,12 +192,20 @@ def bench_product():
             runtime.intern_keys(keys)
         intern_s = (time.perf_counter() - t0) / 5
         product_s_per_batch = BATCH / float(np.median(window_rates))
+        # async emit pipeline counters (core/emit_queue.py): device→host
+        # transfers per junction batch and the share of batches that
+        # matched nothing and so transferred nothing at all
+        es = runtime.emit_stats
+        steps = max(runtime.step_invocations, 1)
         rt.shutdown()
         return {
             "events_per_sec": float(np.median(window_rates)),
             "window_rates": [round(r, 1) for r in window_rates],
             "intern_share": round(intern_s / max(product_s_per_batch, 1e-9), 3),
             "matches": matches[0],
+            "emit_transfers_per_batch": round(es.emit_transfers / steps, 3),
+            "zero_match_skip_rate": round(es.zero_match_skips / steps, 3),
+            "max_pending_emit_depth": es.max_pending_depth,
         }
     finally:
         m.shutdown()
@@ -337,6 +345,8 @@ def main():
         "product_window_rates": product["window_rates"],
         "product_vs_host": round(product["events_per_sec"] / host_rate, 2),
         "intern_share_of_product_step": product["intern_share"],
+        "product_emit_transfers_per_batch": product["emit_transfers_per_batch"],
+        "product_zero_match_skip_rate": product["zero_match_skip_rate"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

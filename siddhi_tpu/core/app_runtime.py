@@ -62,6 +62,32 @@ class SiddhiAppRuntime:
         self._manager = None  # back-ref set by SiddhiManager
         self._apply_statistics_level(self.app_context.root_metrics_level)
 
+    # -- async emit pipeline barriers ---------------------------------------
+
+    def _device_runtimes(self):
+        """Every device/dense runtime holding a pending-emit queue
+        (core/emit_queue.py), across top-level queries and dense
+        partitions."""
+        for qr in self.query_runtimes.values():
+            for attr in ("device_runtime", "pattern_processor"):
+                rt = getattr(qr, attr, None)
+                if rt is not None and hasattr(rt, "drain"):
+                    yield rt
+        for pr in self.partitions.values():
+            for qr in getattr(pr, "dense_query_runtimes", {}).values():
+                for attr in ("device_runtime", "pattern_processor"):
+                    rt = getattr(qr, attr, None)
+                    if rt is not None and hasattr(rt, "drain"):
+                        yield rt
+
+    def drain_device_emits(self):
+        """App-wide flush barrier of the async emit pipeline: every
+        device runtime's queued match batches materialize and emit (in
+        the synchronous order) before host code observes state —
+        snapshot/persist/restore, pull queries, shutdown."""
+        for rt in self._device_runtimes():
+            rt.drain()
+
     # -- lifecycle ----------------------------------------------------------
 
     def debug(self):
@@ -73,6 +99,10 @@ class SiddhiAppRuntime:
         for qr in self.query_runtimes.values():
             if hasattr(qr, "debugger"):
                 qr.debugger = debugger
+        # breakpoints must observe every emit at its own batch: force
+        # the pending-emit queue to drain after each step
+        for rt in self._device_runtimes():
+            rt.emit_queue.depth = 1
         self.start()
         return debugger
 
@@ -143,6 +173,9 @@ class SiddhiAppRuntime:
             sm.stop_reporting()
         for s in self.sources:
             s.shutdown()
+        # barrier: queued device emits reach their callbacks/sinks
+        # before the scheduler and junctions stop accepting output
+        self.drain_device_emits()
         for s in self.sinks:
             s.shutdown()
         self.scheduler.stop()
@@ -232,8 +265,21 @@ class SiddhiAppRuntime:
             sm.throughput.clear()
             sm.latency.clear()
             sm.lowering.clear()
+            sm.transfers.clear()
         else:
             sm.lowering.update(self.lowering())
+            # async emit pipeline transfer counters, one gauge per
+            # device-lowered query (emitTransfers / deferredBatches /
+            # zeroMatchSkips / maxPendingDepth)
+            for name, qr in list(self.query_runtimes.items()) + [
+                (n, q)
+                for pr in self.partitions.values()
+                for n, q in getattr(pr, "dense_query_runtimes", {}).items()
+            ]:
+                for attr in ("device_runtime", "pattern_processor"):
+                    rt = getattr(qr, attr, None)
+                    if rt is not None and hasattr(rt, "emit_stats"):
+                        sm.transfer_tracker(name, rt.emit_stats)
         if not detail:
             sm.buffers.clear()
         for j in self.junctions.values():
@@ -317,6 +363,10 @@ class SiddhiAppRuntime:
         from siddhi_tpu.compiler.compiler import SiddhiCompiler
         from siddhi_tpu.core.on_demand import OnDemandQueryRuntime
 
+        # barrier: a pull query reads tables/windows/aggregations that
+        # queued device emits may still feed — flush them first so the
+        # result matches the synchronous path
+        self.drain_device_emits()
         rt = self._on_demand_cache.get(on_demand_query)
         if rt is None:
             odq = SiddhiCompiler.parse_on_demand_query(on_demand_query)
@@ -363,6 +413,9 @@ class SiddhiAppRuntime:
         # (reference: SiddhiAppRuntimeImpl.persist:677-691 pauses sources)
         for s in self.sources:
             s.pause()
+        # barrier: queued device emits must land in downstream state
+        # (selectors, windows, tables) before it is snapshotted
+        self.drain_device_emits()
         try:
             if isinstance(store, IncrementalPersistenceStore):
                 kind, data = svc.incremental_snapshot()
@@ -377,9 +430,13 @@ class SiddhiAppRuntime:
     def snapshot(self) -> bytes:
         """Raw snapshot bytes without a store (reference:
         SiddhiAppRuntimeImpl.snapshot)."""
+        self.drain_device_emits()
         return self._snapshot_service().full_snapshot()
 
     def restore(self, snapshot: bytes):
+        # barrier: pending emits flush into the PRE-restore state (the
+        # synchronous path delivered them before restore was called)
+        self.drain_device_emits()
         self._snapshot_service().restore(snapshot)
 
     def restore_revision(self, revision: str):

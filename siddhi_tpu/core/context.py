@@ -89,6 +89,11 @@ class SiddhiAppContext:
         # @app:execution('tpu', devices='N'): shard the dense partition
         # axis over an N-device jax.sharding.Mesh (None = single device)
         self.tpu_devices = None
+        # @app:execution('tpu', emit.depth='N'): pending-emit queue
+        # depth of the async emit pipeline (core/emit_queue.py) — device
+        # runtimes hold up to N matched batches device-resident before
+        # one coalesced drain.  1 (default) drains after every batch.
+        self.tpu_emit_depth = 1
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

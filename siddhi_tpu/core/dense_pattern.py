@@ -226,12 +226,16 @@ class DensePatternRuntime:
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None],
                  key_fn: Optional[Callable] = None,
-                 mesh=None, app_context=None):
+                 mesh=None, app_context=None, emit_depth: int = 1):
+        from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats
+
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.key_fn = key_fn
         self.mesh = mesh
+        self.emit_stats = EmitStats()
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats)
         self._app_context = app_context  # exception-listener channel
         self._sharded: Optional[Dict[str, object]] = None
         if mesh is not None:
@@ -476,6 +480,9 @@ class DensePatternRuntime:
         ]
         if not idle:
             return
+        # barrier: purged keys' pending matches must reach per-key
+        # selector state before on_purge_keys drops it
+        self.drain()
         rows = self._phys_rows(np.asarray([r for _k, r in idle],
                                           dtype=np.int32))
         init = self.engine.init_state_host()
@@ -530,18 +537,40 @@ class DensePatternRuntime:
         if len(ts):
             np.maximum.at(self._row_last_used, part, ts)
         if self._sharded is not None:
-            self.state, ev_idx, out, _total = self._sharded[
-                stream_key].process(self.state, part, cols, ts)
+            self.state, pending, _total = self._sharded[
+                stream_key].process_deferred(self.state, part, cols, ts)
         else:
-            self.state, ev_idx, out = eng.process(
+            self.state, pending = eng.process_deferred(
                 self.state, stream_key, part, cols, ts)
         self.step_invocations += 1
         if eng.has_deadlines:
             self._wake_dirty = True
         if self.step_invocations % self._OVF_POLL == 0:
             self._check_overflow()
+        if pending is None:
+            self.emit_queue.skip()
+            return
+        from siddhi_tpu.core.emit_queue import PendingEmit
+
+        now = (self._app_context.timestamp_generator.current_time()
+               if self._app_context is not None else None)
+        self.emit_queue.push(PendingEmit(
+            pending.device_arrays(),
+            lambda host, p=pending, t=ts, k=keys, n=now: self._emit_deferred(
+                p, t, k, host, now=n)))
+
+    def drain(self):
+        """Flush barrier: materialize and emit every queued match batch
+        (one coalesced transfer) — called wherever host code could
+        observe emit timing (snapshot/restore, timer fires, purges,
+        shutdown)."""
+        self.emit_queue.drain()
+
+    def _emit_deferred(self, pending, ts, keys, host_arrays, now=None):
+        ev_idx, out = pending.materialize(host_arrays)
         if len(ev_idx) == 0:
             return
+        eng = self.engine
         out_cols: Dict[str, np.ndarray] = {}
         names = eng.output_names
         for oi, name in enumerate(names):
@@ -552,6 +581,11 @@ class DensePatternRuntime:
         )
         if keys is not None:
             mb.aux["partition_keys"] = [keys[int(i)] for i in ev_idx]
+        if now is not None:
+            # the clock sampled when this batch was processed: deferred
+            # drains replay time-based rate limiters exactly (the
+            # sync-path `now` sequence, not the drain time)
+            mb.aux["emit_now"] = now
         self.emit_cb(mb)
 
     # -- instance-capacity overflow ------------------------------------------
@@ -619,13 +653,16 @@ class DensePatternRuntime:
             self._ovf_warned = total
 
     def close(self):
-        """Final overflow check at app shutdown: short-lived apps (< one
-        poll interval of batches) still get the dropped-instance warning."""
+        """App shutdown: drain pending emits, then the final overflow
+        check — short-lived apps (< one poll interval of batches) still
+        get the dropped-instance warning."""
+        self.drain()
         self._check_overflow()
 
     # -- snapshot contract ---------------------------------------------------
 
     def snapshot(self) -> Dict:
+        self.drain()
         self._check_overflow()
         return {
             "dense_state": {k: np.asarray(v) for k, v in self.state.items()},
@@ -637,6 +674,7 @@ class DensePatternRuntime:
         }
 
     def restore(self, state: Dict):
+        self.drain()
         jnp = self.engine.jnp
         rows = len(next(iter(state["dense_state"].values())))
         if self._sharded is not None:
@@ -682,6 +720,9 @@ class DensePatternRuntime:
         eng = self.engine
         if not getattr(eng, "has_deadlines", False):
             return
+        # barrier BEFORE the timer fire: event matches queued before
+        # this tick must emit first (the synchronous order)
+        self.drain()
         self.state, fired = eng.on_time_state(self.state, now)
         self._wake_dirty = True
         if fired is None:
@@ -703,6 +744,7 @@ class DensePatternRuntime:
             logical = self._logical_rows(np.asarray(rows))
             mb.aux["partition_keys"] = [
                 self._row_keys.get(int(r)) for r in logical]
+        mb.aux["emit_now"] = now
         self.emit_cb(mb)
 
     def next_wakeup(self):

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats, PendingEmit
 from siddhi_tpu.core.event import EventBatch
 from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
 
@@ -46,18 +47,35 @@ class DeviceQueryRuntime:
     batches to device columns, advances per-group state with the jitted
     step, and emits output batches into the query's output chain.
 
+    Emission runs through the async emit pipeline (core/emit_queue.py):
+    each junction batch fetches ONE match-count scalar; zero-match
+    batches transfer nothing, matched batches stay device-resident in a
+    bounded pending-emit queue (``@app:execution('tpu',
+    emit.depth='N')``; default 1 drains immediately) until a coalesced
+    drain.  Every host-observable point — snapshot/restore, timer
+    fires, pull queries, shutdown — calls :meth:`drain` first, so
+    callback content and order are bit-identical to the synchronous
+    path.
+
     Also a scheduler task: ``next_wakeup``/``fire`` drive timer-based
     timeBatch pane flushes so tumbling panes close on watermark time
     even when no further events arrive (the host TimeBatchWindow's
     scheduler contract)."""
 
     def __init__(self, engine, out_stream_id: str,
-                 emit: Callable[[EventBatch], None]):
+                 emit: Callable[[EventBatch], None], emit_depth: int = 1,
+                 clock: Optional[Callable[[], int]] = None):
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.state = engine.init_state()
         self.step_invocations = 0  # proof the jitted path ran (tests)
+        self.emit_stats = EmitStats()
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats)
+        # app clock sampled at ENQUEUE time: deferred emits replay with
+        # the `now` the synchronous path would have used (time-based
+        # rate limiters key their period grid off it)
+        self.clock = clock
 
     # -- event path ----------------------------------------------------------
 
@@ -79,25 +97,44 @@ class DeviceQueryRuntime:
             for a in eng.all_attrs if a in cur.columns
         }
         ts = np.asarray(cur.timestamps, dtype=np.int64)
-        self.state, out_cols, out_ts = eng.process_batch(
+        self.state, pending = eng.process_batch_deferred(
             self.state, cols, ts, part_keys=keys)
         self.step_invocations += 1
-        self._emit(out_cols, out_ts)
+        if pending is None:
+            self.emit_queue.skip()
+            return
+        now = self.clock() if self.clock is not None else None
+        self.emit_queue.push(PendingEmit(
+            pending.device_arrays(),
+            lambda host, p=pending, t=now: self._emit_deferred(p, host, t)))
+
+    def drain(self):
+        """Flush barrier: materialize and emit every queued batch (one
+        coalesced transfer).  Called wherever host code could observe
+        emit timing — snapshot/restore, timer fires, rate-limiter
+        decisions, pull queries, shutdown, debugger."""
+        self.emit_queue.drain()
+
+    def _emit_deferred(self, pending, host_arrays, now=None):
+        out_cols, out_ts, keys = pending.materialize(host_arrays)
+        self._emit(out_cols, out_ts, keys, now=now)
 
     def purge_idle(self, now: int, idle_ms) -> int:
         """Partition-mode idle-key purge (the dense analog of dropping
-        idle PartitionInstances)."""
+        idle PartitionInstances).  Drains first: purged keys' pending
+        emits must reach per-key selector state before it is dropped."""
+        self.drain()
         self.state, n = self.engine.purge_idle_keys(self.state, now, idle_ms)
         return n
 
-    def _emit(self, out_cols: Dict[str, np.ndarray], out_ts: np.ndarray):
+    def _emit(self, out_cols: Dict[str, np.ndarray], out_ts: np.ndarray,
+              keys=None, now=None):
         if len(out_ts) == 0:
             return
         mb = EventBatch(
             self.out_stream_id, self.engine.output_names, out_cols,
             out_ts, np.full(len(out_ts), ev.CURRENT, dtype=np.int8),
         )
-        keys = getattr(self.engine, "last_group_keys", None)
         if keys is not None:
             if len(keys) != len(mb):
                 # a misaligned side channel is a wiring bug: degrading
@@ -110,6 +147,8 @@ class DeviceQueryRuntime:
             # group-key side channel: per-group/snapshot rate limiters
             # read it exactly like the host selector's
             mb.aux["group_keys"] = list(keys)
+        if now is not None:
+            mb.aux["emit_now"] = now
         self.emit_cb(mb)
 
     # -- scheduler task (timeBatch pane flushes) -----------------------------
@@ -118,8 +157,12 @@ class DeviceQueryRuntime:
         return self.engine.pane_wakeup()
 
     def fire(self, now: int):
+        # barrier BEFORE the pane flush: batches processed before this
+        # timer tick must emit first (the synchronous order)
+        self.drain()
         self.state, out_cols, out_ts = self.engine.flush_due(self.state, now)
-        self._emit(out_cols, out_ts)
+        self._emit(out_cols, out_ts,
+                   getattr(self.engine, "last_group_keys", None), now=now)
 
     def on_start(self, now: int):
         pass
@@ -130,12 +173,14 @@ class DeviceQueryRuntime:
     # -- snapshot contract ---------------------------------------------------
 
     def snapshot(self) -> Dict:
+        self.drain()
         return {
             "device_state": {k: np.asarray(v) for k, v in self.state.items()},
             "host": self.engine.host_snapshot(),
         }
 
     def restore(self, state: Dict):
+        self.drain()
         eng = self.engine
         if hasattr(eng, "put_state"):  # sharded: restore the placement
             self.state = eng.put_state(state["device_state"])

@@ -1,0 +1,193 @@
+"""Async emit pipeline: count-gated, double-buffered device→host emits.
+
+The product path's dominant cost on the tunneled platform is the
+device→host fetch of jit outputs (~57 ms sticky RTT per transfer —
+bench.py).  This module holds the pieces every device runtime shares:
+
+- ``EmitStats``: per-runtime transfer counters surfaced through
+  ``util/statistics.py`` (``emitTransfers`` / ``deferredBatches`` /
+  ``zeroMatchSkips`` / ``maxPendingDepth``).
+- ``EmitQueue``: a bounded pending-emit queue.  Each entry is one
+  junction batch whose match outputs are still resident on the device;
+  when the queue reaches its configured depth (``emit.depth`` on
+  ``@app:execution``), ALL queued outputs are drained with one
+  coalesced transfer.  Depth 1 (the default) drains right after each
+  batch — emit timing is then identical to the synchronous path while
+  still benefiting from count-gating and the per-batch coalesced fetch.
+- ``fetch_coalesced``: groups device arrays by (dtype, trailing shape),
+  concatenates each group on device along axis 0, fetches everything in
+  a single ``jax.device_get``, and splits back host-side — one transfer
+  round trip instead of one per column per batch.
+
+Exactness contract: entries drain strictly FIFO and each entry
+materializes into exactly the EventBatch the synchronous path would
+have emitted, so callback content AND order are bit-identical; the
+runtimes insert explicit ``drain()`` barriers wherever host code could
+observe emit timing (snapshot/restore, timer fires, rate-limiter
+decisions, pull queries, shutdown, debugger).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class EmitStats:
+    """Transfer counters for one device runtime (host-side ints; one
+    increment per batch, matching the micro-batched tracker style of
+    util/statistics.py)."""
+
+    __slots__ = ("emit_transfers", "deferred_batches", "zero_match_skips",
+                 "max_pending_depth")
+
+    def __init__(self):
+        self.emit_transfers = 0
+        self.deferred_batches = 0
+        self.zero_match_skips = 0
+        self.max_pending_depth = 0
+
+    def note_depth(self, depth: int):
+        if depth > self.max_pending_depth:
+            self.max_pending_depth = depth
+
+    def as_dict(self) -> dict:
+        return {
+            "emitTransfers": self.emit_transfers,
+            "deferredBatches": self.deferred_batches,
+            "zeroMatchSkips": self.zero_match_skips,
+            "maxPendingDepth": self.max_pending_depth,
+        }
+
+
+def _is_device_array(a) -> bool:
+    return not isinstance(a, (np.ndarray, np.generic, int, float, bool))
+
+
+def fetch_coalesced(arrays: Sequence) -> List[np.ndarray]:
+    """One device→host round trip for a list of arrays.
+
+    Device arrays are grouped by (dtype, trailing shape), each group is
+    concatenated ON DEVICE along axis 0, the concatenated buffers are
+    fetched with a single ``jax.device_get``, and the result is split
+    back host-side in input order.  Host numpy arrays pass through
+    untouched.  Counts as ONE emit transfer.
+    """
+    if not arrays:
+        return []
+    out: List[Optional[np.ndarray]] = [None] * len(arrays)
+    groups: dict = {}  # (dtype, trailing shape) -> [index]
+    for i, a in enumerate(arrays):
+        if not _is_device_array(a):
+            out[i] = np.asarray(a)
+            continue
+        shape = getattr(a, "shape", ())
+        if len(shape) == 0:
+            key = ("scalar", i)  # 0-d: no concat axis; fetch alone
+        else:
+            key = (str(a.dtype), tuple(shape[1:]))
+        groups.setdefault(key, []).append(i)
+    if not groups:
+        return [a for a in out]  # all host already
+    import jax
+    import jax.numpy as jnp
+
+    keys = list(groups)
+    staged = []
+    for key in keys:
+        idxs = groups[key]
+        if len(idxs) == 1:
+            staged.append(arrays[idxs[0]])
+        else:
+            try:
+                staged.append(jnp.concatenate(
+                    [arrays[i] for i in idxs], axis=0))
+            except Exception:
+                # heterogeneous placements (e.g. differently-sharded
+                # chunks) can refuse to concatenate — fall back to
+                # fetching the group members individually in the same
+                # device_get call
+                staged.append(None)
+    fetch = []
+    for key, s in zip(keys, staged):
+        if s is None:
+            fetch.extend(arrays[i] for i in groups[key])
+        else:
+            fetch.append(s)
+    host = jax.device_get(fetch)
+    pos = 0
+    for key, s in zip(keys, staged):
+        idxs = groups[key]
+        if s is None:
+            for i in idxs:
+                out[i] = host[pos]
+                pos += 1
+        elif len(idxs) == 1:
+            out[idxs[0]] = host[pos]
+            pos += 1
+        else:
+            cat = host[pos]
+            pos += 1
+            off = 0
+            for i in idxs:
+                n = arrays[i].shape[0]
+                out[i] = cat[off:off + n]
+                off += n
+    return out  # type: ignore[return-value]
+
+
+class PendingEmit:
+    """One deferred junction batch: device refs + a materializer that
+    turns the fetched host arrays into the exact synchronous emit."""
+
+    __slots__ = ("arrays", "materialize")
+
+    def __init__(self, arrays: Sequence, materialize: Callable):
+        # materialize(host_arrays) -> None (runs the emit callback)
+        self.arrays = list(arrays)
+        self.materialize = materialize
+
+
+class EmitQueue:
+    """Bounded per-runtime pending-emit queue (FIFO, depth >= 1)."""
+
+    def __init__(self, depth: int = 1, stats: Optional[EmitStats] = None):
+        self.depth = max(1, int(depth))
+        self.stats = stats or EmitStats()
+        self._entries: List[PendingEmit] = []
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def push(self, entry: PendingEmit):
+        self._entries.append(entry)
+        self.stats.note_depth(len(self._entries))
+        if len(self._entries) >= self.depth:
+            self.drain()
+        else:
+            self.stats.deferred_batches += 1
+
+    def skip(self):
+        """Record a zero-match batch that transferred nothing."""
+        self.stats.zero_match_skips += 1
+
+    def drain(self):
+        """Flush barrier: materialize every pending entry in FIFO order
+        with one coalesced transfer.  Re-entrant pushes from emit
+        callbacks land in a fresh list and drain after the current
+        entries — the same order the synchronous path produces."""
+        while self._entries:
+            entries, self._entries = self._entries, []
+            arrays: List = []
+            spans: List[int] = []
+            for e in entries:
+                spans.append(len(e.arrays))
+                arrays.extend(e.arrays)
+            if any(_is_device_array(a) for a in arrays):
+                self.stats.emit_transfers += 1
+            host = fetch_coalesced(arrays)
+            off = 0
+            for e, n in zip(entries, spans):
+                e.materialize(host[off:off + n])
+                off += n

@@ -854,7 +854,13 @@ class QueryRuntime:
         self.callback_output.callbacks.append(cb)
 
     def process(self, batch: EventBatch, chain_index: int = 0):
-        now = self.app_context.timestamp_generator.current_time()
+        # async emit pipeline: a deferred device emit carries the time
+        # observed when its batch was PROCESSED (aux side channel) —
+        # time-based rate limiters must see the same clock sequence the
+        # synchronous path produces, not the later drain time
+        now = batch.aux.pop("emit_now", None)
+        if now is None:
+            now = self.app_context.timestamp_generator.current_time()
         if self.latency_tracker is not None:
             self.latency_tracker.mark_in(len(batch))
         try:
@@ -882,6 +888,7 @@ class QueryRuntime:
         chain, selector group states, rate limiter, join-side windows,
         pattern NFA instances) — the analog of the reference's per-query
         StateHolder walk (util/snapshot/SnapshotService.java:101-169)."""
+        self._drain_device_emits()
         state: Dict = {"selector": self.selector.snapshot()}
         if hasattr(self.rate_limiter, "snapshot"):
             state["rate_limiter"] = self.rate_limiter.snapshot()
@@ -908,7 +915,18 @@ class QueryRuntime:
             state["device"] = dr.snapshot()
         return state
 
+    def _drain_device_emits(self):
+        """Flush barrier of the async emit pipeline: this query's queued
+        device emits materialize (through selector/limiter/output) BEFORE
+        the surrounding snapshot/restore reads or replaces that state —
+        exactly where the synchronous path would have delivered them."""
+        for attr in ("device_runtime", "pattern_processor"):
+            rt = getattr(self, attr, None)
+            if rt is not None and hasattr(rt, "drain"):
+                rt.drain()
+
     def restore_state(self, state: Dict):
+        self._drain_device_emits()
         self.selector.restore(state["selector"])
         if "rate_limiter" in state and hasattr(self.rate_limiter, "restore"):
             self.rate_limiter.restore(state["rate_limiter"])

@@ -608,7 +608,7 @@ class DensePatternEngine:
         step(state, part_idx[B] i32, cols {attr: [B] f32}, ts[B] i32
              relative-ms, valid[B] bool)
           -> (state, emit[B, 2*I] bool, out_vals[B, 2*I, n_out] f32,
-              emit_anchor[B, 2*I] i32)
+              emit_anchor[B, 2*I] i32, n_emit i32 scalar)
 
         ``emit[b, i]``: a pending instance of event ``b``'s partition
         completed the chain on this event.  The emit arrays carry 2*I
@@ -1218,8 +1218,13 @@ class DensePatternEngine:
             if "deadline" in state:
                 new_state["deadline"] = state["deadline"].at[part_idx].set(
                     jnp.where(v1, dlh[0], state["deadline"][part_idx]))
-            # outs is a pytree: float lanes + integer hi/lo pair lanes
-            return new_state, emit, {"f": out_vals, "i": out_ivals}, emit_anchor
+            # outs is a pytree: float lanes + integer hi/lo pair lanes;
+            # n_emit is the count-gate scalar for the async emit
+            # pipeline — the host fetches it alone and skips the column
+            # transfer entirely on zero-match batches
+            n_emit = jnp.sum((emit & valid[:, None]).astype(jnp.int32))
+            return (new_state, emit, {"f": out_vals, "i": out_ivals},
+                    emit_anchor, n_emit)
 
         fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
         self._step_cache[cache_key] = fn
@@ -1487,15 +1492,33 @@ class DensePatternEngine:
         (ascending; same-event matches ordered by arming age, mirroring
         the reference's pendingStateEventList iteration order) and
         ``match_out[m, n_out]`` its output values."""
+        state, pending = self.process_deferred(state, stream_key, part_idx,
+                                               cols, ts)
+        if pending is None:
+            return state, *flatten_match_parts(
+                [], [], [], max(len(self.out_spec), 1))
+        from siddhi_tpu.core.emit_queue import fetch_coalesced
+
+        ev, out = pending.materialize(fetch_coalesced(
+            pending.device_arrays()))
+        return state, ev, out
+
+    def process_deferred(self, state, stream_key: str, part_idx: np.ndarray,
+                         cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Async-emit variant of :meth:`process`: match outputs of rounds
+        whose count gate fired stay resident on device inside the
+        returned :class:`DeferredDenseEmit` (None when no round
+        matched).  Only the per-round ``n_emit`` scalar crosses
+        device->host here — matches are rare in CEP, so the common batch
+        costs one scalar round trip, not a column transfer (transfers
+        are expensive on tunneled/remote devices)."""
         jnp = self.jnp
         step = self.make_step(stream_key)
         rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
         state, rel64 = self.maybe_re_anchor(state, rel64)
         rel = rel64.astype(np.int32)
         prepared = self.prepare_cols(stream_key, cols)
-        ev_parts: List[np.ndarray] = []
-        out_parts: List[np.ndarray] = []
-        key_parts: List[np.ndarray] = []  # (ev, anchor, lane) sort keys
+        pending = DeferredDenseEmit(self)
         for ridx in _collision_rounds(part_idx):
             b = len(ridx)
             bp = max(1 << (b - 1).bit_length(), 16)  # pad to pow2, min 16
@@ -1510,26 +1533,15 @@ class DensePatternEngine:
                 col = np.zeros(bp, dtype=v.dtype)
                 col[:b] = v[ridx]
                 cb[k] = jnp.asarray(col)
-            state, emit, outs, emit_anchor = step(
+            state, emit, outs, emit_anchor, n_emit = step(
                 state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
             )
-            # device->host: fetch the emit mask, then the output values
-            # only when something matched — matches are rare in CEP, so
-            # the common batch costs ONE transfer round trip, not two
-            # (transfers are expensive on tunneled/remote devices)
-            emit_np = np.asarray(emit)[:b]  # [b, 2I]
-            if emit_np.any():
-                out_f = np.asarray(outs["f"])[:b]
-                out_i = np.asarray(outs["i"])[:b]
-                anchor_np = np.asarray(emit_anchor)[:b]
-                rows, lanes = np.nonzero(emit_np)
-                ev_parts.append(ridx[rows])
-                out_parts.append(self.assemble_out(out_f, out_i, rows, lanes))
-                key_parts.append(np.stack(
-                    [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
-        ev, out = flatten_match_parts(
-            ev_parts, out_parts, key_parts, max(len(self.out_spec), 1))
-        return state, ev, out
+            if int(n_emit):
+                pending.chunks.append({
+                    "emit": emit, "f": outs["f"], "i": outs["i"],
+                    "anchor": emit_anchor, "sel": slice(0, b), "ridx": ridx,
+                })
+        return state, (pending if pending.chunks else None)
 
     def assemble_out(self, out_f: np.ndarray, out_i: np.ndarray,
                      rows: np.ndarray, lanes: np.ndarray) -> np.ndarray:
@@ -1630,6 +1642,56 @@ class DensePatternEngine:
             elif a.type.is_numeric:
                 out[a.name] = v.astype(np.float32)
         return out
+
+
+class DeferredDenseEmit:
+    """Device-resident match outputs of one dense batch, pending drain.
+
+    Each chunk is one collision round whose count gate fired: the
+    ``emit``/``f``/``i``/``anchor`` arrays are still jit outputs on
+    device; ``sel`` maps padded device rows back to the round's events
+    (a ``slice`` on the unsharded engine, a routed-slot index array on
+    the sharded one) and ``ridx`` maps round rows to batch rows.
+    ``device_arrays()`` + ``materialize()`` is the pending-emit queue
+    contract (core/emit_queue.py): materialize receives the fetched host
+    arrays in ``device_arrays()`` order and reproduces exactly what the
+    synchronous path returns.
+    """
+
+    __slots__ = ("engine", "chunks")
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.chunks: List[dict] = []
+
+    def device_arrays(self) -> List:
+        arrs: List = []
+        for ch in self.chunks:
+            arrs.extend((ch["emit"], ch["f"], ch["i"], ch["anchor"]))
+        return arrs
+
+    def materialize(self, host_arrays) -> Tuple[np.ndarray, np.ndarray]:
+        eng = self.engine
+        ev_parts: List[np.ndarray] = []
+        out_parts: List[np.ndarray] = []
+        key_parts: List[np.ndarray] = []  # (ev, anchor, lane) sort keys
+        for ci, ch in enumerate(self.chunks):
+            emit_h, f_h, i_h, anchor_h = host_arrays[4 * ci:4 * ci + 4]
+            sel = ch["sel"]
+            emit_np = np.asarray(emit_h)[sel]  # [b, 2I]
+            if not emit_np.any():
+                continue  # count gate can overcount padded lanes: skip
+            out_f = np.asarray(f_h)[sel]
+            out_i = np.asarray(i_h)[sel]
+            anchor_np = np.asarray(anchor_h)[sel]
+            rows, lanes = np.nonzero(emit_np)
+            ridx = ch["ridx"]
+            ev_parts.append(ridx[rows])
+            out_parts.append(eng.assemble_out(out_f, out_i, rows, lanes))
+            key_parts.append(np.stack(
+                [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
+        return flatten_match_parts(
+            ev_parts, out_parts, key_parts, max(len(eng.out_spec), 1))
 
 
 def flatten_match_parts(ev_parts, out_parts, key_parts, n_out: int

@@ -911,7 +911,11 @@ class DeviceQueryEngine:
         step(state, cols {attr: [B] f32}, ts[B] i32 relative-ms,
              grp[B] i32, wgrp[B] i32 (window group; partition mode only),
              valid[B] bool)
-          -> (state, out_valid[B], out_vals[B, n_out])
+          -> (state, out_valid[B], out_vals[B, n_out], n_match scalar i32)
+
+        ``n_match`` is the async-emit count gate: the host fetches this
+        ONE scalar per batch and skips the column fetch entirely when it
+        is zero (the common case for selective filters).
         """
         key = ("step", jit)
         if key in self._step_cache:
@@ -1040,7 +1044,13 @@ class DeviceQueryEngine:
             self._forever_scatter(state, new_state, argvals, grp, fmask)
             return new_state, ov, out
 
-        fn = self.jax.jit(step, donate_argnums=(0,)) if jit else step
+        def step_counted(state, cols, ts, grp, wgrp, valid):
+            new_state, ov, out = step(state, cols, ts, grp, wgrp, valid)
+            n = jnp.sum((ov.astype(bool) & valid).astype(jnp.int32))
+            return new_state, ov, out, n
+
+        fn = (self.jax.jit(step_counted, donate_argnums=(0,)) if jit
+              else step_counted)
         self._step_cache[key] = fn
         return fn
 
@@ -1192,7 +1202,9 @@ class DeviceQueryEngine:
         return fn
 
     def make_flush_step(self, jit: bool = True) -> Callable:
-        """Tumbling flush: (state) -> (state, flush_valid[G], out[G, n_out])."""
+        """Tumbling flush: (state) -> (state, flush_valid[G],
+        out[G, n_out], n_match scalar i32) — the count gates the host
+        fetch exactly like make_step's."""
         key = ("flush", jit)
         if key in self._step_cache:
             return self._step_cache[key]
@@ -1228,7 +1240,7 @@ class DeviceQueryEngine:
             if "acc_max" in state:
                 new_state["acc_max"] = jnp.full_like(state["acc_max"], -jnp.inf)
             new_state["touched"] = jnp.zeros_like(state["touched"])
-            return new_state, ov, out
+            return new_state, ov, out, jnp.sum(ov.astype(jnp.int32))
 
         fn = self.jax.jit(flush, donate_argnums=(0,)) if jit else flush
         self._step_cache[key] = fn
@@ -1567,19 +1579,22 @@ class DeviceQueryEngine:
             jnp.asarray(valid), B
 
     def _out_columns(self, vals, sel, gids, in_cols, in_sel,
-                     host_env=None, key_cols=None) -> Dict[str, np.ndarray]:
+                     host_env=None, key_cols=None,
+                     gvals=None) -> Dict[str, np.ndarray]:
         """Assemble output columns (declared dtypes) for the selected
         rows.  ``vals``: {name: [*]} device column dict; ``sel``: row
         indices into it; ``gids``: group id per output row (None for the
         stateless filter kind — group keys are then evaluated host-side
         from ``host_env``); ``in_cols``/``in_sel``: input batch columns
         + row indices for passthrough items (None for flush outputs,
-        which cannot have passthroughs)."""
+        which cannot have passthroughs).  ``gvals``: pre-captured group
+        key value per output row — deferred emits pass this so a group
+        id recycled between enqueue and drain cannot alias the keys."""
         cols: Dict[str, np.ndarray] = {}
         for oi, (kind, v, name) in enumerate(self.out_spec):
             t = self.out_types[oi]
             if kind == "group_key":
-                if gids is None:
+                if gids is None and gvals is None:
                     # no interned ids: use the precomputed key columns
                     # (or evaluate the key expr directly)
                     if key_cols is not None:
@@ -1591,7 +1606,8 @@ class DeviceQueryEngine:
                             (n,))
                     cols[name] = col[in_sel].astype(t.np_dtype, copy=False)
                     continue
-                comp = [self._group_vals[int(g)] for g in gids]
+                comp = (list(gvals) if gvals is not None
+                        else [self._group_vals[int(g)] for g in gids])
                 if self.partition_mode:
                     # composed tuple is (partition_key, *group_keys)
                     comp = [k[v + 1] for k in comp]
@@ -1651,37 +1667,57 @@ class DeviceQueryEngine:
         output columns cast back to the declared attribute types (the
         product runtime builds an EventBatch straight from these).
         ``part_keys`` (partition mode only): raw partition-key value per
-        row."""
+        row.  Synchronous wrapper over the deferred path — one
+        count-gated, coalesced fetch per call."""
+        state, pending = self.process_batch_deferred(state, cols, ts,
+                                                     part_keys)
+        if pending is None:
+            self.last_group_keys = (
+                [] if self.group_exprs and not self.partition_mode else None)
+            return state, self._empty_cols(), np.empty(0, dtype=np.int64)
+        from siddhi_tpu.core.emit_queue import fetch_coalesced
+
+        out_cols, out_ts, keys = pending.materialize(
+            fetch_coalesced(pending.device_arrays()))
+        self.last_group_keys = keys
+        return state, out_cols, out_ts
+
+    def process_batch_deferred(self, state, cols: Dict[str, np.ndarray],
+                               ts: np.ndarray,
+                               part_keys: Optional[np.ndarray] = None):
+        """Async-emit entry point: run the jitted step(s) and KEEP the
+        match outputs resident on device.  Only the scalar match count
+        crosses the device boundary here; zero-match batches return
+        ``(state, None)`` with no column transfer at all.  Non-empty
+        batches return a DeferredDeviceEmit whose ``device_arrays()`` /
+        ``materialize(host_arrays)`` pair the pending-emit queue
+        (core/emit_queue.py) drains with one coalesced transfer."""
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         if n == 0:
-            return state, self._empty_cols(), np.empty(0, dtype=np.int64)
+            return state, None
         if self.partition_mode and part_keys is None:
             raise SiddhiAppRuntimeError(
                 "partitioned device query needs per-row partition keys")
         pk = np.asarray(part_keys) if part_keys is not None else None
+        pending = DeferredDeviceEmit(self)
         # the chunk bound exists for the [B, B] same-group masks of the
         # running/keyed-sliding kinds (and sliding's [B, W+B] gathers);
         # the stateless filter kind is purely per-row — one dispatch
         if n > MAX_DEVICE_BATCH and self.kind not in ("tumbling", "filter"):
-            chunks = []
-            all_keys: List = []
             for i in range(0, n, MAX_DEVICE_BATCH):
                 sl = slice(i, i + MAX_DEVICE_BATCH)
-                state, oc, ot = self.process_batch(
+                state = self._deferred_chunk(
                     state, {k: np.asarray(v)[sl] for k, v in cols.items()},
-                    ts[sl], pk[sl] if pk is not None else None)
-                chunks.append((oc, ot))
-                if self.last_group_keys is not None:
-                    all_keys.extend(self.last_group_keys)
-            out_cols = {
-                nm: np.concatenate([c[0][nm] for c in chunks])
-                for nm in self.output_names
-            }
-            self.last_group_keys = (
-                all_keys if self.group_exprs and not self.partition_mode
-                else None)
-            return state, out_cols, np.concatenate([c[1] for c in chunks])
+                    ts[sl], pk[sl] if pk is not None else None, pending)
+        else:
+            state = self._deferred_chunk(state, cols, ts, pk, pending)
+        return state, (pending if pending.chunks else None)
+
+    def _deferred_chunk(self, state, cols, ts, pk, pending):
+        """Process one <=MAX_DEVICE_BATCH slice; non-empty match outputs
+        are appended to ``pending`` as device refs."""
+        n = len(ts)
         if self.base_ts is None:
             self.base_ts = int(ts[0]) - 1
         rel64 = ts - self.base_ts
@@ -1691,7 +1727,8 @@ class DeviceQueryEngine:
         now = int(ts.max())
         if self.kind == "filter":
             # stateless: no interning at all (group-key select items are
-            # evaluated host-side below) — unbounded key cardinality
+            # evaluated host-side at materialize time) — unbounded key
+            # cardinality
             grp = wgrp = np.zeros(n, dtype=np.int32)
         elif self.partition_mode:
             wgrp = self._intern_wgroups(pk, now)
@@ -1703,34 +1740,30 @@ class DeviceQueryEngine:
         if self.kind in ("filter", "running", "sliding", "keyed_sliding"):
             step = self.make_step()
             c, t, g, wg, valid, B = self._pad(cols, rel, grp, n, wgrp)
-            state, ov, out = step(state, c, t, g, wg, valid)
-            idx = np.flatnonzero(np.asarray(ov)[:n])
-            out_np = {k: np.asarray(col)[:n] for k, col in out.items()}
-            if self.kind == "filter":
-                host_env = self._host_env(cols, ts, n)
-                key_cols = ([np.broadcast_to(
-                    np.asarray(g.fn(host_env)), (n,))
-                    for g in self.group_exprs]
-                    if self.group_exprs else None)
-                out_cols = self._out_columns(
-                    out_np, idx, None, cols, idx, host_env=host_env,
-                    key_cols=key_cols)
-                if key_cols and not self.partition_mode:
-                    from siddhi_tpu.core.query import format_group_keys
-
-                    self.last_group_keys = format_group_keys(key_cols, idx)
-                else:
-                    self.last_group_keys = None
-            else:
-                out_cols = self._out_columns(out_np, idx, grp[idx], cols, idx)
-                self.last_group_keys = (
-                    self._keys_for_gids(grp[idx])
-                    if self.group_exprs and not self.partition_mode
-                    else None)
-            return state, out_cols, ts[idx]
+            state, ov, out, n_match = step(state, c, t, g, wg, valid)
+            if int(n_match) == 0:
+                return state  # count gate: no column ever fetched
+            # group key values are captured NOW (host-side, from the
+            # intern tables): a group id recycled by a later batch or an
+            # idle purge before the deferred drain must not alias the
+            # keys of rows already pending
+            gvals = (self._keys_for_gids(grp[:n])
+                     if self.group_exprs and self.kind != "filter"
+                     else None)
+            pending.chunks.append({
+                "kind": "device", "ov": ov, "out": dict(out),
+                "names": list(out), "n": n, "gvals": gvals, "ts": ts,
+                "cols": {k: np.asarray(v) for k, v in cols.items()},
+            })
+            return state
         state, out_cols, out_ts = self._process_tumbling(
             state, cols, rel, grp, n)
-        return state, out_cols, out_ts
+        if len(out_ts):
+            pending.chunks.append({
+                "kind": "host", "cols": out_cols, "ts": out_ts,
+                "keys": self.last_group_keys,
+            })
+        return state
 
     def process(self, state, cols: Dict[str, np.ndarray], ts: np.ndarray,
                 part_keys: Optional[np.ndarray] = None):
@@ -1759,7 +1792,11 @@ class DeviceQueryEngine:
 
     def _flush_cols(self, state):
         flush = self.make_flush_step()
-        state, ov, out = flush(state)
+        state, ov, out, n_match = flush(state)
+        if int(n_match) == 0:
+            # count gate: empty pane — no group/output column fetched
+            return state, self._empty_cols(), 0, (
+                [] if self.group_exprs else None)
         gidx = np.flatnonzero(np.asarray(ov))
         out_np = {k: np.asarray(col) for k, col in out.items()}
         out_cols = self._out_columns(out_np, gidx, gidx, None, None)
@@ -1954,6 +1991,103 @@ class DeviceQueryEngine:
     @property
     def output_names(self) -> List[str]:
         return [name for _k, _v, name in self.out_spec]
+
+
+class DeferredDeviceEmit:
+    """Device-resident match outputs of one ``process_batch_deferred``
+    call (one junction batch; possibly several >MAX_DEVICE_BATCH-row
+    chunks).  The pending-emit queue (core/emit_queue.py) fetches
+    ``device_arrays()`` with one coalesced transfer and hands the host
+    copies back to ``materialize``; the result is byte-identical to what
+    the synchronous ``process_batch`` would have returned."""
+
+    __slots__ = ("engine", "chunks")
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.chunks: List[dict] = []
+
+    def device_arrays(self) -> List:
+        arrs: List = []
+        for ch in self.chunks:
+            if ch["kind"] != "device":
+                continue
+            arrs.append(ch["ov"])
+            arrs.extend(ch["out"][nm] for nm in ch["names"])
+        return arrs
+
+    def materialize(self, host_arrays):
+        """``host_arrays``: fetched copies aligned with
+        ``device_arrays()``.  Returns ``(out_cols, out_ts, keys)`` —
+        the synchronous result triple (keys = the group-key side
+        channel, None when the query carries none)."""
+        eng = self.engine
+        pos = 0
+        parts = []  # (out_cols, out_ts, keys|None)
+        for ch in self.chunks:
+            if ch["kind"] == "host":
+                parts.append((ch["cols"], ch["ts"], ch["keys"]))
+                continue
+            n = ch["n"]
+            # sharded chunks carry a routed-slot map instead of plain
+            # front-padding: ``pos`` maps input row -> routed slot
+            sel = ch.get("pos")
+            raw_ov = np.asarray(host_arrays[pos])
+            ov_np = raw_ov[sel] if sel is not None else raw_ov[:n]
+            pos += 1
+            out_np = {}
+            for nm in ch["names"]:
+                raw_col = np.asarray(host_arrays[pos])
+                out_np[nm] = raw_col[sel] if sel is not None else raw_col[:n]
+                pos += 1
+            idx = np.flatnonzero(ov_np)
+            cols, ts = ch["cols"], ch["ts"]
+            if eng.kind == "filter":
+                host_env = eng._host_env(cols, ts, n)
+                key_cols = ([np.broadcast_to(
+                    np.asarray(g.fn(host_env)), (n,))
+                    for g in eng.group_exprs]
+                    if eng.group_exprs else None)
+                out_cols = eng._out_columns(
+                    out_np, idx, None, cols, idx, host_env=host_env,
+                    key_cols=key_cols)
+                if key_cols and not eng.partition_mode:
+                    from siddhi_tpu.core.query import format_group_keys
+
+                    keys = format_group_keys(key_cols, idx)
+                else:
+                    keys = None
+            else:
+                gvals = ch["gvals"]
+                sel_vals = ([gvals[int(i)] for i in idx]
+                            if gvals is not None else None)
+                out_cols = eng._out_columns(out_np, idx, None, cols, idx,
+                                            gvals=sel_vals)
+                keys = (sel_vals
+                        if eng.group_exprs and not eng.partition_mode
+                        else None)
+            parts.append((out_cols, ts[idx], keys))
+        return self._concat_parts(parts)
+
+    def _concat_parts(self, parts):
+        eng = self.engine
+        parts = [p for p in parts if len(p[1])]
+        if not parts:
+            return (eng._empty_cols(), np.empty(0, dtype=np.int64),
+                    [] if eng.group_exprs and not eng.partition_mode
+                    else None)
+        names = eng.output_names
+        out_cols = {
+            nm: np.concatenate([p[0][nm] for p in parts]) for nm in names
+        }
+        out_ts = np.concatenate(
+            [np.asarray(p[1], dtype=np.int64) for p in parts])
+        key_lists = [p[2] for p in parts]
+        if any(k is not None for k in key_lists):
+            keys = [k for kl in key_lists for k in (kl or [])]
+        else:
+            keys = None
+        return out_cols, out_ts, keys
 
 
 # ---------------------------------------------------------------------------

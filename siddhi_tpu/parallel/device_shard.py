@@ -76,15 +76,22 @@ class ShardedDeviceQueryEngine:
 
         def sharded_step(state, cols, ts, grp, valid):
             wgrp = jnp.zeros_like(grp)  # running kind ignores wgrp
-            return raw(state, cols, ts, grp, wgrp, valid)
+            new_state, ov, out, n_local = raw(state, cols, ts, grp, wgrp,
+                                              valid)
+            # count gate for the async emit pipeline: one replicated
+            # scalar the host can fetch without touching the columns
+            total = jax.lax.psum(n_local, axis_name=a)
+            return new_state, ov, out, total
 
         out_names = [nm for kind, _v, nm in engine.out_spec
                      if kind == "expr"]
-        self._step = jax.jit(jax.shard_map(
+        from siddhi_tpu.parallel.mesh import get_shard_map
+
+        self._step = jax.jit(get_shard_map()(
             sharded_step,
             mesh=mesh,
             in_specs=(specs, {k: P(a) for k in col_keys}, P(a), P(a), P(a)),
-            out_specs=(specs, P(a), {nm: P(a) for nm in out_names}),
+            out_specs=(specs, P(a), {nm: P(a) for nm in out_names}, P()),
         ), donate_argnums=(0,))
         self._P = P
         self._NamedSharding = NamedSharding
@@ -141,36 +148,52 @@ class ShardedDeviceQueryEngine:
     def process_batch(self, state, cols: Dict[str, np.ndarray],
                       ts: np.ndarray,
                       part_keys: Optional[np.ndarray] = None):
-        from siddhi_tpu.ops.device_query import MAX_DEVICE_BATCH
+        """Synchronous wrapper over the deferred path — one count-gated,
+        coalesced fetch per call (mirrors DeviceQueryEngine)."""
+        eng = self.engine
+        state, pending = self.process_batch_deferred(state, cols, ts,
+                                                     part_keys)
+        if pending is None:
+            eng.last_group_keys = (
+                [] if eng.group_exprs and not eng.partition_mode else None)
+            return state, eng._empty_cols(), np.empty(0, dtype=np.int64)
+        from siddhi_tpu.core.emit_queue import fetch_coalesced
+
+        out_cols, out_ts, keys = pending.materialize(
+            fetch_coalesced(pending.device_arrays()))
+        eng.last_group_keys = keys
+        return state, out_cols, out_ts
+
+    def process_batch_deferred(self, state, cols: Dict[str, np.ndarray],
+                               ts: np.ndarray,
+                               part_keys: Optional[np.ndarray] = None):
+        """Async-emit entry point: the psum'd match count is the only
+        scalar fetched here; match columns stay sharded on device until
+        the pending-emit queue drains them (core/emit_queue.py)."""
+        from siddhi_tpu.ops.device_query import (
+            MAX_DEVICE_BATCH,
+            DeferredDeviceEmit,
+        )
 
         eng = self.engine
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         if n == 0:
-            return state, eng._empty_cols(), np.empty(0, dtype=np.int64)
-        if n > MAX_DEVICE_BATCH:
-            # same chunk bound as the unsharded engine: the running
-            # step builds [B, B] same-group masks per shard
-            pk_all = (np.asarray(part_keys)
-                      if part_keys is not None else None)
-            chunks = []
-            all_keys = []
-            for i in range(0, n, MAX_DEVICE_BATCH):
-                sl = slice(i, i + MAX_DEVICE_BATCH)
-                state, oc, ot = self.process_batch(
-                    state, {k: np.asarray(v)[sl] for k, v in cols.items()},
-                    ts[sl], pk_all[sl] if pk_all is not None else None)
-                chunks.append((oc, ot))
-                if eng.last_group_keys is not None:
-                    all_keys.extend(eng.last_group_keys)
-            out_cols = {
-                nm: np.concatenate([c[0][nm] for c in chunks])
-                for nm in eng.output_names
-            }
-            eng.last_group_keys = (
-                all_keys if eng.group_exprs and not eng.partition_mode
-                else None)
-            return state, out_cols, np.concatenate([c[1] for c in chunks])
+            return state, None
+        pk_all = np.asarray(part_keys) if part_keys is not None else None
+        pending = DeferredDeviceEmit(eng)
+        # same chunk bound as the unsharded engine: the running step
+        # builds [B, B] same-group masks per shard
+        for i in range(0, n, MAX_DEVICE_BATCH):
+            sl = slice(i, i + MAX_DEVICE_BATCH)
+            state = self._deferred_chunk(
+                state, {k: np.asarray(v)[sl] for k, v in cols.items()},
+                ts[sl], pk_all[sl] if pk_all is not None else None, pending)
+        return state, (pending if pending.chunks else None)
+
+    def _deferred_chunk(self, state, cols, ts, pk, pending):
+        eng = self.engine
+        n = len(ts)
         if eng.base_ts is None:
             eng.base_ts = int(ts[0]) - 1
         rel64 = ts - eng.base_ts
@@ -182,10 +205,9 @@ class ShardedDeviceQueryEngine:
         rel = rel64.astype(np.int32)
         now = int(ts.max())
         if eng.partition_mode:
-            if part_keys is None:
+            if pk is None:
                 raise SiddhiAppRuntimeError(
                     "partitioned device query needs per-row partition keys")
-            pk = np.asarray(part_keys)
             # wgroup interning runs unconditionally: _wgrp_last drives
             # the idle-key purge even when composed groups carry state
             wgrp = eng._intern_wgroups(pk, now)
@@ -204,15 +226,18 @@ class ShardedDeviceQueryEngine:
             self._put(local, P(a)),
             self._put(valid, P(a)),
         )
-        state, ov, out = self._step(state, *args)
-        ov_np = np.asarray(ov)[pos]
-        idx = np.flatnonzero(ov_np)
-        out_np = {k: np.asarray(col)[pos] for k, col in out.items()}
-        out_cols = eng._out_columns(out_np, idx, grp[idx], cols, idx)
-        eng.last_group_keys = (
-            eng._keys_for_gids(grp[idx])
-            if eng.group_exprs and not eng.partition_mode else None)
-        return state, out_cols, ts[idx]
+        state, ov, out, total = self._step(state, *args)
+        if int(total) == 0:
+            return state  # count gate: no column ever fetched
+        # group key values captured now — a gid recycled before the
+        # deferred drain must not alias keys of rows already pending
+        gvals = eng._keys_for_gids(grp) if eng.group_exprs else None
+        pending.chunks.append({
+            "kind": "device", "ov": ov, "out": dict(out),
+            "names": list(out), "n": n, "pos": pos, "gvals": gvals,
+            "ts": ts, "cols": {k: np.asarray(v) for k, v in cols.items()},
+        })
+        return state
 
     def _route_part(self, gid: np.ndarray) -> np.ndarray:
         """Global gid -> the 'global partition id' route_to_shards

@@ -61,6 +61,24 @@ def ensure_virtual_devices(n_devices: int) -> bool:
             jax.config.update("jax_num_cpu_devices", n_devices)
         return True
     except Exception as e:
+        # older jax (< jax_num_cpu_devices): the XLA flag serves the
+        # same purpose and is likewise read lazily at CPU-backend init.
+        # Must run before the jax.devices() probe below — the probe
+        # itself initializes the CPU backend.
+        try:
+            import os
+
+            from jax._src import xla_bridge as _xb
+
+            if not _xb.backends_are_initialized():
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        f"{flags} --xla_force_host_platform_device_count="
+                        f"{n_devices}").strip()
+                return True
+        except Exception:
+            pass
         try:
             if len(jax.devices("cpu")) >= n_devices:
                 return True
@@ -70,6 +88,19 @@ def ensure_virtual_devices(n_devices: int) -> bool:
             f"could not configure {n_devices} virtual CPU devices "
             f"(backends already initialized?): {e}", RuntimeWarning)
         return False
+
+
+def get_shard_map():
+    """``jax.shard_map`` moved out of ``jax.experimental`` only in newer
+    jax releases — resolve whichever spelling this jax provides."""
+    import jax
+
+    try:
+        return jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "p",
@@ -213,14 +244,14 @@ class ShardedPatternEngine:
         specs = self.state_specs
 
         def sharded_step(state, part, cols, ts, valid):
-            new_state, emit, outs, anchor = step(state, part, cols, ts, valid)
-            local = jnp.sum(emit.astype(jnp.int32))
+            new_state, emit, outs, anchor, local = step(state, part, cols,
+                                                        ts, valid)
             total = jax.lax.psum(local, axis_name=a)
             return new_state, emit, outs, anchor, total
 
         # donate the state pytree: at 1M+ partitions the rows dominate
         # HBM and double-buffering them would halve capacity
-        self._step = jax.jit(jax.shard_map(
+        self._step = jax.jit(get_shard_map()(
             sharded_step,
             mesh=mesh,
             in_specs=(specs, P(a), {k: P(a) for k in self.col_keys},
@@ -296,7 +327,30 @@ class ShardedPatternEngine:
         normalizes timestamps, and flattens per-instance matches back to
         input order.  Returns ``(state, match_ev_idx[m], out[m, n_out],
         total_matches)`` with same-event matches ordered by arming age."""
-        from siddhi_tpu.ops.dense_nfa import _collision_rounds
+        state, pending, total = self.process_deferred(state, part, cols, ts)
+        if pending is None:
+            from siddhi_tpu.ops.dense_nfa import flatten_match_parts
+
+            ev, out = flatten_match_parts(
+                [], [], [], max(len(self.engine.out_spec), 1))
+            return state, ev, out, total
+        from siddhi_tpu.core.emit_queue import fetch_coalesced
+
+        ev, out = pending.materialize(fetch_coalesced(
+            pending.device_arrays()))
+        return state, ev, out, total
+
+    def process_deferred(self, state, part: np.ndarray,
+                         cols: Dict[str, np.ndarray], ts: np.ndarray):
+        """Async-emit variant of :meth:`process`: matched rounds stay
+        device-resident in a :class:`DeferredDenseEmit` (None when no
+        round matched) and only the psum'd per-round match count crosses
+        device->host here.  Returns ``(state, pending_or_None,
+        total_matches)``."""
+        from siddhi_tpu.ops.dense_nfa import (
+            DeferredDenseEmit,
+            _collision_rounds,
+        )
 
         part = np.asarray(part)
         rel64 = self.engine.rel_ts64(np.asarray(ts, dtype=np.int64))
@@ -305,9 +359,7 @@ class ShardedPatternEngine:
             to_device=lambda k, v: self._put(v, self.state_specs[k]))
         rel = rel64.astype(np.int32)
         prepared = self.engine.prepare_cols(self.stream_key, cols)
-        ev_parts: List[np.ndarray] = []
-        out_parts: List[np.ndarray] = []
-        key_parts: List[np.ndarray] = []
+        pending = DeferredDenseEmit(self.engine)
         total = 0
         for ridx in _collision_rounds(part):
             args, pos = self.route(
@@ -316,21 +368,15 @@ class ShardedPatternEngine:
                 rel[ridx],
             )
             state, emit, outs, anchor, round_total = self.step(state, *args)
-            total += int(round_total)
-            emit_np = np.asarray(emit)[pos]  # [b, 2I]
-            if emit_np.any():
-                out_f = np.asarray(outs["f"])[pos]
-                out_i = np.asarray(outs["i"])[pos]
-                anchor_np = np.asarray(anchor)[pos]
-                rows, lanes = np.nonzero(emit_np)
-                ev_parts.append(ridx[rows])
-                out_parts.append(
-                    self.engine.assemble_out(out_f, out_i, rows, lanes))
-                key_parts.append(np.stack(
-                    [ridx[rows], anchor_np[rows, lanes], lanes], axis=1))
-        from siddhi_tpu.ops.dense_nfa import flatten_match_parts
-
-        ev, out = flatten_match_parts(
-            ev_parts, out_parts, key_parts,
-            max(len(self.engine.out_spec), 1))
-        return state, ev, out, total
+            n_round = int(round_total)
+            total += n_round
+            if n_round == 0:
+                # count gate (async emit pipeline): the psum'd scalar
+                # already crossed the device boundary; zero matches
+                # means no emit/out/anchor columns are fetched at all
+                continue
+            pending.chunks.append({
+                "emit": emit, "f": outs["f"], "i": outs["i"],
+                "anchor": anchor, "sel": pos, "ridx": ridx,
+            })
+        return state, (pending if pending.chunks else None), total

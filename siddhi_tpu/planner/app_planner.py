@@ -107,6 +107,17 @@ class AppPlanner:
                         f"@app:execution: partitions="
                         f"{self.app_context.tpu_partitions} must be "
                         f"divisible by devices={nd}")
+            depth = exec_ann.element("emit.depth")
+            if depth:
+                try:
+                    ed = int(depth)
+                except ValueError:
+                    ed = -1
+                if ed < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: emit.depth='{depth}' must be a "
+                        "positive integer")
+                self.app_context.tpu_emit_depth = ed
 
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 

@@ -71,16 +71,25 @@ _query_counter = itertools.count()
 
 
 class _RateLimiterTask:
-    """Scheduler task flushing time-based rate limiters."""
+    """Scheduler task flushing time-based rate limiters.
 
-    def __init__(self, qr, limiter):
+    ``device_runtime`` (device-lowered queries): the query's device
+    runtime — its pending-emit queue drains BEFORE the limiter's time
+    decision, so queued matches land in the limiter in the same order
+    the synchronous path would deliver them (async emit pipeline flush
+    barrier)."""
+
+    def __init__(self, qr, limiter, device_runtime=None):
         self.qr = qr
         self.limiter = limiter
+        self.device_runtime = device_runtime
 
     def next_wakeup(self):
         return self.limiter.next_wakeup()
 
     def fire(self, now: int):
+        if self.device_runtime is not None:
+            self.device_runtime.drain()
         out = self.limiter.on_time(now)
         if out is not None and len(out):
             self.qr.output.send(out, now)
@@ -586,6 +595,7 @@ class QueryPlanner:
         runtime = DensePatternRuntime(
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
             key_fn=key_fn, mesh=mesh, app_context=self.app.app_context,
+            emit_depth=self.app.app_context.tpu_emit_depth,
         )
         if getattr(selector, "partition_axis", False):
             # idle-key purges must also drop the shared selector's
@@ -603,7 +613,7 @@ class QueryPlanner:
         # the task handles are kept so multi-query callers (partition
         # lowering) can unregister if a LATER query fails eligibility
         if rate_limiter.needs_scheduler_task:
-            task = _RateLimiterTask(qr, rate_limiter)
+            task = _RateLimiterTask(qr, rate_limiter, device_runtime=runtime)
             qr._rate_task = task
             self.app.scheduler.register_task(task)
         if getattr(engine, "has_deadlines", False):
@@ -759,7 +769,9 @@ class QueryPlanner:
             name, [[]], selector, rate_limiter, output, self.app.app_context)
 
         runtime = DeviceQueryRuntime(
-            engine, f"#device_{name}", emit=lambda b: qr.process(b, 0))
+            engine, f"#device_{name}", emit=lambda b: qr.process(b, 0),
+            emit_depth=self.app.app_context.tpu_emit_depth,
+            clock=self.app.app_context.timestamp_generator.current_time)
         qr.device_runtime = runtime
         if subscribe:
             junction = self.app.junction_for_input(s)
@@ -771,7 +783,8 @@ class QueryPlanner:
         if not partition_mode:
             self.app.scheduler.register_task(runtime)
             if rate_limiter.needs_scheduler_task:
-                task = _RateLimiterTask(qr, rate_limiter)
+                task = _RateLimiterTask(qr, rate_limiter,
+                                        device_runtime=runtime)
                 qr._rate_task = task
                 self.app.scheduler.register_task(task)
         qr.lowered_to = "device"

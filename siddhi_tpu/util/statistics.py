@@ -104,6 +104,19 @@ class BufferedEventsTracker:
         return q.qsize() if q is not None else 0
 
 
+class EmitTransferTracker:
+    """Device→host transfer counters of one device runtime's async emit
+    pipeline (core/emit_queue.py EmitStats): a thin gauge view so the
+    counters increment on the hot path without touching this module."""
+
+    def __init__(self, name: str, emit_stats):
+        self.name = name
+        self.emit_stats = emit_stats
+
+    def values(self) -> Dict[str, int]:
+        return self.emit_stats.as_dict()
+
+
 class StatisticsManager:
     """Tracker registry + periodic console reporter
     (reference: util/statistics/metrics/SiddhiStatisticsManager.java:35)."""
@@ -114,6 +127,9 @@ class StatisticsManager:
         self.throughput: Dict[str, ThroughputTracker] = {}
         self.latency: Dict[str, LatencyTracker] = {}
         self.buffers: Dict[str, BufferedEventsTracker] = {}
+        # per-query device→host emit-transfer gauges (async emit
+        # pipeline; one per device-lowered query)
+        self.transfers: Dict[str, EmitTransferTracker] = {}
         # per-query engine placement ('host' | 'dense' | 'device'),
         # populated at app build — not a counter, but reported alongside
         # so execution('tpu') fallbacks are visible in the metrics feed
@@ -136,6 +152,10 @@ class StatisticsManager:
     def buffer_tracker(self, name: str, junction) -> BufferedEventsTracker:
         return self.buffers.setdefault(name, BufferedEventsTracker(name, junction))
 
+    def transfer_tracker(self, name: str, emit_stats) -> EmitTransferTracker:
+        return self.transfers.setdefault(
+            name, EmitTransferTracker(name, emit_stats))
+
     def stats(self) -> Dict[str, object]:
         """Metric name -> value.  Values are floats except the
         ``Queries.<name>.loweredTo`` keys, whose values are the strings
@@ -152,6 +172,9 @@ class StatisticsManager:
             out[self._metric("Queries", l.name, "events")] = l.events
         for b in list(self.buffers.values()):
             out[self._metric("Streams", b.name, "bufferedEvents")] = b.buffered()
+        for tt in list(self.transfers.values()):
+            for metric, v in tt.values().items():
+                out[self._metric("Queries", tt.name, metric)] = v
         for qname, engine in list(self.lowering.items()):
             out[self._metric("Queries", qname, "loweredTo")] = engine
         return out

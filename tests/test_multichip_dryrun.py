@@ -22,9 +22,13 @@ def test_entry_compiles_and_steps():
     from __graft_entry__ import entry
 
     fn, args = entry()
-    out_state, emit, out_vals, emit_anchor = jax.jit(fn)(*args)
+    out_state, emit, out_vals, emit_anchor, n_emit = jax.jit(fn)(*args)
     assert set(out_state) == {"active", "first_ts", "counts", "regs", "overflow"}
     assert np.asarray(emit).dtype == bool
+    # async emit pipeline: the step returns a scalar match count so the
+    # host can skip all column transfers on zero-match batches
+    assert np.asarray(n_emit).shape == ()
+    assert np.asarray(n_emit).dtype == np.int32
 
 
 def test_sharded_engine_init_is_host_only(monkeypatch):
