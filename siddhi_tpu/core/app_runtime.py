@@ -61,6 +61,13 @@ class SiddhiAppRuntime:
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
         self._apply_statistics_level(self.app_context.root_metrics_level)
+        # fault-injection / recovery counters register UNGATED by the
+        # metrics level: when @app:faults is armed, its evidence must be
+        # visible in statistics()/REST even with statistics 'off'
+        sm = self.app_context.statistics_manager
+        fi = self.app_context.fault_injector
+        if sm is not None and fi is not None:
+            sm.fault_tracker("injector", fi.stats)
 
     # -- async emit pipeline barriers ---------------------------------------
 
@@ -126,7 +133,14 @@ class SiddhiAppRuntime:
                 s.start()
             for s in self.sources:
                 s.start()
-        except Exception:
+        except Exception as e:
+            import logging
+
+            # the rollback re-raises, but the failure must also leave a
+            # trace in the error log (the no-silent-fault contract)
+            logging.getLogger("siddhi_tpu").error(
+                "app '%s': transport start failed, rolling back the "
+                "running gate: %s", self.name, e)
             self.app_context.app_running = False
             raise
         from siddhi_tpu.util.statistics import Level
@@ -425,6 +439,12 @@ class SiddhiAppRuntime:
         finally:
             for s in self.sources:
                 s.resume()
+        jr = self.app_context.input_journal
+        if jr is not None:
+            # pin the crash-recovery journal to this checkpoint: batches
+            # recorded so far are covered by the snapshot and pruned;
+            # restore_revision(revision) will replay everything after
+            jr.mark_revision(revision)
         return revision
 
     def snapshot(self) -> bytes:
@@ -438,6 +458,56 @@ class SiddhiAppRuntime:
         # synchronous path delivered them before restore was called)
         self.drain_device_emits()
         self._snapshot_service().restore(snapshot)
+        jr = self.app_context.input_journal
+        if jr is not None:
+            # raw-bytes restore: the journal's revision mark and output
+            # ledger no longer correspond to the restored state
+            jr.reset()
+
+    def _replay_journal(self, revision: str):
+        """Restore-and-replay second half: re-send every input batch the
+        journal recorded after ``revision`` was persisted, with the
+        output ledger suppressing already-delivered callback/sink events
+        — the observable sequence ends up bit-identical to an
+        uninterrupted run (util/faults.py InputJournal)."""
+        import logging
+
+        log = logging.getLogger("siddhi_tpu")
+        jr = self.app_context.input_journal
+        if jr is None:
+            return
+        entries = jr.entries_after(revision)
+        if entries is None:
+            log.warning(
+                "app '%s': input journal cannot replay after revision "
+                "'%s' (unmarked revision or journal overflow); restored "
+                "state only — post-checkpoint input is lost", self.name,
+                revision)
+            jr.reset()
+            return
+        if not self.app_context.app_running:
+            if entries:
+                log.warning(
+                    "app '%s': %d journaled batch(es) pending but the "
+                    "app is not running; start() it before restoring to "
+                    "replay", self.name, len(entries))
+            return
+        jr.begin_replay()
+        try:
+            for stream_id, batch in entries:
+                self.input_manager.get_input_handler(stream_id).send_batch(
+                    batch)
+                if jr.stats is not None:
+                    jr.stats.replayed_batches += 1
+            # barrier INSIDE the replay window: deferred emits produced
+            # by replayed batches must flow through the suppressing
+            # ledger, not escape after end_replay as duplicates
+            self.drain_device_emits()
+        finally:
+            jr.end_replay()
+        if entries:
+            log.info("app '%s': replayed %d journaled batch(es) after "
+                     "revision '%s'", self.name, len(entries), revision)
 
     def restore_revision(self, revision: str):
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
@@ -452,20 +522,33 @@ class SiddhiAppRuntime:
             _, base_bytes, incs = chain
             self._snapshot_service().restore_incremental(
                 base_bytes, [b for _, b in incs])
+            self._replay_journal(revision)
             return
         data = store.load(self.name, revision)
         if data is None:
             raise SiddhiAppRuntimeError(
                 f"app '{self.name}': revision '{revision}' not found"
             )
-        self.restore(data)
+        # inline (not self.restore): the journal must survive the state
+        # restore so the post-checkpoint batches can replay after it
+        self.drain_device_emits()
+        self._snapshot_service().restore(data)
+        self._replay_journal(revision)
 
     def restore_last_revision(self) -> Optional[str]:
         """Restore the newest saved revision; returns its id (None when no
         revision exists — reference: SiddhiAppRuntimeImpl.restoreLastRevision).
-        With an incremental store, replays newest base + later increments."""
+        With an incremental store, replays newest base + later increments.
+        A corrupted newest revision (truncated file, bad unpickle) is
+        skipped with a warning and the walk falls back to older ones."""
+        import logging
+
+        from siddhi_tpu.core.exceptions import (
+            CannotRestoreSiddhiAppStateError,
+        )
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
 
+        log = logging.getLogger("siddhi_tpu")
         store = self._persistence_store()
         if isinstance(store, IncrementalPersistenceStore):
             chain = store.load_chain(self.name)
@@ -475,12 +558,26 @@ class SiddhiAppRuntime:
             self._snapshot_service().restore_incremental(
                 base_bytes, [b for _, b in incs]
             )
-            return incs[-1][0] if incs else base_rev
-        last = store.get_last_revision(self.name)
-        if last is None:
+            rev = incs[-1][0] if incs else base_rev
+            self._replay_journal(rev)
+            return rev
+        revs = store.revisions(self.name)
+        if not revs:
             return None
-        self.restore_revision(last)
-        return last
+        last_error = None
+        for rev in reversed(revs):
+            try:
+                self.restore_revision(rev)
+                return rev
+            except Exception as e:
+                last_error = e
+                log.warning(
+                    "app '%s': revision '%s' failed to restore (%s); "
+                    "falling back to the previous revision", self.name,
+                    rev, e)
+        raise CannotRestoreSiddhiAppStateError(
+            f"app '{self.name}': all {len(revs)} persisted revisions "
+            f"failed to restore (last error: {last_error})")
 
     def clear_all_revisions(self):
         self._persistence_store().clear_all_revisions(self.name)

@@ -62,6 +62,11 @@ class SiddhiContext:
         self.source_handler_manager = None
         self.sink_handler_manager = None
         self.record_table_handler_manager = None
+        # Crash-recovery journals keyed by app name: the journal lives on
+        # the MANAGER context so it survives a simulated runtime crash —
+        # a fresh runtime for the same app picks it up and replays
+        # post-checkpoint batches (util/faults.py InputJournal).
+        self.input_journals: Dict[str, object] = {}
 
 
 class SiddhiAppContext:
@@ -102,6 +107,13 @@ class SiddhiAppContext:
         self.snapshot_service = None  # set by app runtime
         self.statistics_manager = None
         self.exception_listeners: List = []
+        # @app:faults(...) fault-injection harness (util/faults.py).
+        # None when chaos testing is off — every hook site no-ops.
+        self.fault_injector = None
+        # Bounded input journal for restore-and-replay (util/faults.py
+        # InputJournal); shared through siddhi_context.input_journals so
+        # it outlives a crashed runtime.  None = journaling disabled.
+        self.input_journal = None
 
     def set_playback(self, enabled: bool, increment_ms: int = 0):
         self.playback = enabled

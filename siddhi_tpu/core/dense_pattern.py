@@ -235,8 +235,16 @@ class DensePatternRuntime:
         self.key_fn = key_fn
         self.mesh = mesh
         self.emit_stats = EmitStats()
-        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats)
         self._app_context = app_context  # exception-listener channel
+        # @app:faults harness: wired onto the engine (the step hook
+        # reads engine.faults) and the emit queue (drain retry +
+        # isolation); None when chaos testing is off
+        self.faults = getattr(app_context, "fault_injector", None)
+        if self.faults is not None:
+            engine.faults = self.faults
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
+                                    faults=self.faults,
+                                    on_fault=self._on_fault)
         self._sharded: Optional[Dict[str, object]] = None
         if mesh is not None:
             from siddhi_tpu.parallel.mesh import ShardedPatternEngine
@@ -565,6 +573,13 @@ class DensePatternRuntime:
         observe emit timing (snapshot/restore, timer fires, purges,
         shutdown)."""
         self.emit_queue.drain()
+
+    def _on_fault(self, e: Exception):
+        """Emit-queue fault channel: surface isolated drain/callback
+        failures to the app's exception listeners (via the injector's
+        listener list, wired to them by the planner)."""
+        if self.faults is not None:
+            self.faults.notify(e)
 
     def _emit_deferred(self, pending, ts, keys, host_arrays, now=None):
         ev_idx, out = pending.materialize(host_arrays)

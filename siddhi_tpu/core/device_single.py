@@ -64,18 +64,64 @@ class DeviceQueryRuntime:
 
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None], emit_depth: int = 1,
-                 clock: Optional[Callable[[], int]] = None):
+                 clock: Optional[Callable[[], int]] = None, faults=None):
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.state = engine.init_state()
         self.step_invocations = 0  # proof the jitted path ran (tests)
         self.emit_stats = EmitStats()
-        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats)
+        # @app:faults(...) injector: arms the emit.drain/state.poison
+        # sites and the isolation hook so a failing drain batch is
+        # logged + fed to exception listeners instead of killing the app
+        self.faults = faults
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
+                                    faults=faults, on_fault=self._on_fault)
+        # last known-poison-free host copy of the device state, kept
+        # only while a state.poison fault is armed (quarantine source)
+        self._last_good = None
         # app clock sampled at ENQUEUE time: deferred emits replay with
         # the `now` the synchronous path would have used (time-based
         # rate limiters key their period grid off it)
         self.clock = clock
+
+    def _on_fault(self, e: BaseException):
+        if self.faults is not None:
+            self.faults.notify(e)
+
+    def _poison_guard(self) -> bool:
+        """NaN/Inf quarantine, active only while a ``state.poison``
+        fault is armed.  Poisons the state when the fault trips, then
+        scans it; on detection, re-materializes from the last clean host
+        copy (or re-initializes) and reports True so the caller drops
+        the corrupted batch's outputs."""
+        fi = self.faults
+        if fi is None or not fi.watches("state.poison"):
+            return False
+        from siddhi_tpu.util import faults as _faults
+
+        if fi.poisoned("state.poison"):
+            self.state = _faults.poison_state(self.state)
+        if not _faults.state_has_poison(self.state):
+            self._last_good = _faults.host_copy(self.state)
+            return False
+        fi.stats.poison_quarantines += 1
+        eng = self.engine
+        if self._last_good is not None:
+            log.error("device state poisoned (NaN/Inf); quarantining "
+                      "batch and re-materializing last clean state")
+            if hasattr(eng, "put_state"):  # sharded: restore placement
+                self.state = eng.put_state(self._last_good)
+            else:
+                jnp = eng.jnp
+                self.state = {
+                    k: jnp.asarray(v) for k, v in self._last_good.items()
+                }
+        else:
+            log.error("device state poisoned (NaN/Inf) with no clean "
+                      "copy; quarantining batch and re-initializing")
+            self.state = eng.init_state()
+        return True
 
     # -- event path ----------------------------------------------------------
 
@@ -100,6 +146,10 @@ class DeviceQueryRuntime:
         self.state, pending = eng.process_batch_deferred(
             self.state, cols, ts, part_keys=keys)
         self.step_invocations += 1
+        if self._poison_guard():
+            # corrupted step: state was re-materialized from the last
+            # clean copy; this batch's device outputs are quarantined
+            return
         if pending is None:
             self.emit_queue.skip()
             return
@@ -181,6 +231,7 @@ class DeviceQueryRuntime:
 
     def restore(self, state: Dict):
         self.drain()
+        self._last_good = None
         eng = self.engine
         if hasattr(eng, "put_state"):  # sharded: restore the placement
             self.state = eng.put_state(state["device_state"])

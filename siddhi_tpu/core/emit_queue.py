@@ -29,9 +29,15 @@ decisions, pull queries, shutdown, debugger).
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
+
+from .exceptions import TransferFaultError
+
+log = logging.getLogger("siddhi_tpu.emit")
 
 
 class EmitStats:
@@ -103,11 +109,14 @@ def fetch_coalesced(arrays: Sequence) -> List[np.ndarray]:
             try:
                 staged.append(jnp.concatenate(
                     [arrays[i] for i in idxs], axis=0))
-            except Exception:
+            except Exception as e:
                 # heterogeneous placements (e.g. differently-sharded
                 # chunks) can refuse to concatenate — fall back to
                 # fetching the group members individually in the same
                 # device_get call
+                log.debug("fetch_coalesced: device concat refused for "
+                          "group %s (%s); fetching %d members "
+                          "individually", key, e, len(idxs))
                 staged.append(None)
     fetch = []
     for key, s in zip(keys, staged):
@@ -150,11 +159,21 @@ class PendingEmit:
 
 
 class EmitQueue:
-    """Bounded per-runtime pending-emit queue (FIFO, depth >= 1)."""
+    """Bounded per-runtime pending-emit queue (FIFO, depth >= 1).
 
-    def __init__(self, depth: int = 1, stats: Optional[EmitStats] = None):
+    ``faults`` (a ``util.faults.FaultInjector`` or None) arms the
+    ``emit.drain`` injection site and supplies the transfer retry knobs;
+    ``on_fault(exc)`` is the owning runtime's isolation hook — a drain or
+    materialize failure is routed there (fault stream / error log /
+    exception listeners) instead of propagating and killing the runtime.
+    """
+
+    def __init__(self, depth: int = 1, stats: Optional[EmitStats] = None,
+                 faults=None, on_fault: Optional[Callable] = None):
         self.depth = max(1, int(depth))
         self.stats = stats or EmitStats()
+        self.faults = faults
+        self.on_fault = on_fault
         self._entries: List[PendingEmit] = []
 
     def __len__(self) -> int:
@@ -172,11 +191,52 @@ class EmitQueue:
         """Record a zero-match batch that transferred nothing."""
         self.stats.zero_match_skips += 1
 
+    def _fetch(self, arrays: Sequence) -> List[np.ndarray]:
+        """``fetch_coalesced`` behind the ``emit.drain`` injection site,
+        with bounded retry-with-backoff on transient transfer faults
+        (sticky device loss and other errors propagate immediately)."""
+        fi = self.faults
+        if fi is None:
+            return fetch_coalesced(arrays)
+        attempts = fi.transfer_retry_attempts
+        backoff = None
+        attempt = 0
+        while True:
+            try:
+                fi.check("emit.drain")
+                host = fetch_coalesced(arrays)
+                if attempt:
+                    fi.stats.drains_recovered += 1
+                return host
+            except TransferFaultError:
+                if attempt >= attempts:
+                    raise
+                attempt += 1
+                fi.stats.transfer_retries += 1
+                if backoff is None:
+                    from ..transport.retry import BackoffRetryCounter
+
+                    backoff = BackoffRetryCounter(
+                        scale=fi.transfer_retry_scale)
+                wait_s = backoff.get_time_interval_ms() / 1000.0
+                backoff.increment()
+                log.warning("emit drain: transient transfer fault; "
+                            "retry %d/%d in %.3fs", attempt, attempts,
+                            wait_s)
+                if wait_s > 0:
+                    time.sleep(wait_s)
+
     def drain(self):
         """Flush barrier: materialize every pending entry in FIFO order
         with one coalesced transfer.  Re-entrant pushes from emit
         callbacks land in a fresh list and drain after the current
-        entries — the same order the synchronous path produces."""
+        entries — the same order the synchronous path produces.
+
+        Fault isolation: a failed fetch drops only THIS drain's entries
+        (counted in ``FaultStats.drains_failed`` and routed through
+        ``on_fault``); a failing materializer drops only its own entry
+        (``callback_faults_isolated``).  Either way the queue stays
+        usable and the runtime stays alive."""
         while self._entries:
             entries, self._entries = self._entries, []
             arrays: List = []
@@ -184,10 +244,30 @@ class EmitQueue:
             for e in entries:
                 spans.append(len(e.arrays))
                 arrays.extend(e.arrays)
+            try:
+                host = self._fetch(arrays)
+            except Exception as err:
+                fi = self.faults
+                if fi is not None:
+                    fi.stats.drains_failed += 1
+                log.error("emit drain failed; dropping %d pending "
+                          "batch(es): %s", len(entries), err)
+                if self.on_fault is not None:
+                    self.on_fault(err)
+                continue
             if any(_is_device_array(a) for a in arrays):
                 self.stats.emit_transfers += 1
-            host = fetch_coalesced(arrays)
             off = 0
             for e, n in zip(entries, spans):
-                e.materialize(host[off:off + n])
+                seg = host[off:off + n]
                 off += n
+                try:
+                    e.materialize(seg)
+                except Exception as err:
+                    fi = self.faults
+                    if fi is not None:
+                        fi.stats.callback_faults_isolated += 1
+                    log.error("emit materialize failed; dropping one "
+                              "pending batch: %s", err)
+                    if self.on_fault is not None:
+                        self.on_fault(err)

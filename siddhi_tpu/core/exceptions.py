@@ -31,6 +31,31 @@ class ConnectionUnavailableError(Exception):
     (reference: ConnectionUnavailableException)."""
 
 
+class InjectedFaultError(SiddhiAppRuntimeError):
+    """Deterministic fault raised by the fault-injection harness
+    (util/faults.py) at a runtime choke point.  No reference analog:
+    the TPU build's chaos-testing surface."""
+
+
+class TransferFaultError(InjectedFaultError):
+    """Transient device<->host transfer failure (injected, or classed
+    retryable by a hook).  The async emit pipeline retries these with
+    bounded backoff before routing to the fault handler."""
+
+
+class DeviceLostError(InjectedFaultError):
+    """Sticky device loss: NOT retryable — every transfer against the
+    lost device fails until the runtime is restored onto a healthy
+    one."""
+
+
+class SimulatedCrashError(BaseException):
+    """Injected process crash.  Deliberately a BaseException: it must
+    tear through every ``except Exception`` recovery layer exactly as a
+    SIGKILL would, so crash-recovery tests exercise the real
+    restore-and-replay path rather than some hardened catch site."""
+
+
 class OnErrorAction:
     """@OnError(action=...) values (reference: StreamJunction.OnErrorAction)."""
 

@@ -173,8 +173,14 @@ class DeviceJoinProbe:
                     k: dt for k, dt in self._lanes[ref].items()
                     if k in rec.used
                 }
-        except Exception:
-            pass  # pass 2 below decides eligibility with full lanes
+        except Exception as e:
+            # probe-only failure: pass 2 below decides eligibility with
+            # full lanes — but leave a trace (no-silent-fault contract)
+            import logging
+
+            logging.getLogger("siddhi_tpu").debug(
+                "join lane-pruning probe failed (%s); keeping full "
+                "lane set for the traceability check", e)
         # pass 2: the condition must trace over the (pruned) lane env
         env = {}
         for ref, lanes in self._lanes.items():

@@ -774,14 +774,26 @@ class InsertIntoStreamCallback(OutputCallback):
 
 
 class QueryCallbackOutput(OutputCallback):
-    """Feeds user QueryCallbacks with (ts, inEvents, removeEvents)."""
+    """Feeds user QueryCallbacks with (ts, inEvents, removeEvents).
+
+    ``app_context``/``ledger_key`` (set by QueryRuntime.add_callback)
+    plug this endpoint into the crash-recovery output ledger: during
+    restore-and-replay the journal suppresses the prefix of events these
+    callbacks already received before the crash."""
 
     def __init__(self):
         self.callbacks: List[QueryCallback] = []
+        self.app_context = None
+        self.ledger_key = None
 
     def send(self, batch: EventBatch, now: int):
         if not self.callbacks or len(batch) == 0:
             return
+        jr = getattr(self.app_context, "input_journal", None)
+        if jr is not None and self.ledger_key is not None:
+            batch = jr.deliver(self.ledger_key, batch)
+            if batch is None:
+                return
         cur = batch.only(ev.CURRENT)
         exp = batch.only(ev.EXPIRED)
         in_events = events_from_batch(cur) if len(cur) else None
@@ -850,6 +862,8 @@ class QueryRuntime:
     def add_callback(self, cb: QueryCallback):
         if self.callback_output is None:
             self.callback_output = QueryCallbackOutput()
+            self.callback_output.app_context = self.app_context
+            self.callback_output.ledger_key = ("query", self.name)
             self.output = FanOutOutput([self.output, self.callback_output])
         self.callback_output.callbacks.append(cb)
 

@@ -186,11 +186,24 @@ class StreamJunction:
             except Exception as e:  # noqa: BLE001 — fault-stream contract
                 self._handle_error(batch, e)
         if self.callbacks:
+            # crash-recovery output ledger: receivers (query chains)
+            # always reprocess during replay — they rebuild state — but
+            # user-visible callbacks get the already-delivered prefix
+            # suppressed so the observable sequence never duplicates
+            jr = getattr(self.app_context, "input_journal", None)
+            cb_batch = batch
+            if jr is not None:
+                cb_batch = jr.deliver(("stream", self.stream_id), batch)
+                if cb_batch is None:
+                    return
+            fi = getattr(self.app_context, "fault_injector", None)
             for cb in self.callbacks:
                 try:
-                    cb.receive_batch(batch)
+                    if fi is not None:
+                        fi.check("callback")
+                    cb.receive_batch(cb_batch)
                 except Exception as e:  # noqa: BLE001
-                    self._handle_error(batch, e)
+                    self._handle_error(cb_batch, e)
 
     def route_fault(self, batch: EventBatch, e: Exception) -> bool:
         """Send ``batch`` + the error into this stream's ``!stream``
@@ -258,6 +271,7 @@ class InputHandler:
             tsgen.set_event_time(e.timestamp)
         batch = batch_from_events(self.definition, events)
         with self.app_context.process_lock:
+            self._journal_and_check(batch)
             scheduler = self.app_context.scheduler
             if scheduler is not None:
                 scheduler.advance(tsgen.current_time())
@@ -270,10 +284,24 @@ class InputHandler:
             self.app_context.timestamp_generator.set_event_time(
                 int(batch.timestamps.max()))
         with self.app_context.process_lock:
+            self._journal_and_check(batch)
             scheduler = self.app_context.scheduler
             if scheduler is not None:
                 scheduler.advance(self.app_context.timestamp_generator.current_time())
             self.junction.send(batch)
+
+    def _journal_and_check(self, batch: EventBatch):
+        """Crash-recovery hook (under the process lock): journal the
+        batch for restore-and-replay, then give the ``ingest`` injection
+        site its shot.  A crash injected here fires AFTER the record —
+        the batch is committed to the journal but never delivered, the
+        exact state replay exists to repair."""
+        jr = getattr(self.app_context, "input_journal", None)
+        if jr is not None:
+            jr.record(self.junction.stream_id, batch)
+        fi = getattr(self.app_context, "fault_injector", None)
+        if fi is not None:
+            fi.check("ingest")
 
 
 class InputManager:

@@ -1513,6 +1513,9 @@ class DensePatternEngine:
         costs one scalar round trip, not a column transfer (transfers
         are expensive on tunneled/remote devices)."""
         jnp = self.jnp
+        faults = getattr(self, "faults", None)
+        if faults is not None:
+            faults.check("step.dense")
         step = self.make_step(stream_key)
         rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
         state, rel64 = self.maybe_re_anchor(state, rel64)

@@ -306,6 +306,9 @@ class DeviceQueryEngine:
         # [n_wgroups, W] device state instead of per-key Python objects)
         self.partition_mode = bool(partition_mode)
         self.n_wgroups = int(n_wgroups) if n_wgroups else n_groups
+        # fault-injection harness (util/faults.py), wired by the planner
+        # when @app:faults is present; consulted before each jitted step
+        self.faults = None
 
         s = query.input_stream
         if not isinstance(s, SingleInputStream):
@@ -1740,6 +1743,8 @@ class DeviceQueryEngine:
         if self.kind in ("filter", "running", "sliding", "keyed_sliding"):
             step = self.make_step()
             c, t, g, wg, valid, B = self._pad(cols, rel, grp, n, wgrp)
+            if self.faults is not None:
+                self.faults.check("step.device")
             state, ov, out, n_match = step(state, c, t, g, wg, valid)
             if int(n_match) == 0:
                 return state  # count gate: no column ever fetched

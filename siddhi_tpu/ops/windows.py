@@ -607,8 +607,15 @@ class SortWindow(WindowProcessor):
                     if isinstance(nxt, str) and nxt.lower() in ("asc", "desc"):
                         asc = nxt.lower() == "asc"
                         i += 1
-                except Exception:
-                    pass
+                except Exception as e:
+                    # next arg is a key expression, not an asc/desc
+                    # const — expected for non-constant args; traced so
+                    # no construction fault vanishes silently
+                    import logging
+
+                    logging.getLogger("siddhi_tpu").debug(
+                        "sort window: arg %d is not an order const "
+                        "(%s); treating it as a key expression", i + 1, e)
             self.keys.append((expr, asc))
             i += 1
         self._buf: Optional[EventBatch] = None
@@ -744,8 +751,15 @@ class LossyFrequentWindow(WindowProcessor):
                 if isinstance(v, (float, np.floating)):
                     self.error = float(v)
                     i = 2
-            except Exception:
-                pass
+            except Exception as e:
+                # arg 2 is an attribute expression, not an error-bound
+                # const — expected overload ambiguity; traced so no
+                # construction fault vanishes silently
+                import logging
+
+                logging.getLogger("siddhi_tpu").debug(
+                    "lossyFrequent window: arg 2 is not an error-bound "
+                    "const (%s); defaulting error to support/10", e)
         self.key_exprs = list(args[i:])
         self.attribute_names = attribute_names
         self._counts: Dict = {}

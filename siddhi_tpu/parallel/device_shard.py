@@ -27,6 +27,8 @@ ThreadLocal-keyed maps on one JVM (config/SiddhiAppContext.java:55-109).
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -34,8 +36,11 @@ import numpy as np
 from siddhi_tpu.core.exceptions import (
     SiddhiAppCreationError,
     SiddhiAppRuntimeError,
+    TransferFaultError,
 )
 from siddhi_tpu.parallel.mesh import route_to_shards
+
+log = logging.getLogger("siddhi_tpu.shard")
 
 
 class ShardedDeviceQueryEngine:
@@ -105,7 +110,40 @@ class ShardedDeviceQueryEngine:
     # -- sharded state -------------------------------------------------------
 
     def _put(self, x, spec):
-        return self._jax.device_put(x, self._NamedSharding(self.mesh, spec))
+        fi = getattr(self.engine, "faults", None)
+        if fi is None:
+            return self._jax.device_put(x,
+                                        self._NamedSharding(self.mesh, spec))
+        # ingest device_put behind the ingest.put injection site with the
+        # same bounded retry-with-backoff the emit drain uses
+        attempts = fi.transfer_retry_attempts
+        backoff = None
+        attempt = 0
+        while True:
+            try:
+                fi.check("ingest.put")
+                out = self._jax.device_put(
+                    x, self._NamedSharding(self.mesh, spec))
+                if attempt:
+                    fi.stats.drains_recovered += 1
+                return out
+            except TransferFaultError:
+                if attempt >= attempts:
+                    raise
+                attempt += 1
+                fi.stats.transfer_retries += 1
+                if backoff is None:
+                    from siddhi_tpu.transport.retry import BackoffRetryCounter
+
+                    backoff = BackoffRetryCounter(
+                        scale=fi.transfer_retry_scale)
+                wait_s = backoff.get_time_interval_ms() / 1000.0
+                backoff.increment()
+                log.warning("sharded ingest: transient device_put fault; "
+                            "retry %d/%d in %.3fs", attempt, attempts,
+                            wait_s)
+                if wait_s > 0:
+                    time.sleep(wait_s)
 
     def init_state(self):
         host = self.engine.init_state_host()
@@ -226,6 +264,9 @@ class ShardedDeviceQueryEngine:
             self._put(local, P(a)),
             self._put(valid, P(a)),
         )
+        fi = getattr(eng, "faults", None)
+        if fi is not None:
+            fi.check("step.shard")
         state, ov, out, total = self._step(state, *args)
         if int(total) == 0:
             return state  # count gate: no column ever fetched

@@ -77,8 +77,13 @@ def ensure_virtual_devices(n_devices: int) -> bool:
                         f"{flags} --xla_force_host_platform_device_count="
                         f"{n_devices}").strip()
                 return True
-        except Exception:
-            pass
+        except Exception as xe:
+            # internal-module probe (jax._src.xla_bridge) is version-
+            # fragile by design; fall through to the device-count probe
+            import logging
+
+            logging.getLogger("siddhi_tpu.mesh").debug(
+                "XLA_FLAGS virtual-device probe unavailable: %s", xe)
         try:
             if len(jax.devices("cpu")) >= n_devices:
                 return True
@@ -360,6 +365,9 @@ class ShardedPatternEngine:
         rel = rel64.astype(np.int32)
         prepared = self.engine.prepare_cols(self.stream_key, cols)
         pending = DeferredDenseEmit(self.engine)
+        faults = getattr(self.engine, "faults", None)
+        if faults is not None:
+            faults.check("step.shard")
         total = 0
         for ridx in _collision_rounds(part):
             args, pos = self.route(

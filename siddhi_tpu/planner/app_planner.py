@@ -135,6 +135,34 @@ class AppPlanner:
                 interval_s = float(iv)
         self.app_context.root_metrics_level = level
         self.app_context.statistics_manager = StatisticsManager(self.name, interval_s)
+
+        # @app:faults(...): deterministic chaos harness + crash-recovery
+        # journal.  The injector itself is cheap (every hook is a None
+        # check when the annotation is absent); the journal is keyed by
+        # app name on the MANAGER context so a replacement runtime built
+        # after a simulated crash inherits the pre-crash input history.
+        faults_ann = find_annotation(siddhi_app.annotations, "app:faults")
+        if faults_ann is not None:
+            from siddhi_tpu.util.faults import FaultInjector, InputJournal
+
+            fi = FaultInjector()
+            journal_depth = fi.configure_from_options(
+                self._ann_options(faults_ann))
+            fi.listeners = self.app_context.exception_listeners
+            self.app_context.fault_injector = fi
+            if journal_depth:
+                jr = siddhi_context.input_journals.get(self.name)
+                if jr is None or jr.depth != journal_depth:
+                    jr = InputJournal(depth=journal_depth)
+                    siddhi_context.input_journals[self.name] = jr
+                else:
+                    # a reused (post-crash) journal carries its counter
+                    # history into the replacement runtime's feed
+                    for k, v in jr.stats.as_dict().items():
+                        setattr(fi.stats, k, getattr(fi.stats, k) + v)
+                jr.stats = fi.stats
+                self.app_context.input_journal = jr
+
         self.scheduler = Scheduler(self.app_context)
         self.app_context.scheduler = self.scheduler
 
@@ -293,7 +321,12 @@ class AppPlanner:
                 # publish failures follow the stream's @OnError contract
                 # (reference: Sink.onError:354 routing into '!stream')
                 sink.stream_junction = junction
-                junction.subscribe(SinkStreamCallback(sink))
+                cb = SinkStreamCallback(sink)
+                if self.app_context.input_journal is not None:
+                    # output-ledger identity for replay dedup: stream id
+                    # + ordinal keeps multiple sinks on one stream apart
+                    cb.ledger_key = ("sink", definition.id, len(self.sinks))
+                junction.subscribe(cb)
                 self.sinks.append(sink)
 
     def get_or_create_junction(

@@ -743,6 +743,9 @@ class QueryPlanner:
         # running-kind queries over an N-device mesh (same treatment as
         # DensePatternRuntime's partition axis); other kinds stay
         # single-device
+        # chaos harness: the step hook reads engine.faults — set on the
+        # BASE engine so the sharded wrapper's __getattr__ still sees it
+        engine.faults = self.app.app_context.fault_injector
         nd = self.app.app_context.tpu_devices
         if nd and engine.kind == "running":
             from siddhi_tpu.parallel import ShardedDeviceQueryEngine
@@ -771,7 +774,8 @@ class QueryPlanner:
         runtime = DeviceQueryRuntime(
             engine, f"#device_{name}", emit=lambda b: qr.process(b, 0),
             emit_depth=self.app.app_context.tpu_emit_depth,
-            clock=self.app.app_context.timestamp_generator.current_time)
+            clock=self.app.app_context.timestamp_generator.current_time,
+            faults=self.app.app_context.fault_injector)
         qr.device_runtime = runtime
         if subscribe:
             junction = self.app.junction_for_input(s)

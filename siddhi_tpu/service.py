@@ -63,6 +63,9 @@ class SiddhiService:
                 elif len(parts) == 3 and parts[1] == "siddhi-query-lowering":
                     code, payload = service.query_lowering(parts[2])
                     self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-statistics":
+                    code, payload = service.statistics(parts[2])
+                    self._send(code, payload)
                 elif self.path.rstrip("/") == "/siddhi-apps":
                     self._send(200, {"status": "OK", "apps": service.app_names()})
                 else:
@@ -144,6 +147,19 @@ class SiddhiService:
                 "message": f"there is no Siddhi app named '{name}'",
             }
         return 200, {"status": "OK", "queries": runtime.lowering()}
+
+    def statistics(self, name: str):
+        """Metric feed of a deployed app — latency/throughput trackers
+        plus the fault/recovery counters (registered ungated, so chaos
+        and recovery events stay visible at statistics level 'off')."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        return 200, {"status": "OK", "metrics": runtime.statistics()}
 
     def app_names(self):
         with self._lock:

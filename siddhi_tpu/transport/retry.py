@@ -41,6 +41,12 @@ class ConnectRetryMixin:
         import threading
 
         self._retry = BackoffRetryCounter(scale=float(options.get("retry.scale", "1.0")))
+        # retry.max.attempts: bound on consecutive failed connect
+        # attempts before the transport gives up (0 = retry forever, the
+        # reference's behavior and the default)
+        self._retry_max_attempts = int(options.get("retry.max.attempts", "0"))
+        self._retry_attempts = 0
+        self.failed = False
         self._retrying = False
         self._retry_lock = threading.Lock()
         self._retry_timer = None
@@ -48,7 +54,19 @@ class ConnectRetryMixin:
 
     def start(self):
         self._shutdown = False
+        self.failed = False
+        self._retry_attempts = 0
         self._connect_with_retry()
+
+    def _on_retry_exhausted(self, e: Exception):
+        """Hook: the retry ladder ran out of attempts.  Subclasses route
+        this through their OnError machinery; the base just logs."""
+        import logging
+
+        logging.getLogger(type(self).__module__).error(
+            "%s on stream '%s' giving up after %d failed connect "
+            "attempts: %s", type(self).__name__, self.definition.id,
+            self._retry_attempts, e)
 
     def _connect_with_retry(self):
         import logging
@@ -62,8 +80,22 @@ class ConnectRetryMixin:
                 return
             self._retrying = True
         try:
+            fi = getattr(self, "_fault_injector", None)
+            if fi is not None:
+                fi.check(getattr(self, "_fault_site_connect", "connect"))
             self.connect()
         except ConnectionUnavailableError as e:
+            self._retry_attempts += 1
+            if (self._retry_max_attempts
+                    and self._retry_attempts >= self._retry_max_attempts):
+                self.failed = True
+                with self._retry_lock:
+                    self._retrying = False
+                fi = getattr(self, "_fault_injector", None)
+                if fi is not None:
+                    fi.stats.connect_retries_exhausted += 1
+                self._on_retry_exhausted(e)
+                return
             interval = self._retry.get_time_interval_ms()
             self._retry.increment()
             log.warning(
@@ -81,6 +113,8 @@ class ConnectRetryMixin:
             raise
         self.connected = True
         self._retry.reset()
+        self._retry_attempts = 0
+        self.failed = False
         with self._retry_lock:
             self._retrying = False
 

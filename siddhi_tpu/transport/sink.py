@@ -25,6 +25,7 @@ from siddhi_tpu.core.event import (
 )
 from siddhi_tpu.core.exceptions import (
     ConnectionUnavailableError,
+    InjectedFaultError,
     SiddhiAppRuntimeError,
 )
 from siddhi_tpu.extension.registry import extension
@@ -87,6 +88,10 @@ class Sink(ConnectRetryMixin):
         self.mapper = mapper
         self.app_context = app_context
         self.connected = False
+        # @app:faults harness: arms the sink.connect / sink.publish
+        # injection sites (None when chaos testing is off)
+        self._fault_injector = getattr(app_context, "fault_injector", None)
+        self._fault_site_connect = "sink.connect"
         # wired by the planner: the stream's junction, consulted for
         # the @OnError publish-failure contract
         self.stream_junction = None
@@ -172,11 +177,41 @@ class Sink(ConnectRetryMixin):
             self.on_error(payload, ConnectionUnavailableError("not connected"))
             return
         try:
+            fi = self._fault_injector
+            if fi is not None:
+                fi.check("sink.publish")
             self.publish(payload)
         except ConnectionUnavailableError as e:
             self.connected = False
             self.on_error(payload, e)
             self._connect_with_retry()
+        except InjectedFaultError as e:
+            # injected sink failure: the event routes through the same
+            # @OnError contract a real publish error would use
+            self.on_error(payload, e)
+
+    def _on_retry_exhausted(self, e: Exception):
+        """retry.max.attempts ran out: the sink is marked failed
+        (``self.failed``, set by the mixin) and the exhaustion surfaces
+        through the OnError/exception-listener machinery instead of
+        silently ending the timer chain."""
+        log.error(
+            "sink %s on stream '%s' marked FAILED after %d reconnect "
+            "attempts: %s", type(self).__name__, self.definition.id,
+            self._retry_attempts, e)
+        j = self.stream_junction
+        ev_ = getattr(self._tls, "event", None)
+        if (j is not None and ev_ is not None
+                and j.fault_junction is not None
+                and j.route_fault(batch_from_events(self.definition, [ev_]),
+                                  e)):
+            return
+        ac = getattr(self, "app_context", None)
+        for ln in list(getattr(ac, "exception_listeners", None) or []):
+            try:
+                ln(e)
+            except Exception:
+                log.exception("exception listener failed")
 
     def on_error(self, payload, e: Exception):
         """Publish-failure hook (reference Sink.onError:354): when the
@@ -195,12 +230,24 @@ class Sink(ConnectRetryMixin):
 
 
 class SinkStreamCallback:
-    """Junction subscriber adapting batches into a Sink."""
+    """Junction subscriber adapting batches into a Sink.
+
+    ``ledger_key`` (set by the planner) identifies this sink endpoint in
+    the crash-recovery output ledger: during restore-and-replay the
+    journal suppresses the prefix of events the sink already published
+    before the crash, so external observers see each event exactly
+    once."""
 
     def __init__(self, sink: Sink):
         self.sink = sink
+        self.ledger_key = None
 
     def receive(self, batch: EventBatch):
+        jr = getattr(self.sink.app_context, "input_journal", None)
+        if jr is not None and self.ledger_key is not None:
+            batch = jr.deliver(self.ledger_key, batch)
+            if batch is None:
+                return
         self.sink.send_batch(batch)
 
 

@@ -84,6 +84,9 @@ class Source(ConnectRetryMixin):
         self.junction = junction
         self.app_context = app_context
         self.connected = False
+        # @app:faults harness: arms the source.connect injection site
+        self._fault_injector = getattr(app_context, "fault_injector", None)
+        self._fault_site_connect = "source.connect"
         self._paused = False
         self._pause_buffer: List = []
         self._lock = threading.Lock()

@@ -9,9 +9,12 @@ ordering gives the latest revision.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Dict, List, Optional
+
+log = logging.getLogger("siddhi_tpu.persistence")
 
 
 class PersistenceStore:
@@ -25,6 +28,13 @@ class PersistenceStore:
 
     def get_last_revision(self, app_name: str) -> Optional[str]:
         raise NotImplementedError
+
+    def revisions(self, app_name: str) -> List[str]:
+        """All revisions, oldest first.  Default falls back to the last
+        revision only; concrete stores override with the full list so
+        restore can walk backwards past a corrupted newest revision."""
+        last = self.get_last_revision(app_name)
+        return [last] if last else []
 
     def clear_all_revisions(self, app_name: str):
         raise NotImplementedError
@@ -53,6 +63,11 @@ class InMemoryPersistenceStore(PersistenceStore):
                 return None
             return max(revs, key=lambda r: int(r.split("_", 1)[0]))
 
+    def revisions(self, app_name: str) -> List[str]:
+        with self._lock:
+            revs = self._store.get(app_name, {})
+            return sorted(revs, key=lambda r: int(r.split("_", 1)[0]))
+
     def clear_all_revisions(self, app_name: str):
         with self._lock:
             self._store.pop(app_name, None)
@@ -73,10 +88,24 @@ class FileSystemPersistenceStore(PersistenceStore):
 
     def _revisions(self, app_name: str) -> List[str]:
         d = self._app_dir(app_name)
-        if not os.path.isdir(d):
+        try:
+            names = os.listdir(d)
+        except OSError:
+            # missing or concurrently-deleted app dir: no revisions
             return []
-        # .tmp files are crash leftovers from an interrupted save
-        revs = [f for f in os.listdir(d) if "_" in f and not f.endswith(".tmp")]
+        # .tmp files are crash leftovers from an interrupted save;
+        # names without a valid <epoch_ms>_ prefix are foreign junk
+        revs = []
+        for f in names:
+            if "_" not in f or f.endswith(".tmp"):
+                continue
+            try:
+                int(f.split("_", 1)[0])
+            except ValueError:
+                log.warning("persistence: skipping foreign file %r in %s",
+                            f, d)
+                continue
+            revs.append(f)
         return sorted(revs, key=lambda r: int(r.split("_", 1)[0]))
 
     def save(self, app_name: str, revision: str, snapshot: bytes):
@@ -97,25 +126,43 @@ class FileSystemPersistenceStore(PersistenceStore):
 
     def load(self, app_name: str, revision: str) -> Optional[bytes]:
         path = os.path.join(self._app_dir(app_name), revision)
-        if not os.path.isfile(path):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            # missing file OR app dir deleted between listing and read
+            log.warning("persistence: cannot read revision %r of app "
+                        "%r (%s); skipping", revision, app_name, e)
             return None
-        with open(path, "rb") as f:
-            return f.read()
+        if not data:
+            # zero-length file: a save truncated by a crash before any
+            # bytes landed — treat as absent, restore falls back
+            log.warning("persistence: revision %r of app %r is empty "
+                        "(truncated save?); skipping", revision, app_name)
+            return None
+        return data
 
     def get_last_revision(self, app_name: str) -> Optional[str]:
         with self._lock:
             revs = self._revisions(app_name)
             return revs[-1] if revs else None
 
+    def revisions(self, app_name: str) -> List[str]:
+        with self._lock:
+            return self._revisions(app_name)
+
     def clear_all_revisions(self, app_name: str):
         with self._lock:
             d = self._app_dir(app_name)
-            if not os.path.isdir(d):
-                return
-            for f in os.listdir(d):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                return  # already gone (or never created)
+            for f in names:
                 try:
                     os.remove(os.path.join(d, f))
                 except OSError:
+                    # concurrently deleted: the goal state is reached
                     pass
 
 
@@ -158,10 +205,12 @@ class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
     def _entries(self, app_name: str) -> List[tuple]:
         """[(ts, revision, kind)] sorted by timestamp."""
         d = self._app_dir(app_name)
-        if not os.path.isdir(d):
+        try:
+            names = os.listdir(d)
+        except OSError:
             return []
         out = []
-        for f in os.listdir(d):
+        for f in names:
             if f.endswith(".tmp"):
                 continue
             if f.endswith(".base") or f.endswith(".inc"):
@@ -230,9 +279,11 @@ class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
     def clear_all_revisions(self, app_name: str):
         with self._lock:
             d = self._app_dir(app_name)
-            if not os.path.isdir(d):
-                return
-            for f in os.listdir(d):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                return  # already gone (or never created)
+            for f in names:
                 try:
                     os.remove(os.path.join(d, f))
                 except OSError:

@@ -10,8 +10,11 @@ event-driven, reference: TimestampGeneratorImpl + @app:playback).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, List, Optional, Tuple
+
+log = logging.getLogger("siddhi_tpu")
 
 # per-task fire cap within one advance(); far above any legitimate
 # timer fan (a task re-arming every fire drains one wakeup per fire)
@@ -53,6 +56,12 @@ class Scheduler:
     def advance(self, now: int):
         if now <= self._last_advance:
             return
+        fi = getattr(self.app_context, "fault_injector", None)
+        if fi is not None and fi.stalled("timer"):
+            # injected clock stall: this watermark advance is dropped —
+            # due fires are deferred until the next advance (which will
+            # deliver every elapsed wakeup via the drain loop below)
+            return
         self._last_advance = now
         # snapshot both lists: a fire may (un)register tasks mid-iteration
         # (e.g. a partition purge closing per-key instances)
@@ -76,10 +85,26 @@ class Scheduler:
                 if wake is None or wake > now or wake == prev:
                     break
                 prev = wake
-                t.fire(now)
+                try:
+                    if fi is not None:
+                        fi.check("timer.fire")
+                    t.fire(now)
+                except Exception as e:
+                    # timer-fire isolation: one failing task must not
+                    # kill the watermark advance for every other task
+                    # (SimulatedCrashError is a BaseException and still
+                    # tears through, as a real crash would)
+                    log.error("scheduler task %r failed on fire(%d): %s",
+                              t, now, e)
+                    for ln in list(
+                            getattr(self.app_context,
+                                    "exception_listeners", [])):
+                        try:
+                            ln(e)
+                        except Exception:
+                            log.exception("exception listener failed")
+                    break
             else:
-                import logging
-
                 logging.getLogger("siddhi_tpu").warning(
                     "scheduler task %r still has elapsed wakeups after "
                     "%d fires in one advance; deferring to the next tick",

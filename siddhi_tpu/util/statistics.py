@@ -117,6 +117,19 @@ class EmitTransferTracker:
         return self.emit_stats.as_dict()
 
 
+class FaultTracker:
+    """Fault-injection / recovery counters (util/faults.py FaultStats):
+    same thin-gauge pattern as EmitTransferTracker — the harness
+    increments its own counters, this view just reads them."""
+
+    def __init__(self, name: str, fault_stats):
+        self.name = name
+        self.fault_stats = fault_stats
+
+    def values(self) -> Dict[str, int]:
+        return self.fault_stats.as_dict()
+
+
 class StatisticsManager:
     """Tracker registry + periodic console reporter
     (reference: util/statistics/metrics/SiddhiStatisticsManager.java:35)."""
@@ -130,6 +143,10 @@ class StatisticsManager:
         # per-query device→host emit-transfer gauges (async emit
         # pipeline; one per device-lowered query)
         self.transfers: Dict[str, EmitTransferTracker] = {}
+        # fault-injection / recovery gauges (@app:faults harness),
+        # registered ungated so recovery events stay visible even at
+        # statistics level 'off'
+        self.faults: Dict[str, FaultTracker] = {}
         # per-query engine placement ('host' | 'dense' | 'device'),
         # populated at app build — not a counter, but reported alongside
         # so execution('tpu') fallbacks are visible in the metrics feed
@@ -156,6 +173,9 @@ class StatisticsManager:
         return self.transfers.setdefault(
             name, EmitTransferTracker(name, emit_stats))
 
+    def fault_tracker(self, name: str, fault_stats) -> FaultTracker:
+        return self.faults.setdefault(name, FaultTracker(name, fault_stats))
+
     def stats(self) -> Dict[str, object]:
         """Metric name -> value.  Values are floats except the
         ``Queries.<name>.loweredTo`` keys, whose values are the strings
@@ -175,6 +195,9 @@ class StatisticsManager:
         for tt in list(self.transfers.values()):
             for metric, v in tt.values().items():
                 out[self._metric("Queries", tt.name, metric)] = v
+        for ft in list(self.faults.values()):
+            for metric, v in ft.values().items():
+                out[self._metric("Faults", ft.name, metric)] = v
         for qname, engine in list(self.lowering.items()):
             out[self._metric("Queries", qname, "loweredTo")] = engine
         return out

@@ -1,0 +1,260 @@
+"""Crash/restore differential: checkpoint + journal replay is bit-exact.
+
+``@app:faults(journal='N')`` keeps a bounded input journal (keyed to the
+app name on the MANAGER context, so it survives the death of a runtime)
+pinned to ``persist()`` revisions.  After a simulated crash
+(``SimulatedCrashError`` — deliberately a ``BaseException`` so it tears
+through every ``except Exception`` hardening layer, like a real SIGKILL
+would), a replacement runtime restores the last revision and replays the
+post-checkpoint journal with output dedup: the callback/sink sequence
+observed across crash + recovery must be identical to a run that never
+crashed.
+
+The differential runs across all three device engines (device-single,
+dense NFA, sharded) plus a sink endpoint, and covers the degraded paths:
+journal overflow (replay refused, loss surfaced), restore before
+start, and raw-bytes restore invalidating the ledger.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import SimulatedCrashError
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+pytestmark = pytest.mark.faults
+
+DEFINE = "define stream S (k long, v double); "
+
+AGG_BODY = DEFINE + ("@info(name='q') from S#window.length(4) "
+                     "select k, sum(v) as s group by k "
+                     "insert into OutputStream;")
+PATTERN_BODY = DEFINE + (
+    "@info(name='q') from every e1=S[v > 50.0] -> e2=S[v > e1.v] "
+    "within 10 sec select e1.v as a, e2.v as b insert into OutputStream;")
+
+ENGINES = {
+    "device_single": ("@app:execution('tpu') ", AGG_BODY),
+    "dense_nfa": ("@app:execution('tpu', instances='32') ", PATTERN_BODY),
+    "sharded": ("@app:execution('tpu', partitions='16', devices='8') ",
+                AGG_BODY),
+}
+
+
+def series(n, seed=11, n_keys=3):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, size=n)
+    vals = rng.integers(1, 100, size=n).astype(float)
+    ts = 1000 + np.arange(n) * 250
+    return [([int(k), float(v)], int(t)) for k, v, t in zip(keys, vals, ts)]
+
+
+def _header(engine, faults=True):
+    exec_opts, body = ENGINES[engine]
+    h = "@app:name('crashdiff') @app:playback "
+    if faults:
+        h += "@app:faults(journal='256') "
+    return h + exec_opts + body
+
+
+def reference_run(engine, sends):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(_header(engine, faults=False))
+        got = []
+        rt.add_callback("OutputStream",
+                        lambda evs: got.extend(tuple(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(list(row), timestamp=ts)
+        rt.shutdown()
+        return got
+    finally:
+        m.shutdown()
+
+
+def crash_and_recover_run(engine, sends, persist_at, crash_at):
+    """Send ``sends[:crash_at]`` with a persist() at ``persist_at``,
+    crash on the ingest of ``sends[crash_at]``, then recover in a FRESH
+    runtime (same manager: the journal lives on the manager context) and
+    finish the stream.  Returns (outputs, recovery_runtime)."""
+    assert persist_at <= crash_at
+    m = SiddhiManager()
+    try:
+        m.set_persistence_store(InMemoryPersistenceStore())
+        rt = m.create_siddhi_app_runtime(_header(engine))
+        got = []
+        rt.add_callback("OutputStream",
+                        lambda evs: got.extend(tuple(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends[:persist_at]:
+            h.send(list(row), timestamp=ts)
+        rt.persist()
+        for row, ts in sends[persist_at:crash_at]:
+            h.send(list(row), timestamp=ts)
+        rt.app_context.fault_injector.configure("ingest", "crash", count=1)
+        with pytest.raises(SimulatedCrashError):
+            h.send(list(sends[crash_at][0]), timestamp=sends[crash_at][1])
+        rt.shutdown()  # the crashed runtime is gone
+
+        rt2 = m.create_siddhi_app_runtime(_header(engine))
+        rt2.add_callback("OutputStream",
+                         lambda evs: got.extend(tuple(e.data) for e in evs))
+        rt2.start()
+        assert rt2.restore_last_revision() is not None
+        h2 = rt2.get_input_handler("S")
+        # the crashed send WAS journaled (crash fires after the record),
+        # so replay already delivered it — continue after it
+        for row, ts in sends[crash_at + 1:]:
+            h2.send(list(row), timestamp=ts)
+        rt2.shutdown()
+        return got, rt2
+    finally:
+        m.shutdown()
+
+
+class TestCrashRecoveryDifferential:
+    @pytest.mark.parametrize("engine", sorted(ENGINES))
+    def test_recovered_sequence_bit_identical(self, engine):
+        sends = series(30)
+        ref = reference_run(engine, sends)
+        assert len(ref) > 4, "series too tame; differential is vacuous"
+        got, rt2 = crash_and_recover_run(engine, sends,
+                                         persist_at=10, crash_at=20)
+        assert got == ref, (
+            f"{engine}: crash+recover diverged from the uninterrupted run")
+        jr = rt2.app_context.input_journal
+        # sends 10..19 plus the crashed (journaled-but-undelivered) one
+        assert jr.stats.replayed_batches == 11
+        assert jr.stats.suppressed_events > 0
+
+    @pytest.mark.parametrize("engine", sorted(ENGINES))
+    def test_crash_immediately_after_persist(self, engine):
+        # only the crashed (journaled-but-undelivered) send to replay;
+        # nothing pre-crash needs suppression
+        sends = series(24)
+        ref = reference_run(engine, sends)
+        got, rt2 = crash_and_recover_run(engine, sends,
+                                         persist_at=12, crash_at=12)
+        assert got == ref
+        jr = rt2.app_context.input_journal
+        assert jr.stats.replayed_batches == 1
+        assert jr.stats.suppressed_events == 0
+
+
+class TestSinkExactlyOnce:
+    def test_sink_publishes_are_deduped_across_recovery(self):
+        from siddhi_tpu.transport.broker import (
+            FunctionSubscriber,
+            InMemoryBroker,
+        )
+
+        InMemoryBroker.clear()
+        app = ("@app:name('sinkdiff') @app:playback "
+               "@app:faults(journal='256') @app:execution('tpu') "
+               + DEFINE +
+               "@info(name='q') from S[v > 0.0] select k, v "
+               "insert into OutputStream; ")
+        app += ("@sink(type='inMemory', topic='xo') "
+                "define stream OutputStream (k long, v double);")
+        published = []
+        sub = FunctionSubscriber("xo", lambda e: published.append(
+            tuple(e.data)))
+        InMemoryBroker.subscribe(sub)
+        sends = series(12)
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(InMemoryPersistenceStore())
+            rt = m.create_siddhi_app_runtime(app)
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:4]:
+                h.send(list(row), timestamp=ts)
+            rt.persist()
+            for row, ts in sends[4:8]:
+                h.send(list(row), timestamp=ts)
+            rt.app_context.fault_injector.configure("ingest", "crash",
+                                                    count=1)
+            with pytest.raises(SimulatedCrashError):
+                h.send(list(sends[8][0]), timestamp=sends[8][1])
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            rt2.start()
+            rt2.restore_last_revision()
+            h2 = rt2.get_input_handler("S")
+            for row, ts in sends[9:]:
+                h2.send(list(row), timestamp=ts)
+            rt2.shutdown()
+        finally:
+            InMemoryBroker.unsubscribe(sub)
+            m.shutdown()
+        assert published == [(int(k), float(v)) for (k, v), _ts in sends], (
+            "sink published a duplicate or lost an event across recovery")
+
+
+class TestDegradedPaths:
+    def test_journal_overflow_refuses_replay_with_warning(self, caplog):
+        # a depth-4 journal overflows before the crash: replay would be
+        # gapped, so restore must refuse it (checkpoint-only recovery)
+        # and say so — silent divergence is the one forbidden outcome
+        import logging
+
+        sends = series(20)
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(InMemoryPersistenceStore())
+            app = ("@app:name('ovf') @app:playback "
+                   "@app:faults(journal='4') @app:execution('tpu') "
+                   + AGG_BODY)
+            rt = m.create_siddhi_app_runtime(app)
+            rt.add_callback("OutputStream", lambda evs: None)
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:4]:
+                h.send(list(row), timestamp=ts)
+            rt.persist()
+            for row, ts in sends[4:16]:  # 12 > depth 4 -> gap
+                h.send(list(row), timestamp=ts)
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            rt2.add_callback("OutputStream", lambda evs: None)
+            rt2.start()
+            with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+                assert rt2.restore_last_revision() is not None
+            assert rt2.app_context.input_journal.stats.journal_dropped > 0
+            assert any("journal" in r.message for r in caplog.records), (
+                "lost-replay condition must be surfaced in the log")
+            rt2.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_raw_bytes_restore_resets_ledger(self):
+        # restore(bytes) is positionless — the ledger must not suppress
+        # anything afterwards
+        m = SiddhiManager()
+        try:
+            app = ("@app:name('raw') @app:playback "
+                   "@app:faults(journal='64') @app:execution('tpu') "
+                   + AGG_BODY)
+            rt = m.create_siddhi_app_runtime(app)
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([0, 5.0], timestamp=1000)
+            blob = rt.snapshot()
+            rt.restore(blob)
+            jr = rt.app_context.input_journal
+            assert jr._counts == {}  # ledger forgotten
+            h.send([0, 7.0], timestamp=2000)
+            rt.shutdown()
+            assert got == [(0, 5.0), (0, 12.0)]
+        finally:
+            m.shutdown()

@@ -1,0 +1,543 @@
+"""Deterministic fault injection + hardening of the async device pipeline.
+
+``@app:faults(...)`` arms a seeded :class:`FaultInjector` on the app
+context; choke points across the transfer/runtime/transport layers call
+into it so chaos runs are reproducible.  These tests pin the hardening
+contracts:
+
+- transient transfer faults on the emit-drain path are retried with
+  backoff and fully recovered (output bit-identical to a fault-free run);
+- sticky device loss fails the affected drains but never kills the
+  runtime (per-query isolation);
+- injected callback/sink exceptions route through the @OnError fault
+  stream machinery instead of unwinding the processing chain;
+- ``retry.max.attempts`` bounds the reconnect ladder and marks the sink
+  failed through the OnError path on exhaustion;
+- clock stalls drop a scheduler advance without corrupting timer state;
+- NaN/Inf state poison is detected, quarantined, and the state
+  re-materialized from the last known-good copy;
+- every counter is visible through ``runtime.statistics()`` and the REST
+  feed even at statistics level 'off'.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import (
+    ConnectionUnavailableError,
+    DeviceLostError,
+    InjectedFaultError,
+    SimulatedCrashError,
+    TransferFaultError,
+)
+from siddhi_tpu.util.faults import FaultInjector, InputJournal
+
+pytestmark = pytest.mark.faults
+
+DEFINE = "define stream S (k long, v double); "
+FILTER_APP = DEFINE + "from S[v > 0.0] select k, v insert into OutputStream;"
+
+
+def _run(app, sends, out="OutputStream"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback(out, lambda evs: got.extend(tuple(e.data)
+                                                    for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, row in enumerate(sends):
+            h.send(list(row), timestamp=1000 + i)
+        rt.shutdown()
+        return got, rt
+    finally:
+        m.shutdown()
+
+
+class TestInjectorCore:
+    def test_seeded_probability_is_deterministic(self):
+        def trips(seed):
+            fi = FaultInjector(seed=seed)
+            fi.configure("x", "error", p=0.5, count=10 ** 9)
+            out = []
+            for _ in range(64):
+                try:
+                    fi.check("x")
+                    out.append(0)
+                except InjectedFaultError:
+                    out.append(1)
+            return out
+
+        a, b, c = trips(7), trips(7), trips(8)
+        assert a == b, "same seed must trip the same sequence"
+        assert a != c, "different seeds should diverge"
+        assert 0 < sum(a) < 64
+
+    def test_count_and_after_budgets(self):
+        fi = FaultInjector()
+        fi.configure("x", "error", count=2, after=1)
+        fi.check("x")  # skipped by after=1
+        for _ in range(2):
+            with pytest.raises(InjectedFaultError):
+                fi.check("x")
+        fi.check("x")  # budget exhausted -> clean
+        assert fi.stats.faults_injected == 2
+
+    def test_sticky_never_exhausts(self):
+        fi = FaultInjector()
+        fi.configure("x", "sticky")
+        for _ in range(5):
+            with pytest.raises(DeviceLostError):
+                fi.check("x")
+
+    def test_kind_exception_mapping(self):
+        cases = {"transient": TransferFaultError, "sticky": DeviceLostError,
+                 "error": InjectedFaultError,
+                 "conn": ConnectionUnavailableError,
+                 "crash": SimulatedCrashError}
+        for kind, exc in cases.items():
+            fi = FaultInjector()
+            fi.configure("x", kind)
+            with pytest.raises(exc):
+                fi.check("x")
+
+    def test_crash_is_not_an_Exception(self):
+        # a simulated crash must tear through `except Exception`
+        # hardening, exactly like a SIGKILL would
+        assert not issubclass(SimulatedCrashError, Exception)
+
+    def test_options_parsing(self):
+        fi = FaultInjector()
+        depth = fi.configure_from_options({
+            "seed": "42", "transfer.retry.attempts": "5",
+            "transfer.retry.scale": "0.5", "journal": "77",
+            "emit.drain": "transient:count=2:p=0.25:after=3",
+        })
+        assert depth == 77
+        assert fi.seed == 42
+        assert fi.transfer_retry_attempts == 5
+        assert fi.transfer_retry_scale == 0.5
+        spec = fi._specs["emit.drain"][0]
+        assert (spec.kind, spec.remaining, spec.p, spec.after) == (
+            "transient", 2, 0.25, 3)
+
+    @pytest.mark.parametrize("bad", ["", "transient:count", "transient:x=1",
+                                     "nosuchkind"])
+    def test_bad_specs_rejected(self, bad):
+        fi = FaultInjector()
+        with pytest.raises(ValueError):
+            fi.configure_from_options({"emit.drain": bad})
+
+
+class TestTransientDrainRecovery:
+    def test_emit_drain_transient_is_retried_and_bit_exact(self):
+        sends = [[i, float(i + 1)] for i in range(8)]
+        clean, _ = _run("@app:playback @app:execution('tpu') " + FILTER_APP,
+                        sends)
+        chaotic, rt = _run(
+            "@app:playback "
+            "@app:faults(seed='3', transfer.retry.scale='0.0001', "
+            "emit.drain='transient:count=3') "
+            "@app:execution('tpu') " + FILTER_APP, sends)
+        assert chaotic == clean, "retried drains must not lose or dup rows"
+        fi = rt.app_context.fault_injector
+        # count=3 trips on three consecutive attempts of the FIRST
+        # drain, which then succeeds on attempt 4: one recovered drain
+        assert fi.stats.faults_injected == 3
+        assert fi.stats.transfer_retries == 3
+        assert fi.stats.drains_recovered == 1
+        assert fi.stats.drains_failed == 0
+
+    def test_retry_budget_exhaustion_drops_batch_not_runtime(self):
+        # more consecutive transient faults than transfer.retry.attempts:
+        # that drain fails (batch dropped + counted) but later batches
+        # flow normally
+        sends = [[i, 1.0] for i in range(6)]
+        got, rt = _run(
+            "@app:playback "
+            "@app:faults(transfer.retry.attempts='1', "
+            "transfer.retry.scale='0.0001', "
+            "emit.drain='transient:count=2') "
+            "@app:execution('tpu') " + FILTER_APP, sends)
+        fi = rt.app_context.fault_injector
+        assert fi.stats.drains_failed == 1
+        assert len(got) == 5  # one batch of one row lost, rest intact
+
+    def test_sharded_ingest_put_transient_recovered(self):
+        sends = [[i % 4, float(i + 1)] for i in range(24)]
+        app = DEFINE + ("from S select k, sum(v) as s group by k "
+                        "insert into OutputStream;")
+        clean, _ = _run(
+            "@app:playback @app:execution('tpu', partitions='16', "
+            "devices='8') " + app, sends)
+        chaotic, rt = _run(
+            "@app:playback "
+            "@app:faults(transfer.retry.scale='0.0001', "
+            "ingest.put='transient:count=2') "
+            "@app:execution('tpu', partitions='16', devices='8') " + app,
+            sends)
+        assert chaotic == clean
+        fi = rt.app_context.fault_injector
+        assert fi.stats.faults_injected == 2
+        assert fi.stats.transfer_retries == 2
+
+
+class TestStickyDeviceLoss:
+    def test_runtime_survives_device_loss(self):
+        sends = [[i, 1.0] for i in range(5)]
+        got, rt = _run(
+            "@app:playback @app:faults(emit.drain='sticky') "
+            "@app:execution('tpu') " + FILTER_APP, sends)
+        fi = rt.app_context.fault_injector
+        assert got == []  # every drain lost to the dead device
+        assert fi.stats.drains_failed > 0
+        assert fi.stats.drains_recovered == 0
+
+    def test_isolation_routes_to_exception_listeners(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:faults(emit.drain='sticky:count=1') "
+                "@app:execution('tpu') " + FILTER_APP)
+            seen = []
+            rt.add_exception_listener(seen.append)
+            rt.add_callback("OutputStream", lambda evs: None)
+            rt.start()
+            rt.get_input_handler("S").send([1, 1.0], timestamp=1000)
+            rt.shutdown()
+            assert any(isinstance(e, DeviceLostError) for e in seen)
+        finally:
+            m.shutdown()
+
+
+class TestCallbackIsolation:
+    def test_injected_callback_error_does_not_stop_the_stream(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:faults(callback='error:count=1') "
+                + FILTER_APP)
+            got, errs = [], []
+            rt.add_exception_listener(errs.append)
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1, 1.0], timestamp=1000)  # eaten by the injection
+            h.send([2, 2.0], timestamp=1001)
+            rt.shutdown()
+            assert got == [(2, 2.0)]
+            assert any(isinstance(e, InjectedFaultError) for e in errs)
+        finally:
+            m.shutdown()
+
+
+class TestSinkFaults:
+    def setup_method(self):
+        from siddhi_tpu.transport.broker import InMemoryBroker
+
+        InMemoryBroker.clear()
+
+    def test_injected_publish_error_routes_to_fault_stream(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:faults(sink.publish='error:count=1') "
+                "@OnError(action='stream') "
+                "@sink(type='inMemory', topic='t1') "
+                "define stream S (k long, v double);")
+            faulted = []
+            rt.add_callback("!S", lambda evs: faulted.extend(
+                tuple(e.data) for e in evs))
+            from siddhi_tpu.transport.broker import (
+                FunctionSubscriber,
+                InMemoryBroker,
+            )
+            published = []
+            sub = FunctionSubscriber("t1", published.append)
+            InMemoryBroker.subscribe(sub)
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1, 1.0], timestamp=1000)
+            h.send([2, 2.0], timestamp=1001)
+            rt.shutdown()
+            InMemoryBroker.unsubscribe(sub)
+            assert len(published) == 1  # second event went through
+            assert len(faulted) == 1
+            assert faulted[0][:2] == (1, 1.0)
+            assert isinstance(faulted[0][2], InjectedFaultError)
+        finally:
+            m.shutdown()
+
+    def test_retry_max_attempts_marks_sink_failed(self):
+        import time
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:faults(sink.connect='conn:count=99') "
+                "@sink(type='inMemory', topic='t2', "
+                "retry.max.attempts='2', retry.scale='0.00002') "
+                "define stream S (k long, v double);")
+            rt.start()
+            sink = rt.sinks[0]
+            deadline = time.time() + 5.0
+            while not sink.failed and time.time() < deadline:
+                time.sleep(0.01)
+            assert sink.failed, "sink never gave up its reconnect ladder"
+            assert not sink.connected
+            fi = rt.app_context.fault_injector
+            assert fi.stats.connect_retries_exhausted == 1
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_connect_recovers_within_budget(self):
+        import time
+
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:faults(sink.connect='conn:count=1') "
+                "@sink(type='inMemory', topic='t3', "
+                "retry.max.attempts='5', retry.scale='0.00002') "
+                "define stream S (k long, v double);")
+            rt.start()
+            sink = rt.sinks[0]
+            deadline = time.time() + 5.0
+            while not sink.connected and time.time() < deadline:
+                time.sleep(0.01)
+            assert sink.connected
+            assert not sink.failed
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+
+class TestTimerStall:
+    def test_stall_drops_one_advance_then_recovers(self):
+        # timeBatch pane close rides scheduler.advance; a stalled clock
+        # must skip the fire (counted) and the NEXT advance must still
+        # close the pane — no timer-state corruption
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback @app:faults(timer='stall:count=1') "
+                + DEFINE +
+                "from S#window.timeBatch(1 sec) select sum(v) as s "
+                "insert into OutputStream;")
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            h.send([1, 10.0], timestamp=1000)
+            h.send([1, 5.0], timestamp=2500)   # advance stalled here
+            h.send([1, 2.0], timestamp=2600)   # next advance fires panes
+            rt.shutdown()
+            fi = rt.app_context.fault_injector
+            assert fi.stats.timer_stalls == 1
+            assert (10.0,) in got  # the pane still closed eventually
+        finally:
+            m.shutdown()
+
+
+class TestPoisonQuarantine:
+    APP = DEFINE + ("from S#window.length(4) select k, sum(v) as s "
+                    "insert into OutputStream;")
+
+    def test_poisoned_state_rematerialized_from_last_good(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                "@app:playback "
+                "@app:faults(state.poison='poison:count=1:after=2') "
+                "@app:execution('tpu') " + self.APP)
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, v in enumerate([1.0, 2.0, 4.0, 8.0]):
+                h.send([0, v], timestamp=1000 + i)
+            rt.shutdown()
+            fi = rt.app_context.fault_injector
+            assert fi.stats.poison_quarantines == 1
+            # batch 3 (v=4.0) was poisoned: its output is quarantined and
+            # the state rolled back to after batch 2 — batch 4 then sums
+            # over {1,2,8} instead of carrying NaN forward
+            assert got == [(0, 1.0), (0, 3.0), (0, 11.0)]
+            assert all(np.isfinite(s) for _k, s in got)
+        finally:
+            m.shutdown()
+
+    def test_poison_guard_idle_when_unarmed(self):
+        got, rt = _run("@app:playback @app:faults(seed='1') "
+                       "@app:execution('tpu') " + self.APP,
+                       [[0, 1.0], [0, 2.0]])
+        fi = rt.app_context.fault_injector
+        assert fi.stats.poison_quarantines == 0
+        assert got == [(0, 1.0), (0, 3.0)]
+
+
+class TestCountersVisible:
+    def test_statistics_and_rest_feed_expose_fault_counters(self):
+        import json
+        from urllib.request import urlopen
+
+        from siddhi_tpu.service import SiddhiService
+
+        svc = SiddhiService()
+        svc.start()
+        try:
+            code, _ = svc.deploy(
+                "@app:name('chaosApp') @app:playback "
+                "@app:faults(seed='3', transfer.retry.scale='0.0001', "
+                "emit.drain='transient:count=1') "
+                "@app:execution('tpu') " + FILTER_APP)
+            assert code in (200, 201)
+            rt = svc.get_runtime("chaosApp")
+            rt.get_input_handler("S").send([1, 1.0], timestamp=1000)
+            rt.drain_device_emits()
+            pre = "io.siddhi.SiddhiApps.chaosApp.Siddhi.Faults.injector."
+            # direct runtime feed — note @app:statistics is OFF: fault
+            # counters are registered ungated
+            stats = rt.statistics()
+            assert stats[pre + "faults_injected"] == 1
+            assert stats[pre + "transfer_retries"] == 1
+            assert stats[pre + "drains_recovered"] == 1
+            # REST feed — over real HTTP
+            with urlopen(f"http://127.0.0.1:{svc.port}"
+                         "/siddhi-statistics/chaosApp") as r:
+                body = json.loads(r.read())
+            assert body["status"] == "OK"
+            assert body["metrics"][pre + "faults_injected"] == 1
+            code, _ = svc.statistics("nosuchapp")
+            assert code == 404
+        finally:
+            svc.stop()
+            svc.manager.shutdown()
+
+
+class TestPersistenceRobustness:
+    def test_missing_directory_is_not_an_error(self, tmp_path):
+        from siddhi_tpu.util.persistence import FileSystemPersistenceStore
+
+        store = FileSystemPersistenceStore(str(tmp_path / "never_created"))
+        assert store.get_last_revision("app") is None
+        assert store.revisions("app") == []
+        store.clear_all_revisions("app")  # no raise
+
+    def test_foreign_and_truncated_files_skipped(self, tmp_path):
+        from siddhi_tpu.util.persistence import FileSystemPersistenceStore
+
+        store = FileSystemPersistenceStore(str(tmp_path))
+        store.save("app", "100_app", b"good")
+        d = tmp_path / "app"
+        (d / "junk.txt").write_bytes(b"not a revision")
+        (d / "200_app").write_bytes(b"")  # truncated save
+        assert store.load("app", "200_app") is None
+        assert store.load("app", "100_app") == b"good"
+        assert store.load("app", "999_app") is None  # missing file
+        assert store.revisions("app") == ["100_app", "200_app"]
+
+    def test_restore_falls_back_past_corrupt_newest_revision(self):
+        from siddhi_tpu.util.persistence import FileSystemPersistenceStore
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            m = SiddhiManager()
+            try:
+                m.set_persistence_store(FileSystemPersistenceStore(td))
+                rt = m.create_siddhi_app_runtime(
+                    "@app:name('fb') " + DEFINE +
+                    "from S#window.length(3) select sum(v) as s "
+                    "insert into OutputStream;")
+                rt.start()
+                h = rt.get_input_handler("S")
+                h.send([1, 5.0], timestamp=1000)
+                rev1 = rt.persist()
+                h.send([1, 7.0], timestamp=2000)
+                rev2 = rt.persist()
+                assert rev1 != rev2
+                # corrupt the NEWEST revision on disk (truncate)
+                import os
+
+                open(os.path.join(td, "fb", rev2), "wb").close()
+                got = []
+                rt.add_callback("OutputStream",
+                                lambda evs: got.extend(tuple(e.data)
+                                                       for e in evs))
+                used = rt.restore_last_revision()
+                assert used == rev1, "should fall back to the good revision"
+                h.send([1, 1.0], timestamp=3000)
+                rt.shutdown()
+                assert got == [(6.0,)]  # window holds {5.0} + 1.0
+            finally:
+                m.shutdown()
+
+    def test_all_revisions_corrupt_raises(self):
+        from siddhi_tpu.core.exceptions import CannotRestoreSiddhiAppStateError
+        from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+        class BrokenStore(InMemoryPersistenceStore):
+            def load(self, app_name, revision):
+                return b"\x00garbage"
+
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(BrokenStore())
+            rt = m.create_siddhi_app_runtime("@app:name('br') " + FILTER_APP)
+            rt.start()
+            rt.get_input_handler("S").send([1, 1.0], timestamp=1000)
+            rt.persist()
+            with pytest.raises(CannotRestoreSiddhiAppStateError):
+                rt.restore_last_revision()
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+
+class TestJournalUnit:
+    def _batch(self, n, base=0):
+        from siddhi_tpu.core.event import EventBatch
+
+        return EventBatch(
+            "S", ["k"], {"k": np.arange(base, base + n, dtype=np.int64)},
+            1000 + np.arange(n, dtype=np.int64))
+
+    def test_overflow_poisons_replay(self):
+        jr = InputJournal(depth=2)
+        jr.mark_revision("r1")
+        for i in range(4):
+            jr.record("S", self._batch(1, base=i))
+        assert jr.entries_after("r1") is None  # gapped
+        assert jr.stats.journal_dropped == 2
+
+    def test_unknown_revision_returns_none(self):
+        jr = InputJournal(depth=8)
+        jr.record("S", self._batch(1))
+        assert jr.entries_after("never_marked") is None
+
+    def test_partial_suppression_splits_batch(self):
+        jr = InputJournal(depth=8)
+        jr.mark_revision("r1")  # checkpoint taken: nothing delivered yet
+        key = ("stream", "S")
+        # 3 events delivered AFTER the checkpoint, before the crash
+        out = jr.deliver(key, self._batch(3))
+        assert len(out) == 3
+        jr.begin_replay()
+        try:
+            # replay re-emits 5 rows; first 3 suppressed, tail delivered
+            replayed = jr.deliver(key, self._batch(5))
+        finally:
+            jr.end_replay()
+        assert len(replayed) == 2
+        assert list(replayed.columns["k"]) == [3, 4]
+        assert jr.stats.suppressed_events == 3
