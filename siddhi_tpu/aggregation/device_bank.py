@@ -1,0 +1,158 @@
+"""Device-resident accumulator rows for incremental-aggregation ingest.
+
+``DeviceBucketBank`` keeps the float base fields (sum/min/max over
+FLOAT/DOUBLE arguments) of RUNNING buckets of the finest duration as
+device-resident float32 rows.  Ingest scatters each micro-batch into
+the rows in place with one jitted ``.at[rows].add/min/max`` — nothing
+crosses the device boundary per batch.  Rows materialize to the host
+bucket store only at flush barriers: watermark rollover (``_advance``),
+pull queries (``find``), snapshot/restore, and row-capacity pressure.
+
+This is the ingest-side completion of the async pipeline: the emit
+queue (core/emit_queue.py) keeps match OUTPUT device-resident between
+barriers; the bank does the same for aggregation STATE, so tpu-mode
+ingest performs no per-batch device→host flush (the former
+``_device_reduce`` fetched a [U] reduction every batch).
+
+Precision: rows are float32 — the device lane policy shared with every
+other jitted path (ops/device_query.py docstring).  Integer fields
+(count, int sums) never enter the bank; they stay on exact host numpy
+scatter ufuncs at native width.
+
+Row layout: ``cap`` assignable rows + one dump row (index ``cap``) that
+absorbs padded lanes and out-of-order events, which take the host
+merge path instead (aggregation/runtime.py ``_merge_out_of_order``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_IDENTITY = {"sum": 0.0, "count": 0.0, "min": np.inf, "max": -np.inf}
+
+
+class DeviceBucketBank:
+    """Device rows for the float base fields of running finest buckets.
+
+    ``fields``: the eligible BaseFields (op in sum/min/max, float type).
+    One [cap+1] float32 device array per field; ``rows`` maps
+    (bucket_start, group_key) -> row index.
+    """
+
+    def __init__(self, fields, cap: int = 4096):
+        self.fields = list(fields)
+        self.names: List[str] = [f.name for f in self.fields]
+        self.ops: Tuple[str, ...] = tuple(f.op for f in self.fields)
+        self.cap = int(cap)
+        self.rows: Dict[Tuple[int, Tuple], int] = {}
+        self._free: List[int] = list(range(self.cap))
+        self._arrays = None  # per-field jnp [cap+1]; lazy (jax import)
+        self._scatter = None
+        # flush-barrier evidence for tests/bench: ingest batches absorbed
+        # on device vs host materializations
+        self.scatters = 0
+        self.flushes = 0
+
+    @property
+    def dump_row(self) -> int:
+        return self.cap
+
+    # -- device arrays -------------------------------------------------------
+
+    def _ensure_arrays(self):
+        if self._arrays is not None:
+            return
+        import jax.numpy as jnp
+
+        self._arrays = [
+            jnp.full(self.cap + 1, _IDENTITY[op], dtype=jnp.float32)
+            for op in self.ops
+        ]
+
+    def _scatter_fn(self):
+        if self._scatter is None:
+            import jax
+
+            ops = self.ops
+
+            def fn(arrays, rows, vals):
+                out = []
+                for op, a, v in zip(ops, arrays, vals):
+                    if op in ("sum", "count"):
+                        out.append(a.at[rows].add(v))
+                    elif op == "min":
+                        out.append(a.at[rows].min(v))
+                    else:
+                        out.append(a.at[rows].max(v))
+                return out
+
+            self._scatter = jax.jit(fn)
+        return self._scatter
+
+    # -- row assignment ------------------------------------------------------
+
+    def assign(self, keys) -> bool:
+        """Reserve a row per key (idempotent for known keys).  Returns
+        False when the free list cannot cover the new keys — the caller
+        flushes (a capacity barrier) and retries, or falls back to the
+        host path for the batch."""
+        fresh = [k for k in keys if k not in self.rows]
+        if len(fresh) > len(self._free):
+            return False
+        for k in fresh:
+            self.rows[k] = self._free.pop()
+        return True
+
+    def scatter(self, ev_rows: np.ndarray, fvals: Dict[str, np.ndarray]):
+        """Accumulate one micro-batch in place: ``ev_rows`` [n] row per
+        event (``dump_row`` for events that take the host path),
+        ``fvals`` the per-event float columns keyed by field name.  Rows
+        are padded to a power of two so the jitted scatter sees a
+        bounded shape variety; padded lanes target the dump row with the
+        op identity."""
+        import jax.numpy as jnp
+
+        self._ensure_arrays()
+        n = len(ev_rows)
+        n_pad = max(1 << max(n - 1, 1).bit_length(), 256)
+        rows_p = np.full(n_pad, self.dump_row, dtype=np.int32)
+        rows_p[:n] = ev_rows
+        vals = []
+        for name, op in zip(self.names, self.ops):
+            col = np.full(n_pad, _IDENTITY[op], dtype=np.float32)
+            col[:n] = fvals[name].astype(np.float32)
+            vals.append(jnp.asarray(col))
+        self._arrays = self._scatter_fn()(
+            self._arrays, jnp.asarray(rows_p), vals)
+        self.scatters += 1
+
+    # -- flush barriers ------------------------------------------------------
+
+    def flush(self) -> Dict[Tuple[int, Tuple], Dict[str, float]]:
+        """Materialize every assigned row to host and reset the bank:
+        one coalesced device fetch, called only at barriers (rollover,
+        find, snapshot, capacity pressure).  Returns
+        {bucket_key: {field_name: value}}."""
+        if not self.rows:
+            return {}
+        import jax
+
+        host = [np.asarray(a) for a in jax.device_get(self._arrays)]
+        out: Dict[Tuple[int, Tuple], Dict[str, float]] = {}
+        for key, row in self.rows.items():
+            out[key] = {
+                name: float(host[fi][row])
+                for fi, name in enumerate(self.names)
+            }
+        self.flushes += 1
+        self.clear()
+        return out
+
+    def clear(self):
+        """Drop all rows and device arrays (restore path: the host
+        snapshot is the single source of truth)."""
+        self.rows.clear()
+        self._free = list(range(self.cap))
+        self._arrays = None

@@ -327,13 +327,19 @@ class AggregationRuntime:
             scope.add(ref, a.name, a.name, a.type)
         self.compiler = ExpressionCompiler(scope)
 
-        # tpu mode: float base fields reduce on the device (bucketed
-        # scatter-adds, SURVEY §7 step 5); the host store stays the
-        # single source of truth (flushed per batch, so the snapshot,
-        # rollup and on-demand surfaces are untouched)
+        # tpu mode: float base fields live device-resident (bucket bank
+        # scatter-adds, SURVEY §7 step 5) or reduce on the device; the
+        # host store stays the source of truth for snapshots, rollups
+        # and on-demand queries — in tpu mode it is completed lazily, at
+        # flush barriers (rollover/find/snapshot), not per batch
         self._device_segments = (
             app_planner.app_context.execution_mode == "tpu")
         self._device_fn = None
+        # @app:execution('tpu', agg.device.min.batch='N'): minimum batch
+        # size before the transient [U]-segment reduce rides the device
+        # (the bank path is batch-size independent)
+        self._agg_min_batch = getattr(
+            app_planner.app_context, "tpu_agg_min_batch", 512)
 
         # input filters: `from S[cond] select ...` aggregates only
         # passing rows (reference: AggregationParser wires the stream's
@@ -407,6 +413,25 @@ class AggregationRuntime:
             out_attrs.append(Attribute(nm, compiled.type))
         self.base_fields: List[BaseField] = list(rw.fields.values())
         self.field_ops: Dict[str, str] = {f.name: f.op for f in self.base_fields}
+
+        # device-resident ingest (tpu mode): float sum/min/max base
+        # fields of running finest buckets accumulate in device rows and
+        # materialize to the host store only at flush barriers
+        # (aggregation/device_bank.py); integer/last/set fields keep the
+        # exact host path at native width
+        self._bank = None
+        if self._device_segments:
+            bank_fields = [
+                f for f in self.base_fields
+                if f.op in ("sum", "min", "max")
+                and f.type in (AttrType.FLOAT, AttrType.DOUBLE)
+            ]
+            if bank_fields:
+                from siddhi_tpu.aggregation.device_bank import (
+                    DeviceBucketBank,
+                )
+
+                self._bank = DeviceBucketBank(bank_fields)
 
         self.output_definition = StreamDefinition(
             id=self.name, attributes=[Attribute(AGG_START_TS, AttrType.LONG)] + out_attrs
@@ -588,18 +613,37 @@ class AggregationRuntime:
                 ids[i] = j
             uidx = np.asarray(uidx_l, dtype=np.int64)
         U = len(uidx)
-        seg_vals, seg_last = self._reduce_segments(ids, U, fvals, ts, n)
         store = self.stores[finest]
         wm_bucket = int(bucket_starts(
             np.asarray([self.watermark]), finest)[0])
+        seg_keys = [
+            (int(buckets[int(uidx[u])]), key_at(int(uidx[u])))
+            for u in range(U)
+        ]
+        running = np.asarray([k[0] >= wm_bucket for k in seg_keys],
+                             dtype=bool)
+        # device-resident ingest: float sum/min/max fields of running
+        # buckets scatter into the bank in place and skip the host
+        # reduction entirely — no device→host flush this batch
+        bank_names = self._bank_ingest(seg_keys, running, ids, fvals)
+        host_fields = [f for f in self.base_fields
+                       if f.name not in bank_names]
+        seg_vals, seg_last = self._reduce_segments(
+            ids, U, fvals, ts, n, fields=host_fields)
+        # out-of-order events take the host merge path even for bank
+        # fields (the bank's dump row absorbed their device lanes)
+        ooo_vals: Dict[str, List] = {}
+        if bank_names and not running.all():
+            ooo_vals = self._reduce_ooo(ids, U, fvals, bank_names, running)
         for u in range(U):
-            i0 = int(uidx[u])
-            k = (int(buckets[i0]), key_at(i0))
-            values = {f.name: seg_vals[f.name][u] for f in self.base_fields}
+            k = seg_keys[u]
+            values = {f.name: seg_vals[f.name][u] for f in host_fields}
             last_ts = int(seg_last[u])
             # out-of-order below the watermark: merge straight into the
             # finished store (the reference's OutOfOrderEventsDataAggregator)
-            if k[0] < wm_bucket:
+            if not running[u]:
+                for name in bank_names:
+                    values[name] = ooo_vals[name][u]
                 self._merge_out_of_order(k, values, last_ts)
             else:
                 store.merge_into(store.running, k, values, last_ts,
@@ -608,14 +652,74 @@ class AggregationRuntime:
         self._advance(now)
         self._purge(now)
 
+    def _bank_ingest(self, seg_keys, running, ids, fvals):
+        """Scatter this batch's bank-eligible field values into the
+        device bucket bank.  Returns the set of field names the bank
+        absorbed (empty = host path for everything: no bank, or more
+        unique running buckets than the bank holds even after a
+        capacity flush)."""
+        bank = self._bank
+        if bank is None:
+            return set()
+        run_keys = [k for k, r in zip(seg_keys, running) if r]
+        if not bank.assign(run_keys):
+            # capacity barrier: materialize every row and retry once
+            self._flush_bank()
+            if not bank.assign(run_keys):
+                return set()
+        seg_rows = np.full(len(seg_keys), bank.dump_row, dtype=np.int32)
+        for u, (k, r) in enumerate(zip(seg_keys, running)):
+            if r:
+                seg_rows[u] = bank.rows[k]
+        bank.scatter(seg_rows[ids],
+                     {name: fvals[name] for name in bank.names})
+        return set(bank.names)
+
+    def _reduce_ooo(self, ids, U, fvals, names, running):
+        """Host reduction of bank fields over the OUT-OF-ORDER events
+        only (the rare late path; in-order events rode the bank)."""
+        mask = ~running[ids]
+        out: Dict[str, List] = {}
+        for name in names:
+            op = self.field_ops[name]
+            v = fvals[name]
+            if op == "sum":
+                acc = np.zeros(U, dtype=v.dtype)
+                np.add.at(acc, ids[mask], v[mask])
+            elif op == "min":
+                acc = np.full(U, np.inf, dtype=v.dtype)
+                np.minimum.at(acc, ids[mask], v[mask])
+            else:
+                acc = np.full(U, -np.inf, dtype=v.dtype)
+                np.maximum.at(acc, ids[mask], v[mask])
+            out[name] = [x.item() for x in acc]
+        return out
+
+    def _flush_bank(self):
+        """Flush barrier: materialize the device bucket rows into the
+        host running store (one coalesced fetch) — rollover, find,
+        snapshot, and capacity pressure call this; never the per-batch
+        ingest path."""
+        if self._bank is None:
+            return
+        st = self.stores[self.durations[0]]
+        for key, values in self._bank.flush().items():
+            # last_ts sentinel: bank ops are sum/min/max, ts-insensitive;
+            # the host bucket's last_ts was set at ingest time
+            st.merge_into(st.running, key, values, -(1 << 62),
+                          self.field_ops)
+
     def _reduce_segments(self, ids: np.ndarray, U: int,
                          fvals: Dict[str, np.ndarray], ts: np.ndarray,
-                         n: int):
+                         n: int, fields=None):
         """Per-segment field reductions: {name: [U] python-typed
         values}, seg_last_ts [U].  Numeric sum/count/min/max fields
         reduce with np scatter ufuncs (or one jitted device scatter in
         tpu mode); 'last'/'set'/object fields walk sorted segment
-        slices."""
+        slices.  ``fields`` restricts the reduction (the device bucket
+        bank absorbs its fields upstream); default all base fields."""
+        if fields is None:
+            fields = self.base_fields
         seg_vals: Dict[str, List] = {}
         # min-init (not zero): pre-epoch/negative timestamps must win
         seg_last = np.full(U, np.iinfo(np.int64).min, dtype=np.int64)
@@ -623,7 +727,7 @@ class AggregationRuntime:
 
         scatter_fields = []
         slice_fields = []
-        for f in self.base_fields:
+        for f in fields:
             v = fvals[f.name]
             if (f.op in ("sum", "count", "min", "max")
                     and v.dtype.kind in "iuf"):
@@ -635,7 +739,7 @@ class AggregationRuntime:
         # (float32 lanes = the device precision policy); int fields stay
         # on exact numpy scatter ufuncs at native width
         dev = [f for f in scatter_fields
-               if self._device_segments and n >= 512
+               if self._device_segments and n >= self._agg_min_batch
                and fvals[f.name].dtype.kind == "f"]
         for f, col in zip(dev, self._device_reduce(ids, U, fvals, dev)):
             seg_vals[f.name] = [x.item() for x in col]
@@ -756,6 +860,13 @@ class AggregationRuntime:
         """Flush every running bucket that the watermark has passed, cascading
         base values into the parent duration."""
         wm = self.watermark
+        if self._bank is not None and self._bank.rows:
+            # rollover barrier: a finest bucket is about to complete, so
+            # its device rows must reach the host store first; one
+            # coalesced fetch covers every pending bank row
+            finest = self.durations[0]
+            if any(bucket_end(k[0], finest) <= wm for k in self._bank.rows):
+                self._flush_bank()
         for d in self.durations:
             st = self.stores[d]
             done = [k for k in st.running if bucket_end(k[0], d) <= wm]
@@ -782,6 +893,9 @@ class AggregationRuntime:
             raise SiddhiAppCreationError(
                 f"aggregation '{self.name}': per '{per}' is not one of {self.durations}"
             )
+        # pull-query barrier: running buckets' device rows must be
+        # host-visible before the stitch below reads them
+        self._flush_bank()
         # union of finished + running at `per`, plus roll-up of finer running
         merged: Dict[Tuple[int, Tuple], _Bucket] = {}
         ops = self.field_ops
@@ -846,6 +960,10 @@ class AggregationRuntime:
     # -- snapshot -----------------------------------------------------------
 
     def snapshot(self) -> Dict:
+        # persistence barrier: the host store must be complete — device
+        # bucket rows would otherwise be lost with the process
+        self._flush_bank()
+
         def dump(d: Dict[Tuple[int, Tuple], _Bucket]):
             return [(k, b.values, b.last_ts) for k, b in d.items()]
 
@@ -858,6 +976,10 @@ class AggregationRuntime:
         }
 
     def restore(self, state: Dict):
+        # the restored host snapshot is the single source of truth;
+        # pre-restore device rows are stale
+        if self._bank is not None:
+            self._bank.clear()
         self.watermark = state["watermark"]
         for d, st_state in state["stores"].items():
             st = self.stores[d]

@@ -107,9 +107,16 @@ class SiddhiAppRuntime:
             if hasattr(qr, "debugger"):
                 qr.debugger = debugger
         # breakpoints must observe every emit at its own batch: force
-        # the pending-emit queue to drain after each step
+        # the pending-emit queue to drain after each step (and pin it —
+        # an auto controller would re-deepen it), and collapse the
+        # ingest staging window back to synchronous
         for rt in self._device_runtimes():
             rt.emit_queue.depth = 1
+            rt.emit_queue.controller = None
+            stage = getattr(rt, "ingest_stage", None)
+            if stage is not None:
+                stage.flush()
+                stage.depth = 1
         self.start()
         return debugger
 
@@ -280,11 +287,14 @@ class SiddhiAppRuntime:
             sm.latency.clear()
             sm.lowering.clear()
             sm.transfers.clear()
+            sm.ingests.clear()
         else:
             sm.lowering.update(self.lowering())
-            # async emit pipeline transfer counters, one gauge per
-            # device-lowered query (emitTransfers / deferredBatches /
-            # zeroMatchSkips / maxPendingDepth)
+            # async pipeline counters, one gauge pair per device-lowered
+            # query: emit side (emitTransfers / deferredBatches /
+            # zeroMatchSkips / maxPendingDepth / autoEffectiveDepth) and
+            # ingest side (stagedBatches / devicePuts / ingestStalls /
+            # overlappedBatches / flushSyncs / maxStagingDepth)
             for name, qr in list(self.query_runtimes.items()) + [
                 (n, q)
                 for pr in self.partitions.values()
@@ -294,6 +304,8 @@ class SiddhiAppRuntime:
                     rt = getattr(qr, attr, None)
                     if rt is not None and hasattr(rt, "emit_stats"):
                         sm.transfer_tracker(name, rt.emit_stats)
+                    if rt is not None and hasattr(rt, "ingest_stats"):
+                        sm.ingest_tracker(name, rt.ingest_stats)
         if not detail:
             sm.buffers.clear()
         for j in self.junctions.values():

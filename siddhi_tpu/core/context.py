@@ -98,7 +98,18 @@ class SiddhiAppContext:
         # depth of the async emit pipeline (core/emit_queue.py) — device
         # runtimes hold up to N matched batches device-resident before
         # one coalesced drain.  1 (default) drains after every batch.
+        # 'auto' derives the effective depth at runtime from observed
+        # transfer RTT vs batch cadence (EmitDepthController).
         self.tpu_emit_depth = 1
+        # @app:execution('tpu', ingest.depth='N'): ingest staging window
+        # (core/ingest_stage.py) — each batch's count-gate fetch defers
+        # until N-1 later batches have dispatched, overlapping H2D
+        # transfer with the jitted step.  1 (default) = synchronous.
+        self.tpu_ingest_depth = 1
+        # @app:execution('tpu', agg.device.min.batch='N'): minimum batch
+        # size before incremental aggregation uses the jitted device
+        # segment-reduce instead of the host np.add.at path
+        self.tpu_agg_min_batch = 512
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -226,8 +226,10 @@ class DensePatternRuntime:
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None],
                  key_fn: Optional[Callable] = None,
-                 mesh=None, app_context=None, emit_depth: int = 1):
+                 mesh=None, app_context=None, emit_depth=1,
+                 ingest_depth: int = 1):
         from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats
+        from siddhi_tpu.core.ingest_stage import IngestStage, IngestStats
 
         self.engine = engine
         self.out_stream_id = out_stream_id
@@ -245,6 +247,16 @@ class DensePatternRuntime:
         self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
                                     faults=self.faults,
                                     on_fault=self._on_fault)
+        # ingest staging window (@app:execution('tpu', ingest.depth='N')):
+        # depth 2 defers each batch's match-count fetch until the next
+        # batch's H2D puts + step dispatch are in flight; depth 1
+        # (default) finishes inline, matching synchronous timing.  The
+        # engine carries the stats ref so staged_put counts device puts.
+        self.ingest_stats = IngestStats()
+        engine.ingest_stats = self.ingest_stats
+        self.ingest_stage = IngestStage(
+            depth=ingest_depth, stats=self.ingest_stats, faults=self.faults,
+            on_fault=self._on_fault)
         self._sharded: Optional[Dict[str, object]] = None
         if mesh is not None:
             from siddhi_tpu.parallel.mesh import ShardedPatternEngine
@@ -545,7 +557,7 @@ class DensePatternRuntime:
         if len(ts):
             np.maximum.at(self._row_last_used, part, ts)
         if self._sharded is not None:
-            self.state, pending, _total = self._sharded[
+            self.state, pending = self._sharded[
                 stream_key].process_deferred(self.state, part, cols, ts)
         else:
             self.state, pending = eng.process_deferred(
@@ -555,23 +567,36 @@ class DensePatternRuntime:
             self._wake_dirty = True
         if self.step_invocations % self._OVF_POLL == 0:
             self._check_overflow()
-        if pending is None:
-            self.emit_queue.skip()
-            return
         from siddhi_tpu.core.emit_queue import PendingEmit
 
+        # clock sampled at RECEIVE time: the finish step may run a batch
+        # later (ingest.depth > 1) but replays the synchronous `now`
         now = (self._app_context.timestamp_generator.current_time()
                if self._app_context is not None else None)
-        self.emit_queue.push(PendingEmit(
-            pending.device_arrays(),
-            lambda host, p=pending, t=ts, k=keys, n=now: self._emit_deferred(
-                p, t, k, host, now=n)))
+
+        def _finish(p=pending, t=ts, k=keys, n=now):
+            if p is None or p.resolve() == 0:
+                self.emit_queue.skip()
+                return
+            self.emit_queue.push(PendingEmit(
+                p.device_arrays(),
+                lambda host, pp=p, tt=t, kk=k, nn=n: self._emit_deferred(
+                    pp, tt, kk, host, now=nn)))
+
+        # the match-count fetch (resolve) is the blocking device sync;
+        # staging it lets batch N+1's H2D puts + step dispatch go out
+        # before batch N's count scalar is fetched
+        self.ingest_stage.submit(
+            pending.probe() if pending is not None else None, _finish)
 
     def drain(self):
         """Flush barrier: materialize and emit every queued match batch
         (one coalesced transfer) — called wherever host code could
         observe emit timing (snapshot/restore, timer fires, purges,
-        shutdown)."""
+        shutdown).  The ingest stage flushes first: staged batches must
+        enqueue (or skip) before the emit queue drains, preserving the
+        synchronous callback order."""
+        self.ingest_stage.flush()
         self.emit_queue.drain()
 
     def _on_fault(self, e: Exception):

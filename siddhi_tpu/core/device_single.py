@@ -35,6 +35,7 @@ import numpy as np
 from siddhi_tpu.core import event as ev
 from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats, PendingEmit
 from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.ingest_stage import IngestStage, IngestStats
 from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
 
 import logging
@@ -63,8 +64,9 @@ class DeviceQueryRuntime:
     scheduler contract)."""
 
     def __init__(self, engine, out_stream_id: str,
-                 emit: Callable[[EventBatch], None], emit_depth: int = 1,
-                 clock: Optional[Callable[[], int]] = None, faults=None):
+                 emit: Callable[[EventBatch], None], emit_depth=1,
+                 clock: Optional[Callable[[], int]] = None, faults=None,
+                 ingest_depth: int = 1):
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
@@ -77,6 +79,17 @@ class DeviceQueryRuntime:
         self.faults = faults
         self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
                                     faults=faults, on_fault=self._on_fault)
+        # ingest staging window (@app:execution('tpu', ingest.depth='N')):
+        # depth 2 defers each batch's count-gate fetch until the NEXT
+        # batch's H2D put + step dispatch are in flight, overlapping
+        # transfer with compute; depth 1 (default) finishes inline —
+        # identical timing to synchronous ingest.  The engine carries the
+        # stats ref so staged_put (ops layer) counts its device puts.
+        self.ingest_stats = IngestStats()
+        engine.ingest_stats = self.ingest_stats
+        self.ingest_stage = IngestStage(
+            depth=ingest_depth, stats=self.ingest_stats, faults=faults,
+            on_fault=self._on_fault)
         # last known-poison-free host copy of the device state, kept
         # only while a state.poison fault is armed (quarantine source)
         self._last_good = None
@@ -150,19 +163,33 @@ class DeviceQueryRuntime:
             # corrupted step: state was re-materialized from the last
             # clean copy; this batch's device outputs are quarantined
             return
-        if pending is None:
-            self.emit_queue.skip()
-            return
+        # `now` is the clock the SYNCHRONOUS path would have read; the
+        # finish step may run a batch later (ingest.depth > 1), so it is
+        # captured here, at receive time
         now = self.clock() if self.clock is not None else None
-        self.emit_queue.push(PendingEmit(
-            pending.device_arrays(),
-            lambda host, p=pending, t=now: self._emit_deferred(p, host, t)))
+
+        def _finish(p=pending, t=now):
+            if p is None or p.resolve() == 0:
+                self.emit_queue.skip()
+                return
+            self.emit_queue.push(PendingEmit(
+                p.device_arrays(),
+                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt)))
+
+        # the count-gate fetch (resolve) is what blocks on the device;
+        # staging it lets batch N+1's H2D put + step dispatch go out
+        # before batch N's scalar is fetched
+        self.ingest_stage.submit(
+            pending.probe() if pending is not None else None, _finish)
 
     def drain(self):
         """Flush barrier: materialize and emit every queued batch (one
         coalesced transfer).  Called wherever host code could observe
         emit timing — snapshot/restore, timer fires, rate-limiter
-        decisions, pull queries, shutdown, debugger."""
+        decisions, pull queries, shutdown, debugger.  The ingest stage
+        flushes first: staged batches must enqueue (or skip) before the
+        emit queue drains, preserving the synchronous callback order."""
+        self.ingest_stage.flush()
         self.emit_queue.drain()
 
     def _emit_deferred(self, pending, host_arrays, now=None):

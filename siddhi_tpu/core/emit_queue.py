@@ -46,13 +46,16 @@ class EmitStats:
     util/statistics.py)."""
 
     __slots__ = ("emit_transfers", "deferred_batches", "zero_match_skips",
-                 "max_pending_depth")
+                 "max_pending_depth", "auto_depth")
 
     def __init__(self):
         self.emit_transfers = 0
         self.deferred_batches = 0
         self.zero_match_skips = 0
         self.max_pending_depth = 0
+        # effective depth the 'auto' controller is currently running at
+        # (0 = static emit.depth, no controller)
+        self.auto_depth = 0
 
     def note_depth(self, depth: int):
         if depth > self.max_pending_depth:
@@ -64,6 +67,7 @@ class EmitStats:
             "deferredBatches": self.deferred_batches,
             "zeroMatchSkips": self.zero_match_skips,
             "maxPendingDepth": self.max_pending_depth,
+            "autoEffectiveDepth": self.auto_depth,
         }
 
 
@@ -158,6 +162,66 @@ class PendingEmit:
         self.materialize = materialize
 
 
+class EmitDepthController:
+    """Adaptive queue depth for ``emit.depth='auto'``.
+
+    The right static depth is "how many junction batches arrive during
+    one device→host drain round trip": deeper coalesces more transfers
+    per RTT, but anything past that only delays callbacks.  Both inputs
+    drift at runtime (tunnel RTT is load-dependent, batch cadence is the
+    workload's), so the controller keeps decaying averages of the
+    inter-push gap (sampled at ``note_push``) and the drain fetch time
+    (``note_drain``) and re-derives
+
+        effective_depth = clamp(ceil(rtt_ema / gap_ema), 1, AUTO_DEPTH_MAX)
+
+    after every sample.  The EMA weight makes old samples decay with a
+    ~1/ALPHA-sample window, so a match-rate or RTT shift re-converges
+    within a few drains.  AUTO_DEPTH_MAX bounds the queue exactly like a
+    hand-written ``emit.depth`` would — auto can never grow the pending
+    window past it.
+    """
+
+    AUTO_DEPTH_MAX = 32
+    ALPHA = 0.2  # decaying-window weight (newest sample's share)
+
+    __slots__ = ("_gap_ema", "_rtt_ema", "_last_push", "effective_depth")
+
+    def __init__(self):
+        self._gap_ema: Optional[float] = None
+        self._rtt_ema: Optional[float] = None
+        self._last_push: Optional[float] = None
+        self.effective_depth = 1
+
+    def _ema(self, old: Optional[float], sample: float) -> float:
+        if old is None:
+            return sample
+        return old + self.ALPHA * (sample - old)
+
+    def note_push(self, t: Optional[float] = None):
+        """One queued batch; ``t`` (monotonic seconds) is injectable
+        for tests."""
+        if t is None:
+            t = time.monotonic()
+        if self._last_push is not None:
+            self._gap_ema = self._ema(self._gap_ema, t - self._last_push)
+        self._last_push = t
+        self._recompute()
+
+    def note_drain(self, seconds: float):
+        """Observed fetch wall time of one coalesced drain."""
+        self._rtt_ema = self._ema(self._rtt_ema, seconds)
+        self._recompute()
+
+    def _recompute(self):
+        if not self._gap_ema or self._rtt_ema is None:
+            return  # no cadence yet (first batch) — stay at current depth
+        import math
+
+        depth = math.ceil(self._rtt_ema / self._gap_ema)
+        self.effective_depth = max(1, min(depth, self.AUTO_DEPTH_MAX))
+
+
 class EmitQueue:
     """Bounded per-runtime pending-emit queue (FIFO, depth >= 1).
 
@@ -168,8 +232,16 @@ class EmitQueue:
     exception listeners) instead of propagating and killing the runtime.
     """
 
-    def __init__(self, depth: int = 1, stats: Optional[EmitStats] = None,
+    def __init__(self, depth=1, stats: Optional[EmitStats] = None,
                  faults=None, on_fault: Optional[Callable] = None):
+        # depth 'auto': bounded self-tuning — a controller re-derives
+        # the effective depth from observed drain RTT vs push cadence
+        # (never past its AUTO_DEPTH_MAX bound).  The debugger disables
+        # the controller when it forces depth 1.
+        self.controller: Optional[EmitDepthController] = None
+        if depth == "auto":
+            self.controller = EmitDepthController()
+            depth = 1
         self.depth = max(1, int(depth))
         self.stats = stats or EmitStats()
         self.faults = faults
@@ -180,6 +252,10 @@ class EmitQueue:
         return len(self._entries)
 
     def push(self, entry: PendingEmit):
+        if self.controller is not None:
+            self.controller.note_push()
+            self.depth = self.controller.effective_depth
+            self.stats.auto_depth = self.depth
         self._entries.append(entry)
         self.stats.note_depth(len(self._entries))
         if len(self._entries) >= self.depth:
@@ -244,6 +320,9 @@ class EmitQueue:
             for e in entries:
                 spans.append(len(e.arrays))
                 arrays.extend(e.arrays)
+            had_device = any(_is_device_array(a) for a in arrays)
+            t0 = (time.monotonic()
+                  if self.controller is not None and had_device else None)
             try:
                 host = self._fetch(arrays)
             except Exception as err:
@@ -255,7 +334,9 @@ class EmitQueue:
                 if self.on_fault is not None:
                     self.on_fault(err)
                 continue
-            if any(_is_device_array(a) for a in arrays):
+            if t0 is not None:
+                self.controller.note_drain(time.monotonic() - t0)
+            if had_device:
                 self.stats.emit_transfers += 1
             off = 0
             for e, n in zip(entries, spans):

@@ -1494,6 +1494,8 @@ class DensePatternEngine:
         ``match_out[m, n_out]`` its output values."""
         state, pending = self.process_deferred(state, stream_key, part_idx,
                                                cols, ts)
+        if pending is not None and pending.resolve() == 0:
+            pending = None
         if pending is None:
             return state, *flatten_match_parts(
                 [], [], [], max(len(self.out_spec), 1))
@@ -1505,17 +1507,19 @@ class DensePatternEngine:
 
     def process_deferred(self, state, stream_key: str, part_idx: np.ndarray,
                          cols: Dict[str, np.ndarray], ts: np.ndarray):
-        """Async-emit variant of :meth:`process`: match outputs of rounds
-        whose count gate fired stay resident on device inside the
-        returned :class:`DeferredDenseEmit` (None when no round
-        matched).  Only the per-round ``n_emit`` scalar crosses
-        device->host here — matches are rare in CEP, so the common batch
-        costs one scalar round trip, not a column transfer (transfers
-        are expensive on tunneled/remote devices)."""
-        jnp = self.jnp
+        """Async-emit variant of :meth:`process`: every round's match
+        outputs stay resident on device inside the returned
+        :class:`DeferredDenseEmit` (None only for empty input).  NOTHING
+        crosses device->host here — even the per-round ``n_emit`` count
+        gate stays a device scalar until ``resolve()`` fetches it, which
+        the ingest stage (core/ingest_stage.py) defers past the next
+        batch's dispatch so the H2D transfer overlaps this batch's
+        step."""
         faults = getattr(self, "faults", None)
         if faults is not None:
             faults.check("step.dense")
+        from siddhi_tpu.core.ingest_stage import staged_put
+
         step = self.make_step(stream_key)
         rel64 = self.rel_ts64(np.asarray(ts, dtype=np.int64))
         state, rel64 = self.maybe_re_anchor(state, rel64)
@@ -1535,15 +1539,22 @@ class DensePatternEngine:
             for k, v in prepared.items():
                 col = np.zeros(bp, dtype=v.dtype)
                 col[:b] = v[ridx]
-                cb[k] = jnp.asarray(col)
+                cb[k] = col
+            # one pytree H2D put per round behind the ingest.put fault
+            # site (core/ingest_stage.py — the sanctioned ingest path)
+            pi, cb, tb, valid = staged_put(
+                (pi, cb, tb, valid), faults=faults,
+                stats=getattr(self, "ingest_stats", None))
             state, emit, outs, emit_anchor, n_emit = step(
-                state, jnp.asarray(pi), cb, jnp.asarray(tb), jnp.asarray(valid)
+                state, pi, cb, tb, valid
             )
-            if int(n_emit):
-                pending.chunks.append({
-                    "emit": emit, "f": outs["f"], "i": outs["i"],
-                    "anchor": emit_anchor, "sel": slice(0, b), "ridx": ridx,
-                })
+            # count gate deferred: n_emit stays a device scalar until
+            # DeferredDenseEmit.resolve() (driven by the ingest stage)
+            pending.chunks.append({
+                "emit": emit, "f": outs["f"], "i": outs["i"],
+                "anchor": emit_anchor, "sel": slice(0, b), "ridx": ridx,
+                "count": n_emit,
+            })
         return state, (pending if pending.chunks else None)
 
     def assemble_out(self, out_f: np.ndarray, out_i: np.ndarray,
@@ -1661,11 +1672,33 @@ class DeferredDenseEmit:
     synchronous path returns.
     """
 
-    __slots__ = ("engine", "chunks")
+    __slots__ = ("engine", "chunks", "_total")
 
     def __init__(self, engine):
         self.engine = engine
         self.chunks: List[dict] = []
+        self._total: Optional[int] = None
+
+    def probe(self):
+        """Device scalar marking step completion (ingest-stage overlap
+        evidence); None when no round dispatched."""
+        return self.chunks[0]["count"] if self.chunks else None
+
+    def resolve(self) -> int:
+        """Fetch the deferred per-round count gates (scalars only) and
+        prune rounds that matched nothing, so their column banks are
+        never transferred.  Idempotent; returns total match count."""
+        if self._total is not None:
+            return self._total
+        if self.chunks:
+            import jax
+
+            counts = jax.device_get([ch["count"] for ch in self.chunks])
+        else:
+            counts = []
+        self.chunks = [ch for ch, c in zip(self.chunks, counts) if int(c)]
+        self._total = int(sum(int(c) for c in counts))
+        return self._total
 
     def device_arrays(self) -> List:
         arrs: List = []

@@ -1552,7 +1552,6 @@ class DeviceQueryEngine:
         return out
 
     def _pad(self, cols, rel, grp, n, wgrp=None):
-        jnp = self.jnp
         B = _pow2(n)
         valid = np.zeros(B, dtype=bool)
         valid[:n] = True
@@ -1562,15 +1561,15 @@ class DeviceQueryEngine:
             col = np.zeros(B, dtype=lane)
             if k in cols:
                 col[:n] = np.asarray(cols[k])[:n].astype(lane)
-            c[k] = jnp.asarray(col)
+            c[k] = col
         for k in self.long_attrs:
             hi = np.zeros(B, dtype=np.int32)
             lo = np.zeros(B, dtype=np.int32)
             if k in cols:
                 h, l = _split_i64(np.asarray(cols[k])[:n])
                 hi[:n], lo[:n] = h, l
-            c[k + "|hi"] = jnp.asarray(hi)
-            c[k + "|lo"] = jnp.asarray(lo)
+            c[k + "|hi"] = hi
+            c[k + "|lo"] = lo
         t = np.zeros(B, dtype=np.int32)
         t[:n] = rel[:n]
         g = np.zeros(B, dtype=np.int32)
@@ -1578,8 +1577,15 @@ class DeviceQueryEngine:
         wg = np.zeros(B, dtype=np.int32)
         if wgrp is not None:
             wg[:n] = wgrp[:n]
-        return c, jnp.asarray(t), jnp.asarray(g), jnp.asarray(wg), \
-            jnp.asarray(valid), B
+        # ONE H2D put for the whole padded batch (a pytree device_put),
+        # behind the ingest.put fault site — the single sanctioned
+        # ingest transfer (core/ingest_stage.py, tests/test_ingest_guard)
+        from siddhi_tpu.core.ingest_stage import staged_put
+
+        c, t, g, wg, valid = staged_put(
+            (c, t, g, wg, valid), faults=self.faults,
+            stats=getattr(self, "ingest_stats", None))
+        return c, t, g, wg, valid, B
 
     def _out_columns(self, vals, sel, gids, in_cols, in_sel,
                      host_env=None, key_cols=None,
@@ -1674,6 +1680,8 @@ class DeviceQueryEngine:
         count-gated, coalesced fetch per call."""
         state, pending = self.process_batch_deferred(state, cols, ts,
                                                      part_keys)
+        if pending is not None and pending.resolve() == 0:
+            pending = None
         if pending is None:
             self.last_group_keys = (
                 [] if self.group_exprs and not self.partition_mode else None)
@@ -1689,12 +1697,15 @@ class DeviceQueryEngine:
                                ts: np.ndarray,
                                part_keys: Optional[np.ndarray] = None):
         """Async-emit entry point: run the jitted step(s) and KEEP the
-        match outputs resident on device.  Only the scalar match count
-        crosses the device boundary here; zero-match batches return
-        ``(state, None)`` with no column transfer at all.  Non-empty
-        batches return a DeferredDeviceEmit whose ``device_arrays()`` /
-        ``materialize(host_arrays)`` pair the pending-emit queue
-        (core/emit_queue.py) drains with one coalesced transfer."""
+        match outputs resident on device.  NOTHING crosses the device
+        boundary here — even the per-chunk match-count scalar stays on
+        device until ``DeferredDeviceEmit.resolve()`` fetches it (the
+        ingest stage, core/ingest_stage.py, defers that fetch past the
+        next batch's dispatch).  Empty input returns ``(state, None)``;
+        otherwise a DeferredDeviceEmit whose ``resolve()`` /
+        ``device_arrays()`` / ``materialize(host_arrays)`` triple the
+        staging + pending-emit pipeline drains with one count fetch and
+        one coalesced column transfer."""
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         if n == 0:
@@ -1746,18 +1757,21 @@ class DeviceQueryEngine:
             if self.faults is not None:
                 self.faults.check("step.device")
             state, ov, out, n_match = step(state, c, t, g, wg, valid)
-            if int(n_match) == 0:
-                return state  # count gate: no column ever fetched
-            # group key values are captured NOW (host-side, from the
-            # intern tables): a group id recycled by a later batch or an
-            # idle purge before the deferred drain must not alias the
-            # keys of rows already pending
-            gvals = (self._keys_for_gids(grp[:n])
-                     if self.group_exprs and self.kind != "filter"
-                     else None)
+            # the count gate is DEFERRED: ``n_match`` stays a device
+            # scalar until ``DeferredDeviceEmit.resolve()`` fetches it
+            # (the ingest stage calls resolve only after the NEXT
+            # batch's transfer + dispatch are in flight, which is where
+            # the H2D/compute overlap comes from).  Group ids are kept
+            # host-side so resolve can capture the key values for
+            # surviving chunks — resolve always runs before any purge or
+            # later interning could recycle a gid (runtimes flush the
+            # ingest stage first at every such barrier).
+            gids = (grp[:n].copy()
+                    if self.group_exprs and self.kind != "filter" else None)
             pending.chunks.append({
                 "kind": "device", "ov": ov, "out": dict(out),
-                "names": list(out), "n": n, "gvals": gvals, "ts": ts,
+                "names": list(out), "n": n, "count": n_match,
+                "gids": gids, "ts": ts,
                 "cols": {k: np.asarray(v) for k, v in cols.items()},
             })
             return state
@@ -2001,16 +2015,66 @@ class DeviceQueryEngine:
 class DeferredDeviceEmit:
     """Device-resident match outputs of one ``process_batch_deferred``
     call (one junction batch; possibly several >MAX_DEVICE_BATCH-row
-    chunks).  The pending-emit queue (core/emit_queue.py) fetches
-    ``device_arrays()`` with one coalesced transfer and hands the host
-    copies back to ``materialize``; the result is byte-identical to what
-    the synchronous ``process_batch`` would have returned."""
+    chunks).  ``resolve()`` fetches the deferred count gates (the only
+    blocking point of the whole ingest path — the ingest stage times it
+    to land AFTER the next batch's dispatch); the pending-emit queue
+    (core/emit_queue.py) then fetches ``device_arrays()`` with one
+    coalesced transfer and hands the host copies back to
+    ``materialize``; the result is byte-identical to what the
+    synchronous ``process_batch`` would have returned."""
 
-    __slots__ = ("engine", "chunks")
+    __slots__ = ("engine", "chunks", "_total")
 
     def __init__(self, engine):
         self.engine = engine
         self.chunks: List[dict] = []
+        self._total: Optional[int] = None
+
+    def probe(self):
+        """A device scalar whose readiness marks step completion for
+        this batch (the ingest stage's overlap/stall evidence); None
+        when every chunk is host-side."""
+        for ch in self.chunks:
+            if ch["kind"] == "device":
+                return ch["count"]
+        return None
+
+    def resolve(self) -> int:
+        """Fetch the per-chunk count gates (one ``device_get``, scalars
+        only), prune zero-match chunks so their columns are never
+        transferred, and capture group-key values for the survivors
+        (host-side, from the intern tables — safe because every gid
+        purge/restore point flushes the ingest stage, and thus resolves,
+        first).  Idempotent; returns the total match count."""
+        if self._total is not None:
+            return self._total
+        dev = [(i, ch["count"]) for i, ch in enumerate(self.chunks)
+               if ch["kind"] == "device"]
+        counts = {}
+        if dev:
+            import jax
+
+            host = jax.device_get([c for _i, c in dev])
+            counts = {i: int(c) for (i, _d), c in zip(dev, host)}
+        eng = self.engine
+        keep = []
+        total = 0
+        for i, ch in enumerate(self.chunks):
+            if ch["kind"] == "host":
+                total += len(ch["ts"])
+                keep.append(ch)
+                continue
+            c = counts[i]
+            if c == 0:
+                continue  # count gate: no column ever fetched
+            total += c
+            gids = ch.pop("gids", None)
+            ch["gvals"] = (eng._keys_for_gids(gids)
+                           if gids is not None else None)
+            keep.append(ch)
+        self.chunks = keep
+        self._total = total
+        return total
 
     def device_arrays(self) -> List:
         arrs: List = []

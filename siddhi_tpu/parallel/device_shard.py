@@ -28,7 +28,6 @@ ThreadLocal-keyed maps on one JVM (config/SiddhiAppContext.java:55-109).
 from __future__ import annotations
 
 import logging
-import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -36,8 +35,8 @@ import numpy as np
 from siddhi_tpu.core.exceptions import (
     SiddhiAppCreationError,
     SiddhiAppRuntimeError,
-    TransferFaultError,
 )
+from siddhi_tpu.core.ingest_stage import staged_put
 from siddhi_tpu.parallel.mesh import route_to_shards
 
 log = logging.getLogger("siddhi_tpu.shard")
@@ -110,40 +109,12 @@ class ShardedDeviceQueryEngine:
     # -- sharded state -------------------------------------------------------
 
     def _put(self, x, spec):
-        fi = getattr(self.engine, "faults", None)
-        if fi is None:
-            return self._jax.device_put(x,
-                                        self._NamedSharding(self.mesh, spec))
-        # ingest device_put behind the ingest.put injection site with the
-        # same bounded retry-with-backoff the emit drain uses
-        attempts = fi.transfer_retry_attempts
-        backoff = None
-        attempt = 0
-        while True:
-            try:
-                fi.check("ingest.put")
-                out = self._jax.device_put(
-                    x, self._NamedSharding(self.mesh, spec))
-                if attempt:
-                    fi.stats.drains_recovered += 1
-                return out
-            except TransferFaultError:
-                if attempt >= attempts:
-                    raise
-                attempt += 1
-                fi.stats.transfer_retries += 1
-                if backoff is None:
-                    from siddhi_tpu.transport.retry import BackoffRetryCounter
-
-                    backoff = BackoffRetryCounter(
-                        scale=fi.transfer_retry_scale)
-                wait_s = backoff.get_time_interval_ms() / 1000.0
-                backoff.increment()
-                log.warning("sharded ingest: transient device_put fault; "
-                            "retry %d/%d in %.3fs", attempt, attempts,
-                            wait_s)
-                if wait_s > 0:
-                    time.sleep(wait_s)
+        # the shared staged_put owns the ingest.put fault site + the
+        # bounded retry-with-backoff ladder (core/ingest_stage.py)
+        return staged_put(
+            x, self._NamedSharding(self.mesh, spec),
+            faults=getattr(self, "faults", None),
+            stats=getattr(self, "ingest_stats", None))
 
     def init_state(self):
         host = self.engine.init_state_host()
@@ -191,6 +162,8 @@ class ShardedDeviceQueryEngine:
         eng = self.engine
         state, pending = self.process_batch_deferred(state, cols, ts,
                                                      part_keys)
+        if pending is not None and pending.resolve() == 0:
+            pending = None
         if pending is None:
             eng.last_group_keys = (
                 [] if eng.group_exprs and not eng.partition_mode else None)
@@ -268,14 +241,14 @@ class ShardedDeviceQueryEngine:
         if fi is not None:
             fi.check("step.shard")
         state, ov, out, total = self._step(state, *args)
-        if int(total) == 0:
-            return state  # count gate: no column ever fetched
-        # group key values captured now — a gid recycled before the
-        # deferred drain must not alias keys of rows already pending
-        gvals = eng._keys_for_gids(grp) if eng.group_exprs else None
+        # count gate deferred: the psum'd scalar stays on device until
+        # DeferredDeviceEmit.resolve() (driven by the ingest stage);
+        # group ids are kept host-side so resolve can capture key values
+        # before any gid could be recycled (purges flush the stage first)
         pending.chunks.append({
             "kind": "device", "ov": ov, "out": dict(out),
-            "names": list(out), "n": n, "pos": pos, "gvals": gvals,
+            "names": list(out), "n": n, "pos": pos, "count": total,
+            "gids": (grp.copy() if eng.group_exprs else None),
             "ts": ts, "cols": {k: np.asarray(v) for k, v in cols.items()},
         })
         return state

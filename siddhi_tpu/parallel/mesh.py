@@ -332,8 +332,9 @@ class ShardedPatternEngine:
         normalizes timestamps, and flattens per-instance matches back to
         input order.  Returns ``(state, match_ev_idx[m], out[m, n_out],
         total_matches)`` with same-event matches ordered by arming age."""
-        state, pending, total = self.process_deferred(state, part, cols, ts)
-        if pending is None:
+        state, pending = self.process_deferred(state, part, cols, ts)
+        total = pending.resolve() if pending is not None else 0
+        if total == 0:
             from siddhi_tpu.ops.dense_nfa import flatten_match_parts
 
             ev, out = flatten_match_parts(
@@ -347,11 +348,13 @@ class ShardedPatternEngine:
 
     def process_deferred(self, state, part: np.ndarray,
                          cols: Dict[str, np.ndarray], ts: np.ndarray):
-        """Async-emit variant of :meth:`process`: matched rounds stay
-        device-resident in a :class:`DeferredDenseEmit` (None when no
-        round matched) and only the psum'd per-round match count crosses
-        device->host here.  Returns ``(state, pending_or_None,
-        total_matches)``."""
+        """Async-emit variant of :meth:`process`: every round's match
+        outputs stay device-resident in a :class:`DeferredDenseEmit`
+        (None only for empty input).  Nothing crosses device->host here:
+        the psum'd per-round count gate stays a device scalar until
+        ``pending.resolve()`` — the ingest stage (core/ingest_stage.py)
+        defers that fetch past the next batch's dispatch.  Returns
+        ``(state, pending_or_None)``."""
         from siddhi_tpu.ops.dense_nfa import (
             DeferredDenseEmit,
             _collision_rounds,
@@ -368,7 +371,6 @@ class ShardedPatternEngine:
         faults = getattr(self.engine, "faults", None)
         if faults is not None:
             faults.check("step.shard")
-        total = 0
         for ridx in _collision_rounds(part):
             args, pos = self.route(
                 part[ridx],
@@ -376,15 +378,9 @@ class ShardedPatternEngine:
                 rel[ridx],
             )
             state, emit, outs, anchor, round_total = self.step(state, *args)
-            n_round = int(round_total)
-            total += n_round
-            if n_round == 0:
-                # count gate (async emit pipeline): the psum'd scalar
-                # already crossed the device boundary; zero matches
-                # means no emit/out/anchor columns are fetched at all
-                continue
             pending.chunks.append({
                 "emit": emit, "f": outs["f"], "i": outs["i"],
                 "anchor": anchor, "sel": pos, "ridx": ridx,
+                "count": round_total,
             })
-        return state, (pending if pending.chunks else None), total
+        return state, (pending if pending.chunks else None)

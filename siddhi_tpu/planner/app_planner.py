@@ -109,15 +109,43 @@ class AppPlanner:
                         f"divisible by devices={nd}")
             depth = exec_ann.element("emit.depth")
             if depth:
+                if depth.lower() == "auto":
+                    # adaptive: the emit queue derives its effective
+                    # depth from observed transfer RTT vs batch cadence
+                    # (core/emit_queue.py EmitDepthController)
+                    self.app_context.tpu_emit_depth = "auto"
+                else:
+                    try:
+                        ed = int(depth)
+                    except ValueError:
+                        ed = -1
+                    if ed < 1:
+                        raise SiddhiAppCreationError(
+                            f"@app:execution: emit.depth='{depth}' must be "
+                            "a positive integer or 'auto'")
+                    self.app_context.tpu_emit_depth = ed
+            idepth = exec_ann.element("ingest.depth")
+            if idepth:
                 try:
-                    ed = int(depth)
+                    nid = int(idepth)
                 except ValueError:
-                    ed = -1
-                if ed < 1:
+                    nid = -1
+                if nid < 1:
                     raise SiddhiAppCreationError(
-                        f"@app:execution: emit.depth='{depth}' must be a "
+                        f"@app:execution: ingest.depth='{idepth}' must be a "
                         "positive integer")
-                self.app_context.tpu_emit_depth = ed
+                self.app_context.tpu_ingest_depth = nid
+            amb = exec_ann.element("agg.device.min.batch")
+            if amb:
+                try:
+                    nab = int(amb)
+                except ValueError:
+                    nab = -1
+                if nab < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:execution: agg.device.min.batch='{amb}' must "
+                        "be a positive integer")
+                self.app_context.tpu_agg_min_batch = nab
 
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 

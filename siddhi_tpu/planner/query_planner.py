@@ -596,6 +596,7 @@ class QueryPlanner:
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
             key_fn=key_fn, mesh=mesh, app_context=self.app.app_context,
             emit_depth=self.app.app_context.tpu_emit_depth,
+            ingest_depth=self.app.app_context.tpu_ingest_depth,
         )
         if getattr(selector, "partition_axis", False):
             # idle-key purges must also drop the shared selector's
@@ -775,7 +776,8 @@ class QueryPlanner:
             engine, f"#device_{name}", emit=lambda b: qr.process(b, 0),
             emit_depth=self.app.app_context.tpu_emit_depth,
             clock=self.app.app_context.timestamp_generator.current_time,
-            faults=self.app.app_context.fault_injector)
+            faults=self.app.app_context.fault_injector,
+            ingest_depth=self.app.app_context.tpu_ingest_depth)
         qr.device_runtime = runtime
         if subscribe:
             junction = self.app.junction_for_input(s)

@@ -117,6 +117,20 @@ class EmitTransferTracker:
         return self.emit_stats.as_dict()
 
 
+class IngestTracker:
+    """Host→device staging counters of one device runtime's ingest
+    pipeline (core/ingest_stage.py IngestStats): same thin-gauge pattern
+    as EmitTransferTracker — the hot path increments its own counters,
+    this view just reads them."""
+
+    def __init__(self, name: str, ingest_stats):
+        self.name = name
+        self.ingest_stats = ingest_stats
+
+    def values(self) -> Dict[str, int]:
+        return self.ingest_stats.as_dict()
+
+
 class FaultTracker:
     """Fault-injection / recovery counters (util/faults.py FaultStats):
     same thin-gauge pattern as EmitTransferTracker — the harness
@@ -143,6 +157,9 @@ class StatisticsManager:
         # per-query device→host emit-transfer gauges (async emit
         # pipeline; one per device-lowered query)
         self.transfers: Dict[str, EmitTransferTracker] = {}
+        # per-query host→device ingest-staging gauges (double-buffered
+        # H2D pipeline; one per device-lowered query)
+        self.ingests: Dict[str, IngestTracker] = {}
         # fault-injection / recovery gauges (@app:faults harness),
         # registered ungated so recovery events stay visible even at
         # statistics level 'off'
@@ -173,6 +190,10 @@ class StatisticsManager:
         return self.transfers.setdefault(
             name, EmitTransferTracker(name, emit_stats))
 
+    def ingest_tracker(self, name: str, ingest_stats) -> IngestTracker:
+        return self.ingests.setdefault(
+            name, IngestTracker(name, ingest_stats))
+
     def fault_tracker(self, name: str, fault_stats) -> FaultTracker:
         return self.faults.setdefault(name, FaultTracker(name, fault_stats))
 
@@ -195,6 +216,9 @@ class StatisticsManager:
         for tt in list(self.transfers.values()):
             for metric, v in tt.values().items():
                 out[self._metric("Queries", tt.name, metric)] = v
+        for it in list(self.ingests.values()):
+            for metric, v in it.values().items():
+                out[self._metric("Queries", it.name, metric)] = v
         for ft in list(self.faults.values()):
             for metric, v in ft.values().items():
                 out[self._metric("Faults", ft.name, metric)] = v

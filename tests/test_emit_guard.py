@@ -63,6 +63,7 @@ ALLOWED = {
         "DeviceQueryEngine.host_restore",                     # barrier
         "DeferredDeviceEmit.materialize",                     # drain
         "DeferredDeviceEmit._concat_parts",                   # drain
+        "DeferredDeviceEmit.resolve",                         # drain
     },
     "siddhi_tpu/ops/dense_nfa.py": {
         "DensePatternEngine.prepare_cols",                    # ingest
@@ -70,6 +71,7 @@ ALLOWED = {
         "DensePatternEngine.on_time_state",                   # barrier
         "DensePatternEngine.maybe_re_anchor",                 # barrier
         "DeferredDenseEmit.materialize",                      # drain
+        "DeferredDenseEmit.resolve",                          # drain
     },
     "siddhi_tpu/parallel/device_shard.py": {
         "ShardedDeviceQueryEngine.put_state",                 # barrier

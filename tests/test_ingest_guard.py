@@ -1,0 +1,105 @@
+"""Tier-1 guard: every ingest-path H2D transfer goes through staging.
+
+The ingest pipeline's contract is that host→device puts of BATCH data
+happen ONLY through ``core/ingest_stage.py`` ``staged_put`` — the one
+wrapper that arms the ``ingest.put`` fault-injection site (bounded
+retry-with-backoff, crash-journal semantics) and counts
+``IngestStats.device_puts``.  A future edit that calls
+``jax.device_put`` directly on a batch path silently bypasses both the
+fault harness and the staging counters: chaos runs stop covering that
+transfer and the overlap evidence under-reports.
+
+This test AST-scans the whole package and fails when a ``device_put``
+call appears outside the curated allowlist.  Buckets:
+  staging — the sanctioned wrapper itself
+  mesh    — sharding helpers placing STATE rows on the mesh (one-time /
+            barrier placement, not per-batch event data; faults on the
+            sharded batch path still flow through staged_put in
+            parallel/device_shard.py ``_put``)
+  state   — engine state initialization / re-anchor barriers (same
+            reasoning: not an ingest path, and arming ``ingest.put``
+            there would skew the injector's per-batch fault cadence)
+"""
+
+import ast
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "siddhi_tpu"
+
+ALLOWED = {
+    "siddhi_tpu/core/ingest_stage.py": {
+        "staged_put",                                     # staging
+    },
+    "siddhi_tpu/parallel/mesh.py": {
+        "ShardedPatternEngine._put",                      # mesh
+    },
+    "siddhi_tpu/ops/dense_nfa.py": {
+        "DensePatternEngine.init_state",                  # state
+        "DensePatternEngine.maybe_re_anchor",             # state
+    },
+}
+
+
+def device_put_calls(source):
+    """Yield (lineno, qualified enclosing function) for every
+    ``*.device_put(...)`` call, regardless of the receiver chain
+    (``jax.device_put``, ``self.jax.device_put``, ...)."""
+    stack = []
+    hits = []
+
+    class V(ast.NodeVisitor):
+        def _scoped(self, node):
+            stack.append(node.name)
+            self.generic_visit(node)
+            stack.pop()
+
+        visit_FunctionDef = _scoped
+        visit_AsyncFunctionDef = _scoped
+        visit_ClassDef = _scoped
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "device_put":
+                hits.append((node.lineno, ".".join(stack) or "<module>"))
+            self.generic_visit(node)
+
+    V().visit(ast.parse(source))
+    return hits
+
+
+def test_detector_sees_through_receiver_chains():
+    src = ("import jax\n"
+           "class E:\n"
+           "    def a(self):\n"
+           "        jax.device_put(1)\n"
+           "    def b(self):\n"
+           "        self.jax.device_put(1)\n")
+    assert device_put_calls(src) == [(4, "E.a"), (6, "E.b")]
+
+
+def test_no_device_put_bypasses_ingest_staging():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO).as_posix()
+        allowed = ALLOWED.get(rel, set())
+        for lineno, qual in device_put_calls(path.read_text()):
+            if qual not in allowed:
+                offenders.append(f"{rel}:{lineno} device_put in {qual}()")
+    assert not offenders, (
+        "direct device_put outside the sanctioned staging/mesh/state "
+        "sites — route batch ingest through core/ingest_stage.staged_put "
+        "(fault site + counters), or add it to the allowlist WITH a "
+        "bucket justification:\n  " + "\n  ".join(offenders))
+
+
+def test_allowlist_not_stale():
+    """Every allowlisted function still exists and still calls
+    device_put — keeps the guard honest as the ingest paths evolve."""
+    for rel, allowed in ALLOWED.items():
+        path = REPO / rel
+        assert path.exists(), f"guard list is stale: {rel} moved"
+        live = {q for _ln, q in device_put_calls(path.read_text())}
+        gone = allowed - live
+        assert not gone, (f"{rel}: allowlisted entries no longer call "
+                          f"device_put; prune them: {sorted(gone)}")
