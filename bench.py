@@ -56,6 +56,16 @@ HOST_MAX_SECONDS = 20.0
 PRODUCT_STEPS = 10
 PRODUCT_WINDOWS = 3
 
+# sharded windowed-state measurement (parallel/device_shard.py): a
+# tumbling lengthBatch group-by whose pane state lives shard-major over
+# every visible device; the per-chip number divides by the mesh size
+SHWIN_KEYS = 4_096
+SHWIN_BATCH = 1 << 15
+SHWIN_PANE = 1_024
+SHWIN_STEPS = 10
+SHWIN_WARMUP = 2
+SHWIN_WINDOWS = 3
+
 # CPU-backend smoke fallback (device backend unreachable): reduced
 # sizes so the number exists in seconds, clearly labeled as NOT the
 # chip measurement
@@ -63,6 +73,9 @@ SMOKE_PARTITIONS = 4_096
 SMOKE_BATCH = 4_096
 SMOKE_STEPS = 5
 SMOKE_WARMUP = 2
+SMOKE_SHWIN_KEYS = 512
+SMOKE_SHWIN_BATCH = 2_048
+SMOKE_SHWIN_STEPS = 4
 
 
 def pattern_query() -> str:
@@ -233,6 +246,80 @@ def bench_product():
         m.shutdown()
 
 
+def _shwin_app(n_devices, keys, pane):
+    return ("@app:playback "
+            f"@app:execution('tpu', partitions='{keys}', "
+            f"devices='{n_devices}', ingest.depth='2', "
+            "emit.depth='auto') "
+            "define stream Mkt (k long, v double); "
+            f"@info(name='w') from Mkt#window.lengthBatch({pane}) "
+            "select k, sum(v) as s, count() as c group by k "
+            "insert into Panes;")
+
+
+def bench_sharded_window(n_devices=None, keys=SHWIN_KEYS,
+                         batch=SHWIN_BATCH, pane=SHWIN_PANE,
+                         steps=SHWIN_STEPS, windows=SHWIN_WINDOWS):
+    """Sharded windowed state: tumbling pane accumulation + flush
+    emission with the per-group rows laid out shard-major across the
+    device mesh.  Every pane flush rides the count-gated async emit
+    queue (zero-match panes transfer nothing), so the measured rate
+    includes pane bookkeeping, the psum'd count gates and the coalesced
+    flush drains — the end-to-end windowed ingest path."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.device_single import DeviceQueryRuntime
+    from siddhi_tpu.core.event import EventBatch
+    from siddhi_tpu.parallel import ShardedDeviceQueryEngine
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _shwin_app(n_devices, keys, pane))
+        rows = [0]
+        rt.add_callback("Panes", lambda evs: rows.__setitem__(
+            0, rows[0] + len(evs)))
+        rt.start()
+        dr = rt.query_runtimes["w"].device_runtime
+        assert (isinstance(dr, DeviceQueryRuntime)
+                and isinstance(dr.engine, ShardedDeviceQueryEngine)), (
+            "sharded window bench app fell back off the sharded path")
+        h = rt.get_input_handler("Mkt")
+        rng = np.random.default_rng(17)
+
+        def mk(i):
+            k = ((np.arange(batch, dtype=np.int64) * 524287 + i * batch)
+                 % keys)
+            v = rng.integers(0, 50, batch).astype(np.float64)
+            ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+            return EventBatch("Mkt", ["k", "v"], {"k": k, "v": v}, ts)
+
+        bs = [mk(i) for i in range(SHWIN_WARMUP + steps)]
+        for b in bs[:SHWIN_WARMUP]:
+            h.send_batch(b)
+        window_rates = []
+        for _w in range(windows):
+            t_w = time.perf_counter()
+            for b in bs[SHWIN_WARMUP:]:
+                h.send_batch(b)
+            window_rates.append(
+                batch * steps / (time.perf_counter() - t_w))
+        rt.shutdown()
+        rate = float(np.median(window_rates))
+        return {
+            "events_per_sec": rate,
+            "per_chip": rate / n_devices,
+            "n_devices": n_devices,
+            "window_rates": [round(r, 1) for r in window_rates],
+            "pane_rows": rows[0],
+        }
+    finally:
+        m.shutdown()
+
+
 def bench_host_baseline():
     """Measured host-engine (ops/nfa.py) rate on the same partitioned
     pattern — the CPU reference side of the comparison."""
@@ -323,9 +410,9 @@ def bench_cpu_smoke():
 
 
 def _cpu_smoke_subprocess(timeout_s: int = 300):
-    """Run bench_cpu_smoke in a fresh process pinned to the CPU backend
-    (this process may have poisoned backend state from the failed device
-    probes).  Returns events/sec or None."""
+    """Run the --cpu-smoke suite in a fresh process pinned to the CPU
+    backend (this process may have poisoned backend state from the
+    failed device probes).  Returns the smoke JSON dict or None."""
     import os
     import subprocess
     import sys as _sys
@@ -340,7 +427,7 @@ def _cpu_smoke_subprocess(timeout_s: int = 300):
         for line in reversed(r.stdout.decode().splitlines()):
             line = line.strip()
             if line.startswith("{"):
-                return json.loads(line).get("cpu_smoke_events_per_sec")
+                return json.loads(line)
     except Exception:
         return None
     return None
@@ -385,9 +472,23 @@ def _probe_with_retry() -> bool:
 
 def main():
     if "--cpu-smoke" in sys.argv:
-        # child of _cpu_smoke_subprocess (JAX_PLATFORMS=cpu)
-        print(json.dumps({
-            "cpu_smoke_events_per_sec": round(bench_cpu_smoke(), 1)}))
+        # child of _cpu_smoke_subprocess (JAX_PLATFORMS=cpu).  Virtual
+        # devices must be configured before the first backend init, so
+        # the sharded-window smoke can build an 8-way mesh on CPU.
+        from siddhi_tpu.parallel import ensure_virtual_devices
+
+        ensure_virtual_devices(8)
+        out = {"cpu_smoke_events_per_sec": round(bench_cpu_smoke(), 1)}
+        try:
+            sw = bench_sharded_window(
+                n_devices=8, keys=SMOKE_SHWIN_KEYS,
+                batch=SMOKE_SHWIN_BATCH, pane=256,
+                steps=SMOKE_SHWIN_STEPS, windows=1)
+            out["cpu_smoke_sharded_window_events_per_sec"] = round(
+                sw["events_per_sec"], 1)
+        except Exception as e:  # engine smoke must not hide the kernel one
+            out["cpu_smoke_sharded_window_error"] = str(e)
+        print(json.dumps(out))
         return
     if not _probe_with_retry():
         # one JSON line even when the chip is unreachable, so the
@@ -396,7 +497,7 @@ def main():
         # mistake the outage sentinel for a real measurement — but a
         # CPU-backend smoke run (subprocess, reduced sizes) still rides
         # along so the round records that the ENGINE works.
-        smoke = _cpu_smoke_subprocess()
+        smoke = _cpu_smoke_subprocess() or {}
         print(json.dumps({
             "metric": "pattern_match_events_per_sec_per_chip",
             "value": None,
@@ -404,14 +505,20 @@ def main():
             "vs_baseline": None,
             "error": "device backend unreachable (tunnel down, retried "
                      f"{PROBE_RETRIES}x with backoff); bench skipped",
-            "cpu_smoke_events_per_sec": smoke,
+            "sharded_window_events_per_sec_per_chip": None,
+            "cpu_smoke_events_per_sec": smoke.get(
+                "cpu_smoke_events_per_sec"),
+            "cpu_smoke_sharded_window_events_per_sec": smoke.get(
+                "cpu_smoke_sharded_window_events_per_sec"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
-                "kernel smoke — engine health only, NOT the chip metric"),
+                "kernel smoke + 8-virtual-device sharded-window smoke — "
+                "engine health only, NOT the chip metric"),
         }))
         return
     kernel = bench_kernel()
     product = bench_product()
+    shwin = bench_sharded_window()
     host = bench_host_baseline()
     workload_rows = None
     if "--workloads" in sys.argv:
@@ -450,6 +557,12 @@ def main():
         "product_ingest_overlapped_batches": product["ingest_overlapped_batches"],
         "product_ingest_stalls": product["ingest_stalls"],
         "product_ingest_max_staging_depth": product["ingest_max_staging_depth"],
+        "sharded_window_events_per_sec_per_chip": round(
+            shwin["per_chip"], 1),
+        "sharded_window_events_per_sec": round(shwin["events_per_sec"], 1),
+        "sharded_window_devices": shwin["n_devices"],
+        "sharded_window_window_rates": shwin["window_rates"],
+        "sharded_window_pane_rows": shwin["pane_rows"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

@@ -16,8 +16,14 @@ ingest performs no per-batch device→host flush (the former
 
 Precision: rows are float32 — the device lane policy shared with every
 other jitted path (ops/device_query.py docstring).  Integer fields
-(count, int sums) never enter the bank; they stay on exact host numpy
-scatter ufuncs at native width.
+(int sums, bare counts) stay on exact host numpy scatter ufuncs at
+native width, with one deliberate exception: when the aggregation is
+avg-bearing (avg rewrites to sum + count and the float numerator is
+already banked), the shared count denominator rides the bank too as
+float32 add rows.  Float32 integer arithmetic is exact below 2**24;
+``count_overflow_risk`` lets the runtime force a flush barrier before
+any row could cross that bound, and the flush merge casts count values
+back to exact ints (aggregation/runtime.py ``_flush_bank``).
 
 Row layout: ``cap`` assignable rows + one dump row (index ``cap``) that
 absorbs padded lanes and out-of-order events, which take the host
@@ -32,11 +38,16 @@ import numpy as np
 
 _IDENTITY = {"sum": 0.0, "count": 0.0, "min": np.inf, "max": -np.inf}
 
+# float32 holds consecutive integers exactly up to 2**24: the largest
+# count any bank row may accumulate between flushes
+COUNT_EXACT_MAX = 1 << 24
+
 
 class DeviceBucketBank:
     """Device rows for the float base fields of running finest buckets.
 
-    ``fields``: the eligible BaseFields (op in sum/min/max, float type).
+    ``fields``: the eligible BaseFields (op in sum/min/max over float
+    arguments, plus the count denominator of avg-bearing selects).
     One [cap+1] float32 device array per field; ``rows`` maps
     (bucket_start, group_key) -> row index.
     """
@@ -54,10 +65,22 @@ class DeviceBucketBank:
         # on device vs host materializations
         self.scatters = 0
         self.flushes = 0
+        # events scattered since the last flush: upper-bounds the count
+        # any single row may have accumulated (count rows are float32,
+        # exact only below COUNT_EXACT_MAX)
+        self._has_count = "count" in self.ops
+        self.events_since_flush = 0
 
     @property
     def dump_row(self) -> int:
         return self.cap
+
+    def count_overflow_risk(self, n: int) -> bool:
+        """True when scattering ``n`` more events could push a float32
+        count row past exact-integer territory — the caller must flush
+        first.  Always False when no count field is banked."""
+        return (self._has_count
+                and self.events_since_flush + n > COUNT_EXACT_MAX)
 
     # -- device arrays -------------------------------------------------------
 
@@ -127,6 +150,7 @@ class DeviceBucketBank:
         self._arrays = self._scatter_fn()(
             self._arrays, jnp.asarray(rows_p), vals)
         self.scatters += 1
+        self.events_since_flush += n
 
     # -- flush barriers ------------------------------------------------------
 
@@ -156,3 +180,4 @@ class DeviceBucketBank:
         self.rows.clear()
         self._free = list(range(self.cap))
         self._arrays = None
+        self.events_since_flush = 0

@@ -141,6 +141,9 @@ class IncrementalRewrite:
         self.compiler = compiler
         self.final_scope = final_scope
         self.fields: Dict[str, BaseField] = {}
+        # avg decomposes to sum + count; the device bank uses this to
+        # decide whether the count denominator should ride the device
+        self.saw_avg = False
 
     def _field(self, op: str, arg_expr: Optional[Expression], type_: AttrType) -> str:
         key = f"__{op}_{'' if arg_expr is None else repr(arg_expr)}"
@@ -174,6 +177,7 @@ class IncrementalRewrite:
                     return sum_v
                 cnt_v = Variable(attribute=self._field("count", None, AttrType.LONG))
                 if name == "avg":
+                    self.saw_avg = True
                     return ArithmeticOp("/", sum_v, cnt_v)
                 sq = ArithmeticOp("*", a, a)
                 sumsq_v = Variable(attribute=self._field("sum", sq, AttrType.DOUBLE))
@@ -426,6 +430,17 @@ class AggregationRuntime:
                 if f.op in ("sum", "min", "max")
                 and f.type in (AttrType.FLOAT, AttrType.DOUBLE)
             ]
+            # avg(x) over a float argument rewrites to _SUM/_COUNT; with
+            # the numerator banked above, banking the shared count
+            # denominator too lets avg-bearing ingest skip the host
+            # reduction entirely.  Count rows are float32 on the device
+            # — exact below 2**24, enforced by the overflow barrier in
+            # _bank_ingest — and cast back to exact ints at flush merge.
+            # Without an avg, count keeps the exact host path.
+            if rw.saw_avg and any(f.op == "sum" for f in bank_fields):
+                bank_fields += [
+                    f for f in self.base_fields if f.op == "count"
+                ]
             if bank_fields:
                 from siddhi_tpu.aggregation.device_bank import (
                     DeviceBucketBank,
@@ -661,6 +676,10 @@ class AggregationRuntime:
         bank = self._bank
         if bank is None:
             return set()
+        # float32 count rows stay exact only below 2**24 increments:
+        # force a flush before this batch could push any row past that
+        if bank.count_overflow_risk(len(ids)):
+            self._flush_bank()
         run_keys = [k for k, r in zip(seg_keys, running) if r]
         if not bank.assign(run_keys):
             # capacity barrier: materialize every row and retry once
@@ -683,7 +702,9 @@ class AggregationRuntime:
         for name in names:
             op = self.field_ops[name]
             v = fvals[name]
-            if op == "sum":
+            if op in ("sum", "count"):
+                # count values are per-event ones (int64): the same
+                # scatter-add yields the exact late-event count
                 acc = np.zeros(U, dtype=v.dtype)
                 np.add.at(acc, ids[mask], v[mask])
             elif op == "min":
@@ -704,8 +725,15 @@ class AggregationRuntime:
             return
         st = self.stores[self.durations[0]]
         for key, values in self._bank.flush().items():
-            # last_ts sentinel: bank ops are sum/min/max, ts-insensitive;
-            # the host bucket's last_ts was set at ingest time
+            # count rows rode the bank as float32 (exact below 2**24 by
+            # the ingest overflow barrier); the host store keeps exact
+            # int semantics, so cast the denominator back here
+            for name in values:
+                if self.field_ops[name] == "count":
+                    values[name] = int(values[name])
+            # last_ts sentinel: bank ops (sum/count/min/max) are
+            # ts-insensitive; the host bucket's last_ts was set at
+            # ingest time
             st.merge_into(st.running, key, values, -(1 << 62),
                           self.field_ops)
 

@@ -104,7 +104,8 @@ class SiddhiAppContext:
         # @app:execution('tpu', ingest.depth='N'): ingest staging window
         # (core/ingest_stage.py) — each batch's count-gate fetch defers
         # until N-1 later batches have dispatched, overlapping H2D
-        # transfer with the jitted step.  1 (default) = synchronous.
+        # transfer with the jitted step.  1 (default) = synchronous;
+        # 'auto' = RTT-vs-cadence adaptive (EmitDepthController).
         self.tpu_ingest_depth = 1
         # @app:execution('tpu', agg.device.min.batch='N'): minimum batch
         # size before incremental aggregation uses the jitted device

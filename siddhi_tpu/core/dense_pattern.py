@@ -227,7 +227,7 @@ class DensePatternRuntime:
                  emit: Callable[[EventBatch], None],
                  key_fn: Optional[Callable] = None,
                  mesh=None, app_context=None, emit_depth=1,
-                 ingest_depth: int = 1):
+                 ingest_depth=1):  # int or 'auto'
         from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats
         from siddhi_tpu.core.ingest_stage import IngestStage, IngestStats
 

@@ -66,7 +66,7 @@ class DeviceQueryRuntime:
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None], emit_depth=1,
                  clock: Optional[Callable[[], int]] = None, faults=None,
-                 ingest_depth: int = 1):
+                 ingest_depth=1):  # int or 'auto'
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit

@@ -56,7 +56,8 @@ class IngestStats:
     thin-gauge style as ``EmitStats``)."""
 
     __slots__ = ("staged_batches", "device_puts", "ingest_stalls",
-                 "overlapped_batches", "flush_syncs", "max_staging_depth")
+                 "overlapped_batches", "flush_syncs", "max_staging_depth",
+                 "auto_depth")
 
     def __init__(self):
         self.staged_batches = 0
@@ -65,6 +66,8 @@ class IngestStats:
         self.overlapped_batches = 0
         self.flush_syncs = 0
         self.max_staging_depth = 0
+        # effective window when ingest.depth='auto' (0 = fixed depth)
+        self.auto_depth = 0
 
     def note_depth(self, depth: int):
         if depth > self.max_staging_depth:
@@ -78,6 +81,7 @@ class IngestStats:
             "overlappedBatches": self.overlapped_batches,
             "flushSyncs": self.flush_syncs,
             "maxStagingDepth": self.max_staging_depth,
+            "autoIngestDepth": self.auto_depth,
         }
 
 
@@ -143,8 +147,20 @@ class IngestStage:
     (and instead of surfacing under an unrelated later batch).
     """
 
-    def __init__(self, depth: int = 1, stats: Optional[IngestStats] = None,
+    def __init__(self, depth=1, stats: Optional[IngestStats] = None,
                  faults=None, on_fault: Optional[Callable] = None):
+        # depth 'auto': bounded self-tuning with the SAME controller the
+        # emit queue uses (core/emit_queue.py EmitDepthController) — the
+        # staging window re-derives its depth each submit from the
+        # observed count-fetch round trip vs the batch arrival cadence,
+        # so slow fetches widen the window (more H2D/step overlap) and
+        # fast ones shrink it back toward the depth-1 latency profile.
+        self.controller = None
+        if depth == "auto":
+            from .emit_queue import EmitDepthController
+
+            self.controller = EmitDepthController()
+            depth = 1
         self.depth = max(1, int(depth))
         self.stats = stats or IngestStats()
         self.faults = faults
@@ -156,6 +172,10 @@ class IngestStage:
 
     def submit(self, probe, finish: Callable):
         """Stage one dispatched batch; finish entries past the window."""
+        if self.controller is not None:
+            self.controller.note_push()
+            self.depth = self.controller.effective_depth
+            self.stats.auto_depth = self.depth
         self.stats.staged_batches += 1
         self._entries.append((probe, finish))
         self.stats.note_depth(len(self._entries))
@@ -188,6 +208,12 @@ class IngestStage:
                         self.stats.ingest_stalls += 1
                 except Exception:  # pragma: no cover - probe died
                     self.stats.ingest_stalls += 1
+        # RTT sample for depth='auto': the wall time of finish() is
+        # dominated by the blocking count-gate fetch when the batch had
+        # device work (probe is not None)
+        t0 = (time.monotonic()
+              if self.controller is not None and probe is not None
+              else None)
         try:
             finish()
         except Exception as err:
@@ -195,3 +221,6 @@ class IngestStage:
                       "batch's emit: %s", err)
             if self.on_fault is not None:
                 self.on_fault(err)
+            return
+        if t0 is not None:
+            self.controller.note_drain(time.monotonic() - t0)

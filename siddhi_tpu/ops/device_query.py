@@ -908,6 +908,109 @@ class DeviceQueryEngine:
             new_state["acc_maxf"] = state["acc_maxf"].at[grp].max(
                 jnp.where(upd, argvals, -jnp.inf))
 
+    def _forever_block(self, state, argvals, grp, fmask, B, rows, grp_b):
+        """Per-output-row all-time min/max for a row block ([nb, A]):
+        the same-group prefix mask compares each selected output row
+        (global index ``rows[i]``) against the WHOLE batch, so a block
+        decomposition reduces exactly the rows the full-batch
+        ``_forever_rows`` would."""
+        jnp = self.jnp
+        kinds = self._kinds()
+        if not (kinds & {"minForever", "maxForever"}):
+            return None, None
+        le = rows[:, None] >= jnp.arange(B)[None, :]
+        same = le & (grp_b[:, None] == grp[None, :]) & fmask[None, :]
+        big = jnp.float32(np.inf)
+        fmin = fmax = None
+        if "minForever" in kinds:
+            pmin = jnp.min(
+                jnp.where(same[:, :, None], argvals[None, :, :], big), axis=1)
+            fmin = jnp.minimum(state["acc_minf"][grp_b], pmin)
+        if "maxForever" in kinds:
+            pmax = jnp.max(
+                jnp.where(same[:, :, None], argvals[None, :, :], -big), axis=1)
+            fmax = jnp.maximum(state["acc_maxf"][grp_b], pmax)
+        return fmin, fmax
+
+    def _sliding_step(self, state, env, fmask, ts, grp, B,
+                      r0=None, nb=None):
+        """Global sliding-window step body.  ``r0``/``nb`` select a
+        contiguous output-row block: the ring-buffer evolution (cheap,
+        O(B + W)) is always computed over the WHOLE batch, but the
+        O(B*W) window gather/reduction and the emit evaluation run only
+        for rows [r0, r0+nb) — the sharded wrapper splits that work
+        across the mesh's batch axis while keeping the ring replicated.
+        The defaults (r0=None) cover the whole batch, i.e. the
+        single-device step; the block decomposition is bit-identical
+        because each output row's window reduction is unchanged.
+        Returns (new_state, ov[nb], out {name: [nb]})."""
+        jnp = self.jnp
+        W = self.W
+        A = max(len(self.aggs), 1)
+        argvals = self._arg_vals(env, B)  # [B, A]
+        pos = jnp.cumsum(fmask.astype(jnp.int32)) - 1  # [B]
+        n_pass = jnp.sum(fmask.astype(jnp.int32))
+        sidx = jnp.where(fmask, pos, B)  # dump lane B
+        comp_vals = jnp.zeros((B + 1, A), jnp.float32).at[sidx].set(argvals)[:B]
+        comp_ts = jnp.zeros(B + 1, jnp.int32).at[sidx].set(ts)[:B]
+        comp_grp = jnp.zeros(B + 1, jnp.int32).at[sidx].set(grp)[:B]
+        comp_valid = (jnp.zeros(B + 1, bool)
+                      .at[sidx].set(jnp.ones(B, bool))[:B])
+        cat_vals = jnp.concatenate([state["win_vals"], comp_vals], 0)
+        cat_ts = jnp.concatenate([state["win_ts"], comp_ts], 0)
+        cat_grp = jnp.concatenate([state["win_grp"], comp_grp], 0)
+        cat_valid = jnp.concatenate([state["win_valid"], comp_valid], 0)
+        dyn = self.jax.lax.dynamic_slice_in_dim
+        if nb is None:
+            nb = B
+            rows = jnp.arange(B)
+            blk = lambda x: x  # noqa: E731 — whole batch, no slicing
+        else:
+            rows = r0 + jnp.arange(nb)
+            blk = lambda x: dyn(x, r0, nb, axis=0)  # noqa: E731
+        pos_b = blk(pos)
+        grp_b = blk(grp)
+        ts_b = blk(ts)
+        fmask_b = blk(fmask)
+        env_b = {k: blk(v) for k, v in env.items() if k != N_KEY}
+        env_b[N_KEY] = nb
+        # window of output row i: concat positions pos[i]+1 .. pos[i]+W
+        # (the W entries ending at the row itself)
+        gidx = pos_b[:, None] + 1 + jnp.arange(W)[None, :]  # [nb, W]
+        gidx = jnp.clip(gidx, 0, W + B - 1)
+        w_vals = cat_vals[gidx]  # [nb, W, A]
+        member = cat_valid[gidx] & (cat_grp[gidx] == grp_b[:, None])
+        if self.window_name == "time":
+            T = self.window_param
+            member = member & (cat_ts[gidx] > (ts_b[:, None] - T))
+        mf = member.astype(jnp.float32)[:, :, None]
+        env_out = dict(env_b)
+        kinds = self._kinds()
+        wsum = jnp.sum(w_vals * mf, axis=1)  # [nb, A]
+        wcnt = jnp.sum(mf, axis=1)  # [nb, 1]
+        wsumsq = (jnp.sum(w_vals * w_vals * mf, axis=1)
+                  if "stdDev" in kinds else None)
+        m3 = member[:, :, None]
+        wmin = (jnp.min(jnp.where(m3, w_vals, jnp.inf), axis=1)
+                if "min" in kinds else None)
+        wmax = (jnp.max(jnp.where(m3, w_vals, -jnp.inf), axis=1)
+                if "max" in kinds else None)
+        fmin, fmax = self._forever_block(state, argvals, grp, fmask, B,
+                                         rows, grp_b)
+        self._finalize_aggs(env_out, wsum, wcnt, wsumsq, wmin, wmax,
+                            fmin, fmax)
+        ov, out = self._emit(env_out, fmask_b, nb)
+        # new buffer = last W entries ending at the batch's final
+        # passing row: concat[n_pass : n_pass + W]
+        start = jnp.clip(n_pass, 0, B)
+        new_state = dict(state)
+        new_state["win_vals"] = dyn(cat_vals, start, W, axis=0)
+        new_state["win_ts"] = dyn(cat_ts, start, W, axis=0)
+        new_state["win_grp"] = dyn(cat_grp, start, W, axis=0)
+        new_state["win_valid"] = dyn(cat_valid, start, W, axis=0)
+        self._forever_scatter(state, new_state, argvals, grp, fmask)
+        return new_state, ov, out
+
     def make_step(self, jit: bool = True) -> Callable:
         """Per-event step (filter / running / sliding / keyed_sliding):
 
@@ -997,55 +1100,7 @@ class DeviceQueryEngine:
                     state, env, fmask, ts, grp, wgrp, B)
 
             # sliding: compact passing rows, gather [B, W] windows
-            W = self.W
-            pos = jnp.cumsum(fmask.astype(jnp.int32)) - 1  # [B]
-            n_pass = jnp.sum(fmask.astype(jnp.int32))
-            sidx = jnp.where(fmask, pos, B)  # dump lane B
-            comp_vals = jnp.zeros((B + 1, A), jnp.float32).at[sidx].set(argvals)[:B]
-            comp_ts = jnp.zeros(B + 1, jnp.int32).at[sidx].set(ts)[:B]
-            comp_grp = jnp.zeros(B + 1, jnp.int32).at[sidx].set(grp)[:B]
-            comp_valid = (jnp.zeros(B + 1, bool)
-                          .at[sidx].set(jnp.ones(B, bool))[:B])
-            cat_vals = jnp.concatenate([state["win_vals"], comp_vals], 0)
-            cat_ts = jnp.concatenate([state["win_ts"], comp_ts], 0)
-            cat_grp = jnp.concatenate([state["win_grp"], comp_grp], 0)
-            cat_valid = jnp.concatenate([state["win_valid"], comp_valid], 0)
-            # window of output row i: concat positions pos[i]+1 .. pos[i]+W
-            # (the W entries ending at the row itself)
-            gidx = pos[:, None] + 1 + jnp.arange(W)[None, :]  # [B, W]
-            gidx = jnp.clip(gidx, 0, W + B - 1)
-            w_vals = cat_vals[gidx]  # [B, W, A]
-            member = cat_valid[gidx] & (cat_grp[gidx] == grp[:, None])
-            if self.window_name == "time":
-                T = self.window_param
-                member = member & (cat_ts[gidx] > (ts[:, None] - T))
-            mf = member.astype(jnp.float32)[:, :, None]
-            env_out = dict(env)
-            kinds = self._kinds()
-            wsum = jnp.sum(w_vals * mf, axis=1)  # [B, A]
-            wcnt = jnp.sum(mf, axis=1)  # [B, 1]
-            wsumsq = (jnp.sum(w_vals * w_vals * mf, axis=1)
-                      if "stdDev" in kinds else None)
-            m3 = member[:, :, None]
-            wmin = (jnp.min(jnp.where(m3, w_vals, jnp.inf), axis=1)
-                    if "min" in kinds else None)
-            wmax = (jnp.max(jnp.where(m3, w_vals, -jnp.inf), axis=1)
-                    if "max" in kinds else None)
-            fmin, fmax = self._forever_rows(state, argvals, grp, fmask, B)
-            self._finalize_aggs(env_out, wsum, wcnt, wsumsq, wmin, wmax,
-                                fmin, fmax)
-            ov, out = self._emit(env_out, fmask, B)
-            # new buffer = last W entries ending at the batch's final
-            # passing row: concat[n_pass : n_pass + W]
-            start = jnp.clip(n_pass, 0, B)
-            new_state = dict(state)
-            dyn = self.jax.lax.dynamic_slice_in_dim
-            new_state["win_vals"] = dyn(cat_vals, start, W, axis=0)
-            new_state["win_ts"] = dyn(cat_ts, start, W, axis=0)
-            new_state["win_grp"] = dyn(cat_grp, start, W, axis=0)
-            new_state["win_valid"] = dyn(cat_valid, start, W, axis=0)
-            self._forever_scatter(state, new_state, argvals, grp, fmask)
-            return new_state, ov, out
+            return self._sliding_step(state, env, fmask, ts, grp, B)
 
         def step_counted(state, cols, ts, grp, wgrp, valid):
             new_state, ov, out = step(state, cols, ts, grp, wgrp, valid)
@@ -1068,7 +1123,11 @@ class DeviceQueryEngine:
         matmul rides the MXU); state updates are unique-slot scatters."""
         jnp = self.jnp
         W = self.W
-        Gw = self.n_wgroups
+        # row count from the state, not self.n_wgroups: under the
+        # sharded wrapper each shard sees only its slice of the window
+        # groups (plus a scratch row), and every scatter below must pad
+        # against the LOCAL row count
+        Gw = state["win_count"].shape[0]
         argvals = self._arg_vals(env, B)  # [B, A]
         tril = jnp.tril(jnp.ones((B, B), dtype=bool))
         samew = (wgrp[:, None] == wgrp[None, :]) & fmask[None, :]
@@ -1204,15 +1263,21 @@ class DeviceQueryEngine:
         self._step_cache[key] = fn
         return fn
 
-    def make_flush_step(self, jit: bool = True) -> Callable:
+    def make_flush_step(self, jit: bool = True,
+                        n_rows: Optional[int] = None) -> Callable:
         """Tumbling flush: (state) -> (state, flush_valid[G],
         out[G, n_out], n_match scalar i32) — the count gates the host
-        fetch exactly like make_step's."""
-        key = ("flush", jit)
+        fetch exactly like make_step's.
+
+        ``n_rows`` overrides the accumulator row count (default
+        ``self.n_groups``): the sharded wrapper traces this body per
+        shard over its local rows-per-shard slice (whose scratch row is
+        never touched, so it never emits)."""
+        key = ("flush", jit, n_rows)
         if key in self._step_cache:
             return self._step_cache[key]
         jnp = self.jnp
-        G = self.n_groups
+        G = self.n_groups if n_rows is None else int(n_rows)
 
         def flush(state):
             env = {N_KEY: G}
@@ -1472,13 +1537,14 @@ class DeviceQueryEngine:
         return wid
 
     def purge_idle_keys(self, state, now: int, idle_ms: Optional[int],
-                        remap=None):
+                        remap=None, wremap=None):
         """Reclaim device state rows of partition keys idle for
         ``idle_ms`` (the analog of PartitionRuntime dropping idle
         per-key instances; ids return to the free lists after their
         rows are zeroed).  ``remap`` maps logical group ids to state
-        row ids (the sharded wrapper's shard-major bijection; identity
-        by default).  Returns ``(state, n_purged_keys)``."""
+        row ids and ``wremap`` window-group ids to ring-buffer row ids
+        (the sharded wrapper's shard-major bijections; identity by
+        default).  Returns ``(state, n_purged_keys)``."""
         if not self.partition_mode or idle_ms is None:
             return state, 0
         dead_w = np.flatnonzero(
@@ -1512,7 +1578,10 @@ class DeviceQueryEngine:
                 if key in state:
                     state[key] = state[key].at[gi].set(init)
         if self.kind == "keyed_sliding":
-            wi = jnp.asarray(np.asarray(dead_w, dtype=np.int32))
+            wrows = np.asarray(dead_w, dtype=np.int64)
+            if wremap is not None:
+                wrows = wremap(wrows)
+            wi = jnp.asarray(wrows.astype(np.int32))
             state["win_valid"] = state["win_valid"].at[wi].set(False)
             state["win_count"] = state["win_count"].at[wi].set(0)
         for w in dead_w:
@@ -1869,8 +1938,16 @@ class DeviceQueryEngine:
         state, n_pass = acc(state, c, t, g, self.jnp.asarray(gkv), valid)
         return state, int(n_pass)
 
-    def _process_tumbling(self, state, cols, rel, grp, n):
-        chunks = []  # (cols, abs_ts, n_rows, keys|None)
+    def _pane_sweep(self, state, cols, rel, grp, n, acc_segment,
+                    flush_pane):
+        """Shared tumbling pane control flow: walk one batch, feed
+        intra-pane segments to ``acc_segment(state, cols, rel, grp,
+        idx) -> (state, n_pass)`` and close each crossed boundary via
+        ``flush_pane(state, abs_ts) -> state``.  The single-device path
+        and the sharded wrapper drive the SAME sweep with their own
+        accumulate/flush steps, so pane placement (``_pane_end``,
+        lengthBatch fill counts — host scalars either way) cannot
+        diverge between them."""
         if self.window_name == "timeBatch":
             # pane bookkeeping mirrors the host TimeBatchWindow: the
             # first event anchors the boundary, boundaries advance by T
@@ -1889,17 +1966,14 @@ class DeviceQueryEngine:
                 j = int(np.searchsorted(rel[i:], self._pane_end,
                                         side="left")) + i
                 if j > i:
-                    state, n_pass = self._acc_segment(
+                    state, n_pass = acc_segment(
                         state, cols, rel, grp, np.arange(i, j))
                     self._pane_fill += n_pass
                     i = j
                 if i < n:  # boundary crossed by remaining events
-                    boundary = self.base_ts + self._pane_end
-                    state, fcols, nf, keys = self._flush_cols(state)
-                    chunks.append((fcols, boundary, nf, keys))
+                    state = flush_pane(state, self.base_ts + self._pane_end)
                     self._advance_pane()
-            out_cols, out_ts = self._concat_chunks(chunks)
-            return state, out_cols, out_ts
+            return state
         # lengthBatch: need passing counts to place flush boundaries,
         # so probe the filter mask first (host-visible)
         L = int(self.window_param)
@@ -1909,17 +1983,28 @@ class DeviceQueryEngine:
             remaining = L - self._pane_fill
             pass_pos = np.flatnonzero(fmask[i:])
             if len(pass_pos) < remaining:
-                state, _ = self._acc_segment(
+                state, _ = acc_segment(
                     state, cols, rel, grp, np.arange(i, n))
                 self._pane_fill += len(pass_pos)
                 break
             j = i + int(pass_pos[remaining - 1]) + 1
-            state, _ = self._acc_segment(state, cols, rel, grp,
-                                         np.arange(i, j))
-            state, fcols, nf, keys = self._flush_cols(state)
-            chunks.append((fcols, self.base_ts + int(rel[j - 1]), nf, keys))
+            state, _ = acc_segment(state, cols, rel, grp,
+                                   np.arange(i, j))
+            state = flush_pane(state, self.base_ts + int(rel[j - 1]))
             self._pane_fill = 0
             i = j
+        return state
+
+    def _process_tumbling(self, state, cols, rel, grp, n):
+        chunks = []  # (cols, abs_ts, n_rows, keys|None)
+
+        def flush_pane(st, when):
+            st, fcols, nf, keys = self._flush_cols(st)
+            chunks.append((fcols, when, nf, keys))
+            return st
+
+        state = self._pane_sweep(state, cols, rel, grp, n,
+                                 self._acc_segment, flush_pane)
         out_cols, out_ts = self._concat_chunks(chunks)
         return state, out_cols, out_ts
 
@@ -2035,7 +2120,7 @@ class DeferredDeviceEmit:
         this batch (the ingest stage's overlap/stall evidence); None
         when every chunk is host-side."""
         for ch in self.chunks:
-            if ch["kind"] == "device":
+            if ch["kind"] in ("device", "flush"):
                 return ch["count"]
         return None
 
@@ -2049,7 +2134,7 @@ class DeferredDeviceEmit:
         if self._total is not None:
             return self._total
         dev = [(i, ch["count"]) for i, ch in enumerate(self.chunks)
-               if ch["kind"] == "device"]
+               if ch["kind"] in ("device", "flush")]
         counts = {}
         if dev:
             import jax
@@ -2066,8 +2151,17 @@ class DeferredDeviceEmit:
                 continue
             c = counts[i]
             if c == 0:
-                continue  # count gate: no column ever fetched
+                continue  # count gate: zero-match pane/batch — no
+                # column ever fetched
             total += c
+            if ch["kind"] == "flush":
+                # sharded pane flush: the matching group ids are only
+                # known once ``ov`` is on the host, so key capture
+                # happens in materialize.  Safe without the gvals
+                # snapshot: tumbling never runs in partition mode, so
+                # its group ids are never purge-recycled.
+                keep.append(ch)
+                continue
             gids = ch.pop("gids", None)
             ch["gvals"] = (eng._keys_for_gids(gids)
                            if gids is not None else None)
@@ -2079,7 +2173,7 @@ class DeferredDeviceEmit:
     def device_arrays(self) -> List:
         arrs: List = []
         for ch in self.chunks:
-            if ch["kind"] != "device":
+            if ch["kind"] not in ("device", "flush"):
                 continue
             arrs.append(ch["ov"])
             arrs.extend(ch["out"][nm] for nm in ch["names"])
@@ -2096,6 +2190,30 @@ class DeferredDeviceEmit:
         for ch in self.chunks:
             if ch["kind"] == "host":
                 parts.append((ch["cols"], ch["ts"], ch["keys"]))
+                continue
+            if ch["kind"] == "flush":
+                # sharded pane flush: rows are shard-major
+                # (owner * rows_per_shard + local); recover the global
+                # group id and emit in ascending-gid order, exactly the
+                # single-device ``_flush_cols`` ordering
+                raw_ov = np.asarray(host_arrays[pos])
+                pos += 1
+                out_np = {}
+                for nm in ch["names"]:
+                    out_np[nm] = np.asarray(host_arrays[pos])
+                    pos += 1
+                rows = np.flatnonzero(raw_ov)
+                rps = ch["rows_per_shard"]
+                gid = (rows % rps) * ch["n_shards"] + rows // rps
+                order = np.argsort(gid, kind="stable")
+                sel, gids = rows[order], gid[order]
+                out_cols = eng._out_columns(out_np, sel, gids, None, None)
+                keys = (eng._keys_for_gids(gids)
+                        if eng.group_exprs else None)
+                parts.append((out_cols,
+                              np.full(len(sel), ch["stamp"],
+                                      dtype=np.int64),
+                              keys))
                 continue
             n = ch["n"]
             # sharded chunks carry a routed-slot map instead of plain

@@ -126,15 +126,22 @@ class AppPlanner:
                     self.app_context.tpu_emit_depth = ed
             idepth = exec_ann.element("ingest.depth")
             if idepth:
-                try:
-                    nid = int(idepth)
-                except ValueError:
-                    nid = -1
-                if nid < 1:
-                    raise SiddhiAppCreationError(
-                        f"@app:execution: ingest.depth='{idepth}' must be a "
-                        "positive integer")
-                self.app_context.tpu_ingest_depth = nid
+                if idepth.lower() == "auto":
+                    # adaptive: the staging window derives its depth
+                    # from observed count-fetch RTT vs batch cadence
+                    # (core/ingest_stage.py, same controller as
+                    # emit.depth='auto')
+                    self.app_context.tpu_ingest_depth = "auto"
+                else:
+                    try:
+                        nid = int(idepth)
+                    except ValueError:
+                        nid = -1
+                    if nid < 1:
+                        raise SiddhiAppCreationError(
+                            f"@app:execution: ingest.depth='{idepth}' must "
+                            "be a positive integer or 'auto'")
+                    self.app_context.tpu_ingest_depth = nid
             amb = exec_ann.element("agg.device.min.batch")
             if amb:
                 try:

@@ -740,23 +740,35 @@ class QueryPlanner:
                        if partition_mode else None),
             defer_order_by=True,  # applied by the selector built below
         )
-        # @app:execution('tpu', devices='N'): shard the group axis of
-        # running-kind queries over an N-device mesh (same treatment as
-        # DensePatternRuntime's partition axis); other kinds stay
-        # single-device
+        # @app:execution('tpu', devices='N'): shard the query's windowed
+        # state (group axis, key axis, or — for the global sliding ring —
+        # the batch axis) over an N-device mesh; same treatment as
+        # DensePatternRuntime's partition axis
         # chaos harness: the step hook reads engine.faults — set on the
         # BASE engine so the sharded wrapper's __getattr__ still sees it
         engine.faults = self.app.app_context.fault_injector
         nd = self.app.app_context.tpu_devices
-        if nd and engine.kind == "running":
+        if nd:
             from siddhi_tpu.parallel import ShardedDeviceQueryEngine
 
-            engine = ShardedDeviceQueryEngine(engine, self._get_mesh(nd))
             import logging
 
-            logging.getLogger("siddhi_tpu").info(
-                "query '%s': device group axis sharded over %d devices",
-                name, nd)
+            try:
+                engine = ShardedDeviceQueryEngine(engine,
+                                                  self._get_mesh(nd))
+                logging.getLogger("siddhi_tpu").info(
+                    "query '%s': device %s state sharded over %d devices",
+                    name, engine.engine.kind, nd)
+            except SiddhiAppCreationError as e:
+                # NOT silent: the mesh stays idle for this query, so log
+                # the reason once and count it on the statistics feed
+                # (Queries.<name>.shardedFallbacks, served over REST)
+                logging.getLogger("siddhi_tpu").warning(
+                    "query '%s': mesh sharding unavailable, running "
+                    "single-device: %s", name, e)
+                sm = self.app.app_context.statistics_manager
+                if sm is not None:
+                    sm.record_sharded_fallback(name, str(e))
         out_target = getattr(query.output_stream, "target", None) or f"__ret_{name}"
         out_attrs = [
             Attribute(nm, t)

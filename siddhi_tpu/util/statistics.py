@@ -168,6 +168,12 @@ class StatisticsManager:
         # populated at app build — not a counter, but reported alongside
         # so execution('tpu') fallbacks are visible in the metrics feed
         self.lowering: Dict[str, str] = {}
+        # queries that requested a mesh but fell back to a single
+        # device (unsupported kind/feature): count + last reason per
+        # query, populated by the planner so the downgrade is never
+        # silent
+        self.sharded_fallbacks: Dict[str, int] = {}
+        self.sharded_fallback_reasons: Dict[str, str] = {}
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -197,10 +203,18 @@ class StatisticsManager:
     def fault_tracker(self, name: str, fault_stats) -> FaultTracker:
         return self.faults.setdefault(name, FaultTracker(name, fault_stats))
 
+    def record_sharded_fallback(self, qname: str, reason: str):
+        """A query that requested mesh sharding is running
+        single-device; counted per query with the last reason kept."""
+        self.sharded_fallbacks[qname] = (
+            self.sharded_fallbacks.get(qname, 0) + 1)
+        self.sharded_fallback_reasons[qname] = reason
+
     def stats(self) -> Dict[str, object]:
         """Metric name -> value.  Values are floats except the
-        ``Queries.<name>.loweredTo`` keys, whose values are the strings
-        'host' | 'dense' | 'device'."""
+        ``Queries.<name>.loweredTo`` /
+        ``Queries.<name>.shardedFallbackReason`` keys, whose values are
+        strings."""
         out: Dict[str, object] = {}
         # snapshot the registries: _apply_statistics_level repopulates
         # them from another thread while the reporter iterates
@@ -224,6 +238,10 @@ class StatisticsManager:
                 out[self._metric("Faults", ft.name, metric)] = v
         for qname, engine in list(self.lowering.items()):
             out[self._metric("Queries", qname, "loweredTo")] = engine
+        for qname, n in list(self.sharded_fallbacks.items()):
+            out[self._metric("Queries", qname, "shardedFallbacks")] = n
+            out[self._metric("Queries", qname, "shardedFallbackReason")] = (
+                self.sharded_fallback_reasons.get(qname, ""))
         return out
 
     def reset(self):

@@ -50,13 +50,25 @@ class TestShardedEngine:
                 assert float(b["mn"]) == float(a["mn"])
                 assert float(b["mx"]) == float(a["mx"])
 
-    def test_non_running_kind_rejected(self):
+    def test_stateless_filter_kind_rejected(self):
+        # windowed kinds shard now (tests/test_sharded_windows.py); the
+        # stateless filter kind is the one remaining single-device case
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        q = (APP + "@info(name='q') from S[v > 10] select sym, v "
+             "insert into Out;")
+        with pytest.raises(SiddhiAppCreationError, match="stateless"):
+            ShardedDeviceQueryEngine(compile_query(q, "q"), make_mesh(8))
+
+    def test_keyed_forever_agg_rejected(self):
         from siddhi_tpu.core.exceptions import SiddhiAppCreationError
 
         q = (APP + "@info(name='q') from S#window.length(3) select k, "
-             "sum(v) as s group by k insert into Out;")
-        with pytest.raises(SiddhiAppCreationError):
-            ShardedDeviceQueryEngine(compile_query(q, "q"), make_mesh(8))
+             "maxForever(v) as mf insert into Out;")
+        with pytest.raises(SiddhiAppCreationError, match="co-locate"):
+            ShardedDeviceQueryEngine(
+                compile_query(q, "q", partition_mode=True, n_wgroups=64),
+                make_mesh(8))
 
 
 class TestShardedProductPath:

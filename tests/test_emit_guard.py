@@ -74,9 +74,12 @@ ALLOWED = {
         "DeferredDenseEmit.resolve",                          # drain
     },
     "siddhi_tpu/parallel/device_shard.py": {
+        "ShardedDeviceQueryEngine.init_state",                # ingest
         "ShardedDeviceQueryEngine.put_state",                 # barrier
         "ShardedDeviceQueryEngine.process_batch_deferred",    # ingest
         "ShardedDeviceQueryEngine._deferred_chunk",           # ingest
+        "ShardedDeviceQueryEngine._sliding_chunk",            # ingest
+        "ShardedDeviceQueryEngine._acc_segment",              # ingest
     },
     "siddhi_tpu/parallel/mesh.py": {
         "make_mesh",                                          # ingest

@@ -1,0 +1,282 @@
+"""Differential suite: sharded windowed state vs the single-device path.
+
+The shard-major layout (parallel/device_shard.py) now covers every
+stateful device-query kind — tumbling panes (lengthBatch/timeBatch),
+the global sliding ring (length/time), and the keyed per-partition
+sliding window.  The contract is BIT-IDENTITY: an app compiled with
+``devices='8'`` must emit exactly the rows, in exactly the order, of
+the same app on one device — including when batches straddle pane
+boundaries, when transient ingest/emit faults fire mid-stream, across
+a crash + journal replay, and across persist()/restore.
+
+conftest.py forces an 8-device virtual CPU mesh (>= the 4-device floor
+this suite requires); anything less fails loudly there.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.device_single import DeviceQueryRuntime
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import SimulatedCrashError
+from siddhi_tpu.parallel import ShardedDeviceQueryEngine
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+DEFINE = "define stream S (sym string, v double, k int); "
+
+SINGLE = "@app:playback @app:execution('tpu') "
+SHARDED = "@app:playback @app:execution('tpu', partitions='64', devices='8') "
+
+WINDOWS = {
+    "lengthBatch": "#window.lengthBatch(5)",
+    "timeBatch": "#window.timeBatch(100 ms)",
+    "sliding_length": "#window.length(6)",
+    "sliding_time": "#window.time(200 ms)",
+}
+
+# sizes chosen against the 5-event pane: runs straddle, under-fill,
+# exactly fill, and multi-fill a pane within single batches
+BATCH_SIZES = (3, 7, 2, 11, 5, 1, 9, 16, 4)
+
+
+def query(win):
+    return (DEFINE + f"@info(name='q') from S{WINDOWS[win]} "
+            "select k, sum(v) as s, count() as c, min(v) as mn, "
+            "max(v) as mx group by k insert into OutputStream;")
+
+
+def batches(seed=9, sizes=BATCH_SIZES, n_keys=5, n_syms=1):
+    """Multi-event EventBatches with integer-valued floats (exact in
+    float32, so reduction order cannot blur the bit-identity check)."""
+    rng = np.random.default_rng(seed)
+    syms = np.asarray([f"s{i}" for i in range(n_syms)], dtype=object)
+    out, t = [], 1000
+    for n in sizes:
+        cols = {
+            "sym": syms[rng.integers(0, n_syms, n)],
+            "v": rng.integers(0, 50, n).astype(np.float64),
+            "k": rng.integers(0, n_keys, n).astype(np.int32),
+        }
+        ts = t + np.arange(n, dtype=np.int64) * 17
+        t = int(ts[-1]) + 29
+        out.append((cols, ts))
+    return out
+
+
+def run(app, sends, store=None):
+    m = SiddhiManager()
+    try:
+        if store is not None:
+            m.set_persistence_store(store)
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("OutputStream", lambda evs: got.extend(
+            tuple(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for cols, ts in sends:
+            h.send_batch(EventBatch(
+                "S", ["sym", "v", "k"],
+                {k: v.copy() for k, v in cols.items()}, ts.copy()))
+        runtimes = [getattr(qr, "device_runtime", None)
+                    for qr in rt.query_runtimes.values()]
+        for pr in getattr(rt, "partitions", {}).values():
+            runtimes += [qr.device_runtime for qr in
+                         getattr(pr, "dense_query_runtimes", {}).values()]
+        rt.shutdown()
+        return got, runtimes, rt
+    finally:
+        m.shutdown()
+
+
+def sharded_runtime(runtimes):
+    dr = [r for r in runtimes if isinstance(r, DeviceQueryRuntime)]
+    assert dr, "query did not lower to a device runtime"
+    assert isinstance(dr[0].engine, ShardedDeviceQueryEngine), (
+        "sharded path fell back to single-device")
+    return dr[0]
+
+
+def n_state_devices(state):
+    return len({d for arr in state.values() for d in arr.devices()})
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("win", sorted(WINDOWS))
+    def test_pane_straddling_batches(self, win):
+        q = query(win)
+        single, _, _ = run(SINGLE + q, batches())
+        sharded, runtimes, _ = run(SHARDED + q, batches())
+        dr = sharded_runtime(runtimes)
+        assert n_state_devices(dr.state) == 8
+        assert len(single) >= 5, "series too tame; differential is vacuous"
+        assert sharded == single
+
+    def test_keyed_sliding_partitioned(self):
+        # partition-mode sliding: per-key ring rows shard on the
+        # partition-key (wgroup) axis
+        body = (DEFINE + "partition with (sym of S) begin "
+                "@info(name='pq') from S#window.length(4) select sym, k, "
+                "sum(v) as s group by k insert into OutputStream; end;")
+        sends = batches(seed=4, n_keys=3, n_syms=4)
+        single, _, _ = run(
+            "@app:playback @app:execution('tpu', partitions='16') " + body,
+            sends)
+        sharded, runtimes, _ = run(
+            "@app:playback @app:execution('tpu', partitions='16', "
+            "devices='8') " + body, sends)
+        sharded_runtime(runtimes)
+        assert len(single) >= 5
+        assert sharded == single
+
+    def test_timer_flush_path(self):
+        # a timeBatch pane closed by the playback clock advancing (no
+        # carrier event in the closing batch) must emit identically
+        q = query("timeBatch")
+        sends = batches(sizes=(4, 3))
+        # a late straggler far past the pane end drives flush_due
+        sends.append(({"sym": np.asarray(["s0"], dtype=object),
+                       "v": np.asarray([1.0]),
+                       "k": np.asarray([0], dtype=np.int32)},
+                      np.asarray([60_000], dtype=np.int64)))
+        single, _, _ = run(SINGLE + q, sends)
+        sharded, runtimes, _ = run(SHARDED + q, sends)
+        sharded_runtime(runtimes)
+        assert len(single) >= 2
+        assert sharded == single
+
+
+class TestTransientFaults:
+    @pytest.mark.parametrize("spec", [
+        "ingest.put='transient:count=2'",
+        "emit.drain='transient:count=2'",
+    ])
+    def test_transient_fault_bit_exact(self, spec):
+        q = query("lengthBatch")
+        clean, _, _ = run(SHARDED + q, batches())
+        chaotic, runtimes, rt = run(
+            "@app:playback @app:faults(seed='3', "
+            f"transfer.retry.scale='0.0001', {spec}) "
+            "@app:execution('tpu', partitions='64', devices='8') " + q,
+            batches())
+        sharded_runtime(runtimes)
+        assert chaotic == clean, (
+            "retried transfers must not lose, dup, or reorder rows")
+        fi = rt.app_context.fault_injector
+        assert fi.stats.faults_injected == 2
+        assert fi.stats.transfer_retries == 2
+        assert fi.stats.drains_failed == 0
+
+
+class TestCrashRecovery:
+    @pytest.mark.parametrize("win", ["lengthBatch", "sliding_time"])
+    def test_crash_and_journal_replay_bit_identical(self, win):
+        q = query(win)
+        header = ("@app:name('shwincrash') @app:playback "
+                  "@app:faults(journal='256') "
+                  "@app:execution('tpu', partitions='64', devices='8') ")
+        # per-event sends: the journal replays per recorded batch, and
+        # a 30-event series crosses several pane/ring boundaries
+        rng = np.random.default_rng(13)
+        sends = [(["s0", float(rng.integers(0, 50)),
+                   int(rng.integers(0, 4))], 1000 + i * 40)
+                 for i in range(30)]
+
+        def reference():
+            got, _, _ = run(SHARDED + q, [
+                ({"sym": np.asarray([r[0]], dtype=object),
+                  "v": np.asarray([r[1]]),
+                  "k": np.asarray([r[2]], dtype=np.int32)},
+                 np.asarray([ts], dtype=np.int64)) for r, ts in sends])
+            return got
+
+        ref = reference()
+        assert len(ref) >= 4
+
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(InMemoryPersistenceStore())
+            rt = m.create_siddhi_app_runtime(header + q)
+            got = []
+            rt.add_callback("OutputStream", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:10]:
+                h.send(list(row), timestamp=ts)
+            rt.persist()  # mid-pane checkpoint
+            for row, ts in sends[10:20]:
+                h.send(list(row), timestamp=ts)
+            rt.app_context.fault_injector.configure(
+                "ingest", "crash", count=1)
+            with pytest.raises(SimulatedCrashError):
+                h.send(list(sends[20][0]), timestamp=sends[20][1])
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(header + q)
+            rt2.add_callback("OutputStream", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt2.start()
+            assert rt2.restore_last_revision() is not None
+            h2 = rt2.get_input_handler("S")
+            # the crashed send WAS journaled; replay delivered it
+            for row, ts in sends[21:]:
+                h2.send(list(row), timestamp=ts)
+            rt2.shutdown()
+            assert got == ref, (
+                f"{win}: crash+replay diverged from the uninterrupted run")
+            jr = rt2.app_context.input_journal
+            assert jr.stats.replayed_batches == 11
+        finally:
+            m.shutdown()
+
+
+class TestSnapshotRestore:
+    @pytest.mark.parametrize("win", sorted(WINDOWS))
+    def test_persist_restore_mid_pane(self, win):
+        # split after 3 batches (12 events): a lengthBatch(5) pane is
+        # 2/5 full and the sliding rings hold live rows at the cut
+        q = query(win)
+        app = "@app:name('shwinsnap') " + SHARDED + q
+        sends = batches()
+        ref, _, _ = run(app, sends, store=InMemoryPersistenceStore())
+        assert len(ref) >= 5
+
+        store = InMemoryPersistenceStore()
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(store)
+            rt = m.create_siddhi_app_runtime(app)
+            got = []
+            rt.add_callback("OutputStream", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for cols, ts in sends[:3]:
+                h.send_batch(EventBatch(
+                    "S", ["sym", "v", "k"],
+                    {k: v.copy() for k, v in cols.items()}, ts.copy()))
+            rev = rt.persist()
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            rt2.add_callback("OutputStream", lambda evs: got.extend(
+                tuple(e.data) for e in evs))
+            rt2.start()
+            rt2.restore_revision(rev)
+            dr = sharded_runtime(
+                [getattr(qr, "device_runtime", None)
+                 for qr in rt2.query_runtimes.values()])
+            assert n_state_devices(dr.state) == 8  # placement restored
+            h2 = rt2.get_input_handler("S")
+            for cols, ts in sends[3:]:
+                h2.send_batch(EventBatch(
+                    "S", ["sym", "v", "k"],
+                    {k: v.copy() for k, v in cols.items()}, ts.copy()))
+            rt2.shutdown()
+            assert got == ref, (
+                f"{win}: persist/restore diverged from the "
+                "uninterrupted run")
+        finally:
+            m.shutdown()
