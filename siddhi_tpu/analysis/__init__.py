@@ -1,0 +1,52 @@
+"""siddhi_tpu.analysis — unified static-analysis pass for the engine's
+un-typeable invariants.
+
+The engine's performance and crash-recovery guarantees rest on contracts
+the type system cannot see: device→host transfers only through the
+count-gated drain (``core/emit_queue.py``), H2D puts only through
+``staged_put`` (``core/ingest_stage.py``), no fault swallowed without a
+log line or counter, no host clock / logging / materialization inside a
+jitted step, no compile-cache churn on the per-batch path, and no
+cross-thread attribute write outside the engine lock.
+
+This package enforces them as one reusable pass — the compile-time
+analog of the paper's query-validation phase:
+
+- ``index``      — single-parse-per-module ``ModuleIndex`` with
+                   qualified-name scope resolution shared by every rule
+- ``framework``  — ``Rule`` base class + registry, ``Finding``,
+                   allowlists with required justifications, stale-entry
+                   expiry
+- ``rules/``     — one module per rule (six registered today)
+- ``reporting``  — text and JSON reporters, ``--baseline`` support
+- ``__main__``   — ``python -m siddhi_tpu.analysis`` CLI (also exposed
+                   as the ``siddhi-tpu-analysis`` console script)
+
+Run ``python -m siddhi_tpu.analysis --list-rules`` for the catalog.
+"""
+
+from .framework import (  # noqa: F401
+    Allowlist,
+    Finding,
+    Rule,
+    all_rules,
+    get_rule,
+    register,
+    run_rules,
+)
+from .index import ModuleIndex, index_package  # noqa: F401
+
+# importing the subpackage registers every built-in rule
+from . import rules  # noqa: F401,E402
+
+__all__ = [
+    "Allowlist",
+    "Finding",
+    "ModuleIndex",
+    "Rule",
+    "all_rules",
+    "get_rule",
+    "index_package",
+    "register",
+    "run_rules",
+]

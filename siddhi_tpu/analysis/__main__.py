@@ -1,0 +1,106 @@
+"""``python -m siddhi_tpu.analysis`` — run the invariant pass.
+
+Exit status 0 when the package is clean (zero unbaselined findings,
+no stale allowlist entries), 1 otherwise, 2 on usage errors.
+
+Examples::
+
+    python -m siddhi_tpu.analysis                  # whole package, text
+    python -m siddhi_tpu.analysis --json
+    python -m siddhi_tpu.analysis --list-rules
+    python -m siddhi_tpu.analysis --rules jit-purity,retrace-hazard
+    python -m siddhi_tpu.analysis --baseline analysis_baseline.json
+    python -m siddhi_tpu.analysis --write-baseline analysis_baseline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .framework import all_rules, get_rule, run_rules
+from .index import index_package
+from . import reporting
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.analysis",
+        description=("Unified static-analysis pass for siddhi_tpu's "
+                     "device-contract, concurrency, and jit-purity "
+                     "invariants."))
+    parser.add_argument(
+        "--root", default=None,
+        help="package directory to scan (default: the installed "
+             "siddhi_tpu package)")
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated subset of rule names (default: all)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    parser.add_argument(
+        "--json", action="store_true", help="JSON report on stdout")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="JSON file of acknowledged finding identities to subtract")
+    parser.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="write current unallowlisted findings as a baseline and "
+             "exit 0")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.rules:
+        try:
+            rules = [get_rule(n.strip()) for n in args.rules.split(",")
+                     if n.strip()]
+        except KeyError as e:
+            parser.error(str(e))
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    if args.root is not None:
+        root = Path(args.root)
+        rel_base = root.parent
+    else:
+        root = Path(__file__).resolve().parent.parent
+        rel_base = root.parent
+    if not root.is_dir():
+        parser.error(f"--root {root} is not a directory")
+
+    indexes = index_package(root, rel_base)
+    result = run_rules(indexes, rules)
+    findings = result["findings"]
+    suppressed = result["suppressed"]
+
+    if args.write_baseline:
+        reporting.write_baseline(args.write_baseline, findings)
+        print(f"wrote {len(findings)} finding identity(ies) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baselined_count = 0
+    stale_baseline = ()
+    if args.baseline:
+        baseline = reporting.load_baseline(args.baseline)
+        findings, baselined, stale_baseline = \
+            reporting.apply_baseline(findings, baseline)
+        baselined_count = len(baselined)
+
+    if args.json:
+        print(reporting.render_json(
+            findings, rules, suppressed, baselined_count,
+            stale_baseline, modules=len(indexes)))
+    else:
+        print(reporting.render_text(
+            findings, rules, len(suppressed), baselined_count,
+            stale_baseline, modules=len(indexes)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Built-in rules — importing this package registers them all.
+
+Catalog (one module per rule):
+
+- ``host_sync``       — ``host-sync-hazard``: D2H materialization only
+  through the count-gated emit drain (ex ``tests/test_emit_guard.py``)
+- ``ingest_put``      — ``ingest-put-bypass``: H2D puts only through
+  ``staged_put`` (ex ``tests/test_ingest_guard.py``)
+- ``broad_except``    — ``broad-except-swallow``: no fault vanishes
+  without a log/counter (ex ``tests/test_except_guard.py``)
+- ``lock_discipline`` — ``lock-discipline``: attributes shared between a
+  thread-entry function and the main batch path stay under the lock
+- ``jit_purity``      — ``jit-purity``: no host clock / logging / fault
+  hooks / tracer materialization inside jitted callables
+- ``retrace``         — ``retrace-hazard``: no un-memoized
+  ``jax.jit``/``shard_map`` on per-batch functions
+"""
+
+from . import (  # noqa: F401
+    broad_except,
+    host_sync,
+    ingest_put,
+    jit_purity,
+    lock_discipline,
+    retrace,
+)

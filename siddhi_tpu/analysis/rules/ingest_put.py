@@ -1,0 +1,48 @@
+"""ingest-put-bypass: every ingest-path H2D transfer goes through staging.
+
+The ingest pipeline's contract is that host→device puts of BATCH data
+happen ONLY through ``core/ingest_stage.py`` ``staged_put`` — the one
+wrapper that arms the ``ingest.put`` fault-injection site (bounded
+retry-with-backoff, crash-journal semantics) and counts
+``IngestStats.device_puts``.  A direct ``jax.device_put`` on a batch
+path silently bypasses both the fault harness and the staging counters:
+chaos runs stop covering that transfer and the overlap evidence
+under-reports.
+
+The rule scans the whole package and reports every ``*.device_put(...)``
+call — regardless of the receiver chain (``jax.device_put``,
+``self.jax.device_put``, ...) — whose enclosing function is not
+allowlisted (buckets: staging / mesh / state, see ``allowlists.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..framework import Finding, Rule, register
+from ..index import ModuleIndex
+
+
+@register
+class IngestPutBypassRule(Rule):
+    name = "ingest-put-bypass"
+    description = (
+        "direct device_put outside the sanctioned staging/mesh/state "
+        "sites — batch ingest must go through core/ingest_stage.staged_put")
+
+    def check(self, index: ModuleIndex) -> Iterable[Finding]:
+        for call in index.calls():
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "device_put":
+                yield Finding(
+                    rule=self.name,
+                    rel=index.rel,
+                    line=call.lineno,
+                    scope=index.qualname(call),
+                    message=(
+                        "direct device_put — route batch ingest through "
+                        "core/ingest_stage.staged_put (fault site + "
+                        "counters), or allowlist it WITH a bucket "
+                        "justification"),
+                )

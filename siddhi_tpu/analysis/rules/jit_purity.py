@@ -1,0 +1,165 @@
+"""jit-purity: jitted callables stay pure.
+
+A callable handed to ``jax.jit`` / ``shard_map`` is traced once per
+shape signature and replayed as compiled XLA: any host-side effect in
+its body either runs only at trace time (logging, stats counters, fault
+hooks — silently NOT per batch, which is worse than failing) or
+materializes a tracer and breaks/stalls compilation (``float()`` /
+``int()`` / ``np.asarray`` on traced values, host clocks).  The
+engine's steps therefore keep every effect — fault checks, EmitStats /
+IngestStats increments, logging, wall-clock reads — on the host side of
+the step boundary.
+
+The rule finds ``jax.jit(...)`` / ``shard_map(...)`` call sites (incl.
+``self.jax.jit`` receivers and ``get_shard_map()(...)``), resolves the
+callable argument to a function definition in the same module (local
+``def step(...)`` / ``lambda``), and reports banned constructs anywhere
+in the resolved body:
+
+- host clocks: ``time.time`` / ``time.monotonic`` / ``time.perf_counter``
+  / ``datetime.now``
+- logging / printing: any call on a ``log`` / ``logger`` / ``logging``
+  receiver, bare ``print``
+- fault hooks: ``.check(...)`` on a fault-injector receiver
+  (``fi`` / ``faults`` / ``fault_injector`` / ``injector``)
+- stats counters: writes to a ``*.stats.*`` attribute chain
+- tracer materialization: ``np.asarray`` / ``np.array`` /
+  ``jax.device_get``, and bare ``float()`` / ``int()`` / ``bool()`` on
+  a non-literal argument
+
+Callables the rule cannot resolve statically (attributes, imports from
+other modules) are skipped — the differential suites cover those paths
+dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..framework import Finding, Rule, register
+from ..index import ModuleIndex
+
+JIT_NAMES = {"jax.jit", "jit"}
+SHARD_NAMES = {"shard_map", "jax.shard_map"}
+
+_CLOCKS = {"time.time", "time.monotonic", "time.perf_counter",
+           "time.perf_counter_ns", "datetime.now", "datetime.datetime.now"}
+_LOG_RECEIVERS = {"log", "logger", "logging"}
+_FAULT_RECEIVERS = {"fi", "faults", "fault_injector", "injector"}
+_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "jax.device_get"}
+_CASTS = {"float", "int", "bool"}
+
+
+def jit_call_sites(index: ModuleIndex) -> List[Tuple[ast.Call, ast.AST]]:
+    """(call, callable-arg) for every jit/shard_map wrapping site."""
+    out = []
+    for call in index.calls():
+        name = index.dotted(call.func)
+        is_wrapper = name in JIT_NAMES or name in SHARD_NAMES
+        if not is_wrapper and isinstance(call.func, ast.Call):
+            # get_shard_map()(step, ...): the wrapper is itself a call
+            inner = index.dotted(call.func.func)
+            is_wrapper = inner is not None and \
+                inner.split(".")[-1] == "get_shard_map"
+        if is_wrapper and call.args:
+            out.append((call, call.args[0]))
+    return out
+
+
+def resolve_callable(index: ModuleIndex, site: ast.Call,
+                     arg: ast.AST) -> Optional[ast.AST]:
+    """The function definition a jit argument refers to, searching the
+    enclosing scopes outward; None when not statically resolvable."""
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Call):
+        # functools.partial(f, ...) / shard_map(f, ...): recurse on the
+        # wrapped callable
+        if arg.args:
+            return resolve_callable(index, site, arg.args[0])
+        return None
+    if not isinstance(arg, ast.Name):
+        return None
+    scope = index.qualname(site)
+    parts = scope.split(".") if scope != "<module>" else []
+    while True:
+        qual = ".".join(parts + [arg.id]) if parts else arg.id
+        fn = index.functions.get(qual)
+        if fn is not None:
+            return fn
+        if not parts:
+            return None
+        parts.pop()
+
+
+def impure_constructs(index: ModuleIndex, fn: ast.AST
+                      ) -> List[Tuple[int, str]]:
+    """(line, description) for every banned construct in a jitted
+    callable's subtree (nested local defs are traced too, so the whole
+    subtree counts)."""
+    hits: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = index.dotted(node.func)
+            if name is None:
+                continue
+            base = name.split(".")[0]
+            leaf = name.split(".")[-1]
+            if name in _CLOCKS:
+                hits.append((node.lineno, f"host clock {name}()"))
+            elif base in _LOG_RECEIVERS and base != leaf:
+                hits.append((node.lineno, f"logging call {name}()"))
+            elif name == "print":
+                hits.append((node.lineno, "print()"))
+            elif leaf == "check" and base in _FAULT_RECEIVERS:
+                hits.append((node.lineno, f"fault hook {name}()"))
+            elif name in _MATERIALIZERS:
+                hits.append((node.lineno, f"tracer materialization {name}()"))
+            elif name in _CASTS and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                hits.append((node.lineno,
+                             f"tracer materialization {name}() on a "
+                             "non-literal"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                tname = index.dotted(t) if isinstance(t, ast.Attribute) \
+                    else None
+                if tname and "stats" in tname.split(".")[:-1]:
+                    hits.append((t.lineno,
+                                 f"stats counter write {tname}"))
+    return hits
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "host clock / logging / fault hook / stats counter / tracer "
+        "materialization inside a callable passed to jax.jit or shard_map")
+
+    def check(self, index: ModuleIndex) -> Iterable[Finding]:
+        reported: Set[Tuple[str, int]] = set()
+        for site, arg in jit_call_sites(index):
+            fn = resolve_callable(index, site, arg)
+            if fn is None:
+                continue
+            fn_qual = index.def_qualname(fn)
+            for line, what in impure_constructs(index, fn):
+                if (fn_qual, line) in reported:
+                    continue  # same fn jitted at several sites
+                reported.add((fn_qual, line))
+                yield Finding(
+                    rule=self.name,
+                    rel=index.rel,
+                    line=line,
+                    scope=fn_qual,
+                    message=(
+                        f"{what} inside a jitted callable — effects run "
+                        "at trace time only (or break tracing); hoist "
+                        "to the host side of the step boundary, or "
+                        "allowlist with a justification"),
+                )

@@ -1,0 +1,205 @@
+"""lock-discipline: cross-thread attribute writes stay under the lock.
+
+The engine spawns real threads: the async junction worker
+(``core/stream.py``), the scheduler's wall-clock timer
+(``util/scheduler.py``), the statistics reporter, the playback
+heartbeat, the service listener, and the transport reconnect chain
+(``threading.Timer`` in ``transport/retry.py``).  All of them share
+mutable engine state with the main batch path; the convention is that
+shared state is touched under the engine lock (``process_lock`` or a
+component lock), but nothing enforced it — PRs 1–4 added emit/ingest
+queues and scheduler interactions that no guard checked at all.
+
+Per class, the rule:
+
+1. finds **thread entries**: methods or local functions passed as
+   ``threading.Thread(target=...)`` / ``threading.Timer(..., fn)``;
+2. closes them over ``self.method()`` calls — a call made inside a
+   ``with <...lock>`` block does NOT extend the closure (the callee runs
+   lock-protected there, like ``Scheduler._loop`` →
+   ``advance`` under ``process_lock``);
+3. collects direct ``self.<attr>`` writes on both sides (constructors
+   — ``__init__`` and the transport SPI's ``init``/``_init_*``
+   initializers — are excluded: construction happens-before thread
+   start);
+4. reports every attribute written by BOTH a thread-side function and a
+   main-path method where any write site is not lexically under a
+   lock-``with``.
+
+The lexical lock check is conservative by design: disciplines the rule
+cannot see (GIL-atomic monotonic flags, caller-holds-lock contracts)
+are allowlisted per attribute with a written justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..framework import Finding, Rule, register
+from ..index import ModuleIndex
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
+
+
+def _target_of(call: ast.Call, index: ModuleIndex):
+    """(kind, node) for a thread-launching call: kind 'method' with the
+    method name, or 'local' with the Name node of a local function."""
+    name = index.dotted(call.func)
+    target = None
+    if name in _THREAD_CTORS:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+    elif name in _TIMER_CTORS:
+        if len(call.args) >= 2:
+            target = call.args[1]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "function":
+                    target = kw.value
+    if target is None:
+        return None
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id in ("self", "cls"):
+        return ("method", target.attr)
+    if isinstance(target, ast.Name):
+        return ("local", target.id)
+    return None
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "attribute written from both a thread-entry function and the "
+        "main path without the engine lock")
+
+    def check(self, index: ModuleIndex) -> Iterable[Finding]:
+        for cls_qual, cls in index.classes.items():
+            yield from self._check_class(index, cls_qual, cls)
+
+    # -- per-class analysis -------------------------------------------------
+
+    def _methods(self, cls: ast.ClassDef) -> Dict[str, ast.AST]:
+        return {n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _own_nodes(self, index: ModuleIndex, fn: ast.AST, qual: str):
+        """Walk ``fn``'s body excluding nested function/class scopes —
+        a local ``def loop()`` inside ``start()`` is its own scope."""
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if index.qualname(node) == qual:
+                yield node
+
+    def _self_writes(self, index: ModuleIndex, fn: ast.AST, qual: str
+                     ) -> List[Tuple[str, int, bool]]:
+        """(attr, line, under_lock) for every direct ``self.x = / +=``
+        in ``fn``'s own scope."""
+        out = []
+        for node in self._own_nodes(index, fn, qual):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in ("self", "cls"):
+                    out.append((t.attr, t.lineno, index.under_lock(t)))
+        return out
+
+    def _self_calls(self, index: ModuleIndex, fn: ast.AST, qual: str
+                    ) -> List[Tuple[str, bool]]:
+        """(method name, under_lock) for every ``self.m(...)`` call in
+        ``fn``'s own scope."""
+        out = []
+        for node in self._own_nodes(index, fn, qual):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in ("self", "cls"):
+                out.append((node.func.attr, index.under_lock(node)))
+        return out
+
+    def _check_class(self, index: ModuleIndex, cls_qual: str,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        methods = self._methods(cls)
+        # 1. thread entries
+        roots: List[Tuple[str, ast.AST, str]] = []  # (label, fn, qual)
+        for mname, m in methods.items():
+            # thread ctors may sit inside a local def, so scan the full
+            # method subtree (not just its own scope)
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Call):
+                    continue
+                tgt = _target_of(node, index)
+                if tgt is None:
+                    continue
+                kind, tname = tgt
+                if kind == "method" and tname in methods:
+                    roots.append((tname, methods[tname],
+                                  f"{cls_qual}.{tname}"))
+                elif kind == "local":
+                    # resolve the local function def by qualified name,
+                    # searching outward from the launching scope
+                    scope = index.qualname(node)
+                    fn = index.functions.get(f"{scope}.{tname}")
+                    if fn is not None:
+                        roots.append((tname, fn, f"{scope}.{tname}"))
+        if not roots:
+            return
+        # 2. closure over unlocked self.method() calls
+        thread_fns: Dict[str, Tuple[ast.AST, str]] = {}
+        work = list(roots)
+        while work:
+            label, fn, qual = work.pop()
+            if label in thread_fns:
+                continue
+            thread_fns[label] = (fn, qual)
+            for callee, locked in self._self_calls(index, fn, qual):
+                if locked:
+                    continue  # callee runs under the lock at this site
+                if callee in methods and callee not in thread_fns:
+                    work.append((callee, methods[callee],
+                                 f"{cls_qual}.{callee}"))
+        # 3. writes on each side
+        thread_writes: Dict[str, List[Tuple[str, int, bool]]] = {}
+        for label, (fn, qual) in thread_fns.items():
+            for attr, line, locked in self._self_writes(index, fn, qual):
+                thread_writes.setdefault(attr, []).append(
+                    (qual, line, locked))
+        main_writes: Dict[str, List[Tuple[str, int, bool]]] = {}
+        for mname, m in methods.items():
+            if mname in thread_fns or mname in ("__init__", "__new__",
+                                                "init") \
+                    or mname.startswith("_init"):
+                continue
+            mqual = f"{cls_qual}.{mname}"
+            for attr, line, locked in self._self_writes(index, m, mqual):
+                main_writes.setdefault(attr, []).append(
+                    (mqual, line, locked))
+        # 4. conflicts: one finding per attribute, keyed Class.attr
+        for attr in sorted(set(thread_writes) & set(main_writes)):
+            sites = thread_writes[attr] + main_writes[attr]
+            unlocked = [(q, ln) for q, ln, locked in sites if not locked]
+            if not unlocked:
+                continue
+            where = ", ".join(f"{q}:{ln}" for q, ln in unlocked)
+            yield Finding(
+                rule=self.name,
+                rel=index.rel,
+                line=unlocked[0][1],
+                scope=f"{cls_qual}.{attr}",
+                message=(
+                    f"'{attr}' is written from both a thread entry "
+                    f"({', '.join(sorted(thread_fns))}) and the main "
+                    f"path, with unlocked write(s) at {where} — guard "
+                    "every write with the engine/component lock, or "
+                    "allowlist with a justification"),
+            )

@@ -1,0 +1,112 @@
+"""retrace-hazard: no compile-cache churn on the per-batch path.
+
+``jax.jit`` / ``shard_map`` wrapping is cheap, but every FRESH wrapper
+carries its own trace cache: wrapping inside a per-batch/per-event
+function and calling the result re-traces and re-compiles on every
+batch — a silent 100-1000x slowdown that still produces correct
+results.  The engine's discipline is that step builders memoize their
+compiled callables (``self._step``, ``self._step_cache[key]``,
+``self._kernels[(B, W)]``) so the hot path only ever LOOKS UP.
+
+The rule finds jit/shard_map wrapping sites whose enclosing function
+name matches the per-batch pattern (``process*``, ``*_chunk``,
+``*_step``, ``receive``, ``advance``...) and reports them unless the
+wrapped callable escapes into an instance attribute — directly
+(``self._fn = jax.jit(f)``), through a subscript cache
+(``self._cache[k] = jax.jit(f)``), or via a local binding that is then
+stored (``k = jit(f); self._kernels[key] = k``).  Builders called from
+``__init__`` only are not matched; a genuinely-sanctioned per-batch
+wrap goes in the allowlist with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from ..framework import Finding, Rule, register
+from ..index import ModuleIndex
+from .jit_purity import jit_call_sites
+
+#: function names that run once per batch/event/pane — the hot path.
+HOT_NAME_RE = re.compile(
+    r"(?:^|_)(process|receive|send|dispatch|deliver|publish|advance|fire|"
+    r"drain|flush|submit|finish|emit|step|chunk|segment|scatter|reduce|"
+    r"kernel|acc|on_time|sweep)(?:$|_)")
+
+
+def _self_attr_target(node: ast.AST) -> bool:
+    """True for ``self.x`` or ``self.x[...]`` assignment targets."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls"))
+
+
+def _escapes_to_instance(index: ModuleIndex, site: ast.Call,
+                         hot_fn: ast.AST) -> bool:
+    """Does the jit wrapper produced at ``site`` get memoized on the
+    instance inside ``hot_fn``?"""
+    # direct: an ancestor assignment whose target is self.x / self.x[k]
+    local_names: Set[str] = set()
+    for anc in index.ancestors(site):
+        if anc is hot_fn:
+            break
+        if isinstance(anc, ast.Assign):
+            for t in anc.targets:
+                if _self_attr_target(t):
+                    return True
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+        elif isinstance(anc, (ast.AugAssign, ast.AnnAssign)) and \
+                _self_attr_target(anc.target):
+            return True
+    if not local_names:
+        return False
+    # indirect: a local bound from the site is later stored on self
+    for node in ast.walk(hot_fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in local_names:
+            if any(_self_attr_target(t) for t in node.targets):
+                return True
+    return False
+
+
+@register
+class RetraceHazardRule(Rule):
+    name = "retrace-hazard"
+    description = (
+        "un-memoized jax.jit/shard_map wrapping inside a per-batch "
+        "function — compile cache churn on the hot path")
+
+    def check(self, index: ModuleIndex) -> Iterable[Finding]:
+        sites = jit_call_sites(index)
+        site_nodes = {s for s, _ in sites}
+        for site, _arg in sites:
+            fn = index.enclosing(site, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+            if fn is None:
+                continue  # module-level wrap compiles once at import
+            if not HOT_NAME_RE.search(fn.name):
+                continue
+            # a shard_map(...) nested inside jax.jit(shard_map(...)) is
+            # covered by the outer wrapping site's escape analysis
+            if any(anc in site_nodes for anc in index.ancestors(site)):
+                continue
+            if _escapes_to_instance(index, site, fn):
+                continue
+            yield Finding(
+                rule=self.name,
+                rel=index.rel,
+                line=site.lineno,
+                scope=index.def_qualname(fn),
+                message=(
+                    "jax.jit/shard_map wrapped inside a per-batch "
+                    "function without memoizing the result on the "
+                    "instance — every call re-traces and re-compiles; "
+                    "hoist to a builder / cache it, or allowlist with "
+                    "a justification"),
+            )

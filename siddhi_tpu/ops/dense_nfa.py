@@ -1396,7 +1396,9 @@ class DensePatternEngine:
         rel = min(rel, 2**31 - 1)
         tstep = self.make_time_step()
         state, emit, outs, fire, n_emit = tstep(state, np.int32(rel))
-        if int(n_emit) == 0:
+        # explicit count-gate fetch: int(device_scalar) is an IMPLICIT
+        # transfer and would trip jax.transfer_guard('disallow')
+        if int(self.jax.device_get(n_emit)) == 0:
             return state, None
         emit_np = np.asarray(emit)
         rows, lanes = np.nonzero(emit_np)

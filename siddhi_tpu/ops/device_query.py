@@ -1881,7 +1881,9 @@ class DeviceQueryEngine:
     def _flush_cols(self, state):
         flush = self.make_flush_step()
         state, ov, out, n_match = flush(state)
-        if int(n_match) == 0:
+        # explicit count-gate fetch: int(device_scalar) is an IMPLICIT
+        # transfer and would trip jax.transfer_guard('disallow')
+        if int(self.jax.device_get(n_match)) == 0:
             # count gate: empty pane — no group/output column fetched
             return state, self._empty_cols(), 0, (
                 [] if self.group_exprs else None)
@@ -1936,7 +1938,8 @@ class DeviceQueryEngine:
                        dtype=np.float32)
         gkv[:n] = self._gk_vals(grp[idx], n)
         state, n_pass = acc(state, c, t, g, self.jnp.asarray(gkv), valid)
-        return state, int(n_pass)
+        # explicit count-gate fetch (transfer_guard-safe, see _flush_cols)
+        return state, int(self.jax.device_get(n_pass))
 
     def _pane_sweep(self, state, cols, rel, grp, n, acc_segment,
                     flush_pane):

@@ -512,8 +512,11 @@ class ShardedDeviceQueryEngine:
             fi.check("step.shard")
         state, total = self._acc(state, *args)
         # blocking count fetch — the same synchronization point the
-        # single-device _acc_segment has (pane placement needs it)
-        return state, int(total)
+        # single-device _acc_segment has (pane placement needs it);
+        # explicit device_get so transfer_guard('disallow') stays happy
+        import jax
+
+        return state, int(jax.device_get(total))
 
     def _flush_pane_chunk(self, state, when, pending):
         """Close the open pane: shard-local flush step, result deferred

@@ -53,9 +53,12 @@ class ConnectRetryMixin:
         self._shutdown = False
 
     def start(self):
-        self._shutdown = False
-        self.failed = False
-        self._retry_attempts = 0
+        # under the retry lock: a pending Timer chain from a previous
+        # start may still be mutating these from its own thread
+        with self._retry_lock:
+            self._shutdown = False
+            self.failed = False
+            self._retry_attempts = 0
         self._connect_with_retry()
 
     def _on_retry_exhausted(self, e: Exception):
@@ -85,12 +88,15 @@ class ConnectRetryMixin:
                 fi.check(getattr(self, "_fault_site_connect", "connect"))
             self.connect()
         except ConnectionUnavailableError as e:
-            self._retry_attempts += 1
-            if (self._retry_max_attempts
-                    and self._retry_attempts >= self._retry_max_attempts):
-                self.failed = True
-                with self._retry_lock:
+            with self._retry_lock:
+                self._retry_attempts += 1
+                exhausted = (
+                    self._retry_max_attempts
+                    and self._retry_attempts >= self._retry_max_attempts)
+                if exhausted:
+                    self.failed = True
                     self._retrying = False
+            if exhausted:
                 fi = getattr(self, "_fault_injector", None)
                 if fi is not None:
                     fi.stats.connect_retries_exhausted += 1
@@ -104,18 +110,19 @@ class ConnectRetryMixin:
             )
             t = threading.Timer(interval / 1000.0, self._retry_connect)
             t.daemon = True
-            self._retry_timer = t
+            with self._retry_lock:
+                self._retry_timer = t
             t.start()
             return  # flag stays held until the timer fires
         except BaseException:
             with self._retry_lock:
                 self._retrying = False
             raise
-        self.connected = True
         self._retry.reset()
-        self._retry_attempts = 0
-        self.failed = False
         with self._retry_lock:
+            self.connected = True
+            self._retry_attempts = 0
+            self.failed = False
             self._retrying = False
 
     def _retry_connect(self):
@@ -126,10 +133,9 @@ class ConnectRetryMixin:
 
     def _shutdown_retry(self):
         """Cancel any pending chain; leaves the mixin restartable."""
-        self._shutdown = True
-        t = self._retry_timer
+        with self._retry_lock:
+            self._shutdown = True
+            t, self._retry_timer = self._retry_timer, None
+            self._retrying = False
         if t is not None:
             t.cancel()
-            self._retry_timer = None
-        with self._retry_lock:
-            self._retrying = False

@@ -8,6 +8,8 @@ planner wiring the pattern hot path
 (util/parser/StateInputStreamParser.java:76-146).
 """
 
+import contextlib
+
 import numpy as np
 import pytest
 
@@ -24,14 +26,25 @@ def manager():
     m.shutdown()
 
 
-def run_app(manager, app, sends, out="Alerts", stream="Txn"):
+def run_app(manager, app, sends, out="Alerts", stream="Txn",
+            transfer_guard=False):
     rt = manager.create_siddhi_app_runtime(app)
     got = []
     rt.add_callback(out, lambda evs: got.extend(e.data for e in evs))
     rt.start()
     h = rt.get_input_handler(stream)
-    for row, ts in sends:
-        h.send(row, timestamp=ts)
+    # transfer_guard: device↔host crossings in the event loop must be
+    # explicit (staged_put in, device_get on the drain) — the dynamic
+    # twin of the host-sync-hazard analysis rule.  No-op on the CPU
+    # backend; bites on real accelerator runs.
+    guard = contextlib.nullcontext()
+    if transfer_guard:
+        import jax
+
+        guard = jax.transfer_guard("disallow")
+    with guard:
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
     rt.shutdown()
     return rt, got
 
@@ -75,7 +88,8 @@ class TestDensePath:
         # `every`: a match must consume only the matched instance — the
         # completing event re-arms the start in the SAME step, so the
         # next event completes again (reset-on-emit would lose it)
-        _rt, dense = run_app(manager, TPU + PATTERN_APP, SENDS)
+        _rt, dense = run_app(manager, TPU + PATTERN_APP, SENDS,
+                             transfer_guard=True)
         m2 = SiddhiManager()
         _rt2, host = run_app(m2, PATTERN_APP, SENDS)
         m2.shutdown()

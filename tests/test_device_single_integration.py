@@ -11,6 +11,8 @@ query/selector/QuerySelector.java:76-99 driven through SiddhiManager
 (the black-box style of the reference test corpus).
 """
 
+import contextlib
+
 import numpy as np
 import pytest
 
@@ -18,8 +20,25 @@ from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.device_single import DeviceQueryRuntime
 
 
+def hot_loop_transfer_guard(enabled):
+    """``jax.transfer_guard('disallow')`` around the batch loop: every
+    device↔host crossing must be explicit (staged_put / device_get on
+    the drain).  An implicit transfer — ``int(device_scalar)``,
+    ``np.asarray(device_array)`` — raises instead of silently stalling.
+    The static twin is the ``host-sync-hazard`` analysis rule; this pins
+    the same contract dynamically.  On the CPU backend the guard is a
+    no-op (jax treats host<->cpu-device crossings as free), so it only
+    bites on real accelerator runs — wiring it here keeps tier-1 green
+    while making TPU CI enforce the contract."""
+    if not enabled:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.transfer_guard("disallow")
+
+
 def run_app(app, sends, out="OutputStream", mode=None, batches=None,
-            want_runtime=False):
+            want_runtime=False, transfer_guard=False):
     """Run via the public API in playback mode -> list of row dicts.
 
     ``batches``: optional list of (start, end) slices — events are sent
@@ -35,15 +54,16 @@ def run_app(app, sends, out="OutputStream", mode=None, batches=None,
         rt.add_callback(out, lambda evs: got.extend(evs))
         rt.start()
         h = rt.get_input_handler("S")
-        if batches is None:
-            for row, ts in sends:
-                h.send(row, timestamp=ts)
-        else:
-            from siddhi_tpu.core.event import Event
+        with hot_loop_transfer_guard(transfer_guard):
+            if batches is None:
+                for row, ts in sends:
+                    h.send(row, timestamp=ts)
+            else:
+                from siddhi_tpu.core.event import Event
 
-            for lo, hi in batches:
-                chunk = sends[lo:hi]
-                h.send([Event(t, list(r)) for r, t in chunk])
+                for lo, hi in batches:
+                    chunk = sends[lo:hi]
+                    h.send([Event(t, list(r)) for r, t in chunk])
         qr = next(iter(rt.query_runtimes.values()))
         runtime = getattr(qr, "device_runtime", None)
         rt.shutdown()
@@ -79,11 +99,12 @@ def assert_rows_close(host, dev, ordered=True):
                 assert x == y, f"row {i}: host {a} != device {b}"
 
 
-def differential(app, sends, ordered=True, out="OutputStream", batches=None):
+def differential(app, sends, ordered=True, out="OutputStream", batches=None,
+                 transfer_guard=False):
     """Host vs tpu through the product API; asserts the device path ran."""
     host = run_app(app, sends, out=out, batches=batches)
     dev, runtime = run_app(app, sends, out=out, mode="tpu", batches=batches,
-                           want_runtime=True)
+                           want_runtime=True, transfer_guard=transfer_guard)
     assert isinstance(runtime, DeviceQueryRuntime), (
         "query did not lower to the device path")
     assert runtime.step_invocations > 0, "jitted device step never ran"
@@ -107,7 +128,10 @@ class TestFilterLowering:
                     "insert into OutputStream;")
 
     def test_filter_projection(self):
-        dev = differential(self.APP, series(200, seed=1))
+        # transfer_guard: the device-mode batch loop may only cross the
+        # device boundary explicitly (see hot_loop_transfer_guard)
+        dev = differential(self.APP, series(200, seed=1),
+                           transfer_guard=True)
         # LONG passthrough stays exact at native width
         assert all(isinstance(r["k"], (int, np.integer)) for r in dev)
 

@@ -1,147 +1,39 @@
 """Tier-1 guard: no stray synchronous device→host transfers.
 
-The async emit pipeline's contract is that jit outputs leave the device
-ONLY through the sanctioned drain path (``core/emit_queue.py``
-``fetch_coalesced`` / ``EmitQueue.drain``) or an explicit barrier
-(snapshot/restore, timer steps).  A future edit that sneaks a
-``np.asarray(...)`` / ``jax.device_get(...)`` onto the hot batch path
-re-introduces the per-batch transfer stall this PR removed — and does so
-silently, because results stay correct.
-
-This test AST-scans the device runtime modules and fails when a
-materializing call appears in a function outside the curated allowlist
-below.  Host-side ingest conversions (interning, routing, padding) also
-use ``np.asarray`` on genuine numpy inputs; those functions are listed
-explicitly so NEW call sites still trip the guard.
+Thin shim over the ``host-sync-hazard`` rule in ``siddhi_tpu.analysis``
+(which absorbed this file's AST scanner, allowlist, and staleness
+check).  The test names are stable tier-1 anchors; the contract, the
+scanned-module list, and the curated allowlist (with bucket
+justifications) now live in ``siddhi_tpu/analysis/rules/host_sync.py``
+and ``siddhi_tpu/analysis/allowlists.py``.
 """
 
-import ast
 from pathlib import Path
+
+from siddhi_tpu.analysis import get_rule, index_package, run_rules
 
 REPO = Path(__file__).resolve().parent.parent
 
-# Module path -> functions (class-qualified) where materializing calls
-# are sanctioned.  Everything falls into four buckets:
-#   ingest    — converting HOST inputs (cols/ts/keys) before device_put
-#   drain     — the coalesced fetch + deferred-emit materializers
-#   barrier   — snapshot/restore/timer paths, already behind drain()
-#   stats     — slow-polled gauges (overflow poll, pattern_state)
-ALLOWED = {
-    "siddhi_tpu/core/emit_queue.py": {
-        "fetch_coalesced",                                    # drain
-    },
-    "siddhi_tpu/core/device_single.py": {
-        "DeviceQueryRuntime.process_stream_batch",            # ingest
-        "DeviceQueryRuntime.snapshot",                        # barrier
-        "DeviceQueryRuntime.restore",                         # barrier
-    },
-    "siddhi_tpu/core/dense_pattern.py": {
-        "DensePatternRuntime.intern_keys",                    # ingest
-        "DensePatternRuntime._intern_keys_dict",              # ingest
-        "DensePatternRuntime._rebuild_key_index",             # ingest
-        "DensePatternRuntime.process_stream_batch",           # ingest
-        "DensePatternRuntime.purge_idle",                     # barrier
-        "DensePatternRuntime.on_time",                        # barrier
-        "DensePatternRuntime.snapshot",                       # barrier
-        "DensePatternRuntime.restore",                        # barrier
-        "DensePatternRuntime.stats",                          # stats
-    },
-    "siddhi_tpu/ops/device_query.py": {
-        "_split_i64",                                         # ingest
-        "DeviceQueryEngine._host_env",                        # ingest
-        "DeviceQueryEngine._intern_groups",                   # ingest
-        "DeviceQueryEngine._intern_wgroups",                  # ingest
-        "DeviceQueryEngine.host_lane_cols",                   # ingest
-        "DeviceQueryEngine._pad",                             # ingest
-        "DeviceQueryEngine._host_filter_mask",                # ingest
-        "DeviceQueryEngine.process_batch_deferred",           # ingest
-        "DeviceQueryEngine._deferred_chunk",                  # ingest
-        "DeviceQueryEngine._acc_segment",                     # ingest
-        "DeviceQueryEngine._out_columns",                     # drain
-        "DeviceQueryEngine._flush_cols",                      # barrier
-        "DeviceQueryEngine.purge_idle_keys",                  # barrier
-        "DeviceQueryEngine.host_restore",                     # barrier
-        "DeferredDeviceEmit.materialize",                     # drain
-        "DeferredDeviceEmit._concat_parts",                   # drain
-        "DeferredDeviceEmit.resolve",                         # drain
-    },
-    "siddhi_tpu/ops/dense_nfa.py": {
-        "DensePatternEngine.prepare_cols",                    # ingest
-        "DensePatternEngine.process_deferred",                # ingest
-        "DensePatternEngine.on_time_state",                   # barrier
-        "DensePatternEngine.maybe_re_anchor",                 # barrier
-        "DeferredDenseEmit.materialize",                      # drain
-        "DeferredDenseEmit.resolve",                          # drain
-    },
-    "siddhi_tpu/parallel/device_shard.py": {
-        "ShardedDeviceQueryEngine.init_state",                # ingest
-        "ShardedDeviceQueryEngine.put_state",                 # barrier
-        "ShardedDeviceQueryEngine.process_batch_deferred",    # ingest
-        "ShardedDeviceQueryEngine._deferred_chunk",           # ingest
-        "ShardedDeviceQueryEngine._sliding_chunk",            # ingest
-        "ShardedDeviceQueryEngine._acc_segment",              # ingest
-    },
-    "siddhi_tpu/parallel/mesh.py": {
-        "make_mesh",                                          # ingest
-        "route_to_shards",                                    # ingest
-        "ShardedPatternEngine.route",                         # ingest
-        "ShardedPatternEngine.process_deferred",              # ingest
-    },
-}
-
-MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
-                 "jax.device_get"}
+RULE = "host-sync-hazard"
 
 
-def materializing_calls(source):
-    """Yield (lineno, call, qualified enclosing function)."""
-    stack = []
-    hits = []
-
-    class V(ast.NodeVisitor):
-        def _scoped(self, node):
-            stack.append(node.name)
-            self.generic_visit(node)
-            stack.pop()
-
-        visit_FunctionDef = _scoped
-        visit_AsyncFunctionDef = _scoped
-        visit_ClassDef = _scoped
-
-        def visit_Call(self, node):
-            f = node.func
-            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
-                name = f"{f.value.id}.{f.attr}"
-                if name in MATERIALIZERS:
-                    hits.append((node.lineno, name,
-                                 ".".join(stack) or "<module>"))
-            self.generic_visit(node)
-
-    V().visit(ast.parse(source))
-    return hits
+def _run():
+    indexes = index_package(REPO / "siddhi_tpu", REPO)
+    return run_rules(indexes, [get_rule(RULE)])
 
 
 def test_no_stray_sync_transfers_in_device_runtimes():
-    offenders = []
-    for rel, allowed in ALLOWED.items():
-        path = REPO / rel
-        assert path.exists(), f"guard list is stale: {rel} moved"
-        for lineno, call, qual in materializing_calls(path.read_text()):
-            if qual not in allowed:
-                offenders.append(f"{rel}:{lineno} {call} in {qual}()")
-    assert not offenders, (
+    hits = [f for f in _run()["findings"] if f.rule == RULE]
+    assert not hits, (
         "synchronous device->host materialization outside the sanctioned "
         "async-emit drain path (route it through the runtime's EmitQueue, "
-        "or add it to the allowlist WITH a bucket justification):\n  "
-        + "\n  ".join(offenders))
+        "or allowlist it in siddhi_tpu/analysis/allowlists.py WITH a "
+        "bucket justification):\n  "
+        + "\n  ".join(f.render() for f in hits))
 
 
 def test_allowlist_not_stale():
-    """Every allowlisted function still exists and still materializes —
-    keeps the guard list honest as the runtimes evolve."""
-    for rel, allowed in ALLOWED.items():
-        live = {q for _ln, _c, q in
-                materializing_calls((REPO / rel).read_text())}
-        gone = allowed - live
-        assert not gone, (f"{rel}: allowlisted entries no longer "
-                          f"materialize; prune them: {sorted(gone)}")
+    """Allowlist entries expire: one that no longer matches a finding
+    surfaces as a ``stale-allowlist`` finding — the list only shrinks."""
+    stale = [f for f in _run()["findings"] if f.rule == "stale-allowlist"]
+    assert not stale, "\n  ".join(f.render() for f in stale)

@@ -1,71 +1,25 @@
 """Tier-1 guard: every ingest-path H2D transfer goes through staging.
 
-The ingest pipeline's contract is that host→device puts of BATCH data
-happen ONLY through ``core/ingest_stage.py`` ``staged_put`` — the one
-wrapper that arms the ``ingest.put`` fault-injection site (bounded
-retry-with-backoff, crash-journal semantics) and counts
-``IngestStats.device_puts``.  A future edit that calls
-``jax.device_put`` directly on a batch path silently bypasses both the
-fault harness and the staging counters: chaos runs stop covering that
-transfer and the overlap evidence under-reports.
-
-This test AST-scans the whole package and fails when a ``device_put``
-call appears outside the curated allowlist.  Buckets:
-  staging — the sanctioned wrapper itself
-  mesh    — sharding helpers placing STATE rows on the mesh (one-time /
-            barrier placement, not per-batch event data; faults on the
-            sharded batch path still flow through staged_put in
-            parallel/device_shard.py ``_put``)
-  state   — engine state initialization / re-anchor barriers (same
-            reasoning: not an ingest path, and arming ``ingest.put``
-            there would skew the injector's per-batch fault cadence)
+Thin shim over the ``ingest-put-bypass`` rule in ``siddhi_tpu.analysis``
+(which absorbed this file's AST scanner, allowlist, and staleness
+check).  The test names are stable tier-1 anchors; the contract and the
+curated allowlist (staging/mesh/state buckets) now live in
+``siddhi_tpu/analysis/rules/ingest_put.py`` and
+``siddhi_tpu/analysis/allowlists.py``.
 """
 
-import ast
 from pathlib import Path
 
+from siddhi_tpu.analysis import ModuleIndex, get_rule, index_package, run_rules
+
 REPO = Path(__file__).resolve().parent.parent
-PKG = REPO / "siddhi_tpu"
 
-ALLOWED = {
-    "siddhi_tpu/core/ingest_stage.py": {
-        "staged_put",                                     # staging
-    },
-    "siddhi_tpu/parallel/mesh.py": {
-        "ShardedPatternEngine._put",                      # mesh
-    },
-    "siddhi_tpu/ops/dense_nfa.py": {
-        "DensePatternEngine.init_state",                  # state
-        "DensePatternEngine.maybe_re_anchor",             # state
-    },
-}
+RULE = "ingest-put-bypass"
 
 
-def device_put_calls(source):
-    """Yield (lineno, qualified enclosing function) for every
-    ``*.device_put(...)`` call, regardless of the receiver chain
-    (``jax.device_put``, ``self.jax.device_put``, ...)."""
-    stack = []
-    hits = []
-
-    class V(ast.NodeVisitor):
-        def _scoped(self, node):
-            stack.append(node.name)
-            self.generic_visit(node)
-            stack.pop()
-
-        visit_FunctionDef = _scoped
-        visit_AsyncFunctionDef = _scoped
-        visit_ClassDef = _scoped
-
-        def visit_Call(self, node):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr == "device_put":
-                hits.append((node.lineno, ".".join(stack) or "<module>"))
-            self.generic_visit(node)
-
-    V().visit(ast.parse(source))
-    return hits
+def _run():
+    indexes = index_package(REPO / "siddhi_tpu", REPO)
+    return run_rules(indexes, [get_rule(RULE)])
 
 
 def test_detector_sees_through_receiver_chains():
@@ -75,31 +29,25 @@ def test_detector_sees_through_receiver_chains():
            "        jax.device_put(1)\n"
            "    def b(self):\n"
            "        self.jax.device_put(1)\n")
-    assert device_put_calls(src) == [(4, "E.a"), (6, "E.b")]
+    rule = get_rule(RULE)
+    rule.begin()
+    idx = ModuleIndex(Path("fixture.py"), "fixture.py", source=src)
+    hits = [(f.line, f.scope) for f in rule.check(idx)]
+    assert hits == [(4, "E.a"), (6, "E.b")]
 
 
 def test_no_device_put_bypasses_ingest_staging():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(REPO).as_posix()
-        allowed = ALLOWED.get(rel, set())
-        for lineno, qual in device_put_calls(path.read_text()):
-            if qual not in allowed:
-                offenders.append(f"{rel}:{lineno} device_put in {qual}()")
-    assert not offenders, (
+    hits = [f for f in _run()["findings"] if f.rule == RULE]
+    assert not hits, (
         "direct device_put outside the sanctioned staging/mesh/state "
         "sites — route batch ingest through core/ingest_stage.staged_put "
-        "(fault site + counters), or add it to the allowlist WITH a "
-        "bucket justification:\n  " + "\n  ".join(offenders))
+        "(fault site + counters), or allowlist it in "
+        "siddhi_tpu/analysis/allowlists.py WITH a bucket justification:\n  "
+        + "\n  ".join(f.render() for f in hits))
 
 
 def test_allowlist_not_stale():
-    """Every allowlisted function still exists and still calls
-    device_put — keeps the guard honest as the ingest paths evolve."""
-    for rel, allowed in ALLOWED.items():
-        path = REPO / rel
-        assert path.exists(), f"guard list is stale: {rel} moved"
-        live = {q for _ln, q in device_put_calls(path.read_text())}
-        gone = allowed - live
-        assert not gone, (f"{rel}: allowlisted entries no longer call "
-                          f"device_put; prune them: {sorted(gone)}")
+    """Allowlist entries expire: one that no longer matches a finding
+    surfaces as a ``stale-allowlist`` finding — the list only shrinks."""
+    stale = [f for f in _run()["findings"] if f.rule == "stale-allowlist"]
+    assert not stale, "\n  ".join(f.render() for f in stale)

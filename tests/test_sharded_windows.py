@@ -64,7 +64,9 @@ def batches(seed=9, sizes=BATCH_SIZES, n_keys=5, n_syms=1):
     return out
 
 
-def run(app, sends, store=None):
+def run(app, sends, store=None, transfer_guard=False):
+    import contextlib
+
     m = SiddhiManager()
     try:
         if store is not None:
@@ -75,10 +77,21 @@ def run(app, sends, store=None):
             tuple(e.data) for e in evs))
         rt.start()
         h = rt.get_input_handler("S")
-        for cols, ts in sends:
-            h.send_batch(EventBatch(
-                "S", ["sym", "v", "k"],
-                {k: v.copy() for k, v in cols.items()}, ts.copy()))
+        # transfer_guard: the sharded batch loop may only cross the
+        # device boundary explicitly (staged_put onto the mesh, explicit
+        # device_get at the count gate / drain) — the dynamic twin of
+        # the host-sync-hazard analysis rule.  No-op on the CPU backend;
+        # bites on real accelerator runs.
+        guard = contextlib.nullcontext()
+        if transfer_guard:
+            import jax
+
+            guard = jax.transfer_guard("disallow")
+        with guard:
+            for cols, ts in sends:
+                h.send_batch(EventBatch(
+                    "S", ["sym", "v", "k"],
+                    {k: v.copy() for k, v in cols.items()}, ts.copy()))
         runtimes = [getattr(qr, "device_runtime", None)
                     for qr in rt.query_runtimes.values()]
         for pr in getattr(rt, "partitions", {}).values():
@@ -107,7 +120,8 @@ class TestBitIdentity:
     def test_pane_straddling_batches(self, win):
         q = query(win)
         single, _, _ = run(SINGLE + q, batches())
-        sharded, runtimes, _ = run(SHARDED + q, batches())
+        sharded, runtimes, _ = run(SHARDED + q, batches(),
+                                   transfer_guard=True)
         dr = sharded_runtime(runtimes)
         assert n_state_devices(dr.state) == 8
         assert len(single) >= 5, "series too tame; differential is vacuous"
