@@ -66,6 +66,18 @@ SHWIN_STEPS = 10
 SHWIN_WARMUP = 2
 SHWIN_WINDOWS = 3
 
+# multi-tenant multiplexing measurement (siddhi_tpu/multiplex/): T
+# identical tumbling group-by apps on ONE manager, seated into one
+# shared engine vs T dedicated engines — the packing win is fewer
+# jitted dispatches per batch cycle (~1 instead of T)
+MUX_TENANTS = 8
+MUX_KEYS = 1_024
+MUX_BATCH = 4_096
+MUX_PANE = 1 << 16   # pane >> batch: panes close every ~16 cycles, so
+MUX_STEPS = 10       # the combined fast path carries the steady state
+MUX_WARMUP = 2
+MUX_WINDOWS = 3
+
 # CPU-backend smoke fallback (device backend unreachable): reduced
 # sizes so the number exists in seconds, clearly labeled as NOT the
 # chip measurement
@@ -76,6 +88,9 @@ SMOKE_WARMUP = 2
 SMOKE_SHWIN_KEYS = 512
 SMOKE_SHWIN_BATCH = 2_048
 SMOKE_SHWIN_STEPS = 4
+SMOKE_MUX_TENANTS = 4
+SMOKE_MUX_BATCH = 2_048
+SMOKE_MUX_STEPS = 4
 
 
 def pattern_query() -> str:
@@ -320,6 +335,97 @@ def bench_sharded_window(n_devices=None, keys=SHWIN_KEYS,
         m.shutdown()
 
 
+def bench_multiplexed(tenants=MUX_TENANTS, keys=MUX_KEYS,
+                      batch=MUX_BATCH, pane=MUX_PANE,
+                      steps=MUX_STEPS, windows=MUX_WINDOWS):
+    """Multi-tenant engine multiplexing: T identical tumbling group-by
+    apps on one SiddhiManager, multiplexed into ONE shared device
+    engine (`@app:multiplex`) vs T dedicated engines.  Reports the
+    shared-engine rate per chip and the measured jitted dispatches per
+    batch cycle — the acceptance evidence that one shared step serves
+    every compatible tenant."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    def run(multiplex):
+        m = SiddhiManager()
+        try:
+            rts = []
+            rows = [0]
+            for i in range(tenants):
+                rt = m.create_siddhi_app_runtime(
+                    f"@app:name('muxbench{i}') @app:playback "
+                    "@app:execution('tpu') "
+                    + (f"@app:multiplex(slots='{tenants}') "
+                       if multiplex else "")
+                    + "define stream Mkt (k long, v double); "
+                    f"@info(name='w') from Mkt#window.lengthBatch({pane}) "
+                    "select k, sum(v) as s, count() as c group by k "
+                    "insert into Panes;")
+                rt.add_callback("Panes", lambda evs: rows.__setitem__(
+                    0, rows[0] + len(evs)))
+                rt.start()
+                rts.append(rt)
+            if multiplex:
+                assert all(rt.lowering()["w"] == "multiplex"
+                           for rt in rts), "bench apps failed to multiplex"
+            hs = [rt.get_input_handler("Mkt") for rt in rts]
+            rng = np.random.default_rng(23)
+
+            def mk(i, tenant):
+                k = ((np.arange(batch, dtype=np.int64) * 524287
+                      + i * batch) % keys)
+                v = rng.integers(0, 50, batch).astype(np.float64)
+                ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+                return EventBatch("Mkt", ["k", "v"], {"k": k, "v": v}, ts)
+
+            bs = [[mk(i, t) for t in range(tenants)]
+                  for i in range(MUX_WARMUP + steps)]
+            for cycle in bs[:MUX_WARMUP]:
+                for h, b in zip(hs, cycle):
+                    h.send_batch(b)
+            window_rates = []
+            for _w in range(windows):
+                t_w = time.perf_counter()
+                for cycle in bs[MUX_WARMUP:]:
+                    for h, b in zip(hs, cycle):
+                        h.send_batch(b)
+                window_rates.append(
+                    tenants * batch * steps
+                    / (time.perf_counter() - t_w))
+            cycles = MUX_WARMUP + windows * steps
+            disp = None
+            if multiplex:
+                reg = m.siddhi_context.multiplex_registry
+                groups = reg.open_groups()
+                assert len(groups) == 1, "tenants split across groups"
+                g = groups[0]
+                disp = {
+                    "dispatches": g.dispatches,
+                    "combined_steps": g.combined_steps,
+                    "slow_steps": g.slow_steps,
+                    "dispatches_per_cycle": round(
+                        g.dispatches / cycles, 3),
+                }
+            for rt in rts:
+                rt.shutdown()
+            return float(np.median(window_rates)), window_rates, disp
+        finally:
+            m.shutdown()
+
+    mux_rate, mux_windows, disp = run(True)
+    ded_rate, _ded_windows, _ = run(False)
+    out = {
+        "events_per_sec": mux_rate,
+        "window_rates": [round(r, 1) for r in mux_windows],
+        "dedicated_events_per_sec": ded_rate,
+        "vs_dedicated": round(mux_rate / ded_rate, 3),
+        "tenants": tenants,
+    }
+    out.update(disp)
+    return out
+
+
 def bench_host_baseline():
     """Measured host-engine (ops/nfa.py) rate on the same partitioned
     pattern — the CPU reference side of the comparison."""
@@ -488,6 +594,18 @@ def main():
                 sw["events_per_sec"], 1)
         except Exception as e:  # engine smoke must not hide the kernel one
             out["cpu_smoke_sharded_window_error"] = str(e)
+        try:
+            mx = bench_multiplexed(
+                tenants=SMOKE_MUX_TENANTS, keys=256,
+                batch=SMOKE_MUX_BATCH, pane=8_192,
+                steps=SMOKE_MUX_STEPS, windows=2)
+            out["cpu_smoke_multiplexed_events_per_sec"] = round(
+                mx["events_per_sec"], 1)
+            out["cpu_smoke_multiplexed_vs_dedicated"] = mx["vs_dedicated"]
+            out["cpu_smoke_multiplexed_dispatches_per_cycle"] = mx[
+                "dispatches_per_cycle"]
+        except Exception as e:
+            out["cpu_smoke_multiplexed_error"] = str(e)
         print(json.dumps(out))
         return
     if not _probe_with_retry():
@@ -510,6 +628,10 @@ def main():
                 "cpu_smoke_events_per_sec"),
             "cpu_smoke_sharded_window_events_per_sec": smoke.get(
                 "cpu_smoke_sharded_window_events_per_sec"),
+            "cpu_smoke_multiplexed_events_per_sec": smoke.get(
+                "cpu_smoke_multiplexed_events_per_sec"),
+            "cpu_smoke_multiplexed_dispatches_per_cycle": smoke.get(
+                "cpu_smoke_multiplexed_dispatches_per_cycle"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
                 "kernel smoke + 8-virtual-device sharded-window smoke — "
@@ -519,6 +641,7 @@ def main():
     kernel = bench_kernel()
     product = bench_product()
     shwin = bench_sharded_window()
+    mux = bench_multiplexed()
     host = bench_host_baseline()
     workload_rows = None
     if "--workloads" in sys.argv:
@@ -563,6 +686,13 @@ def main():
         "sharded_window_devices": shwin["n_devices"],
         "sharded_window_window_rates": shwin["window_rates"],
         "sharded_window_pane_rows": shwin["pane_rows"],
+        "multiplexed_events_per_sec_per_chip": round(
+            mux["events_per_sec"], 1),
+        "multiplexed_vs_dedicated": mux["vs_dedicated"],
+        "multiplexed_tenants": mux["tenants"],
+        "multiplexed_dispatches_per_cycle": mux["dispatches_per_cycle"],
+        "multiplexed_combined_steps": mux["combined_steps"],
+        "multiplexed_window_rates": mux["window_rates"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

@@ -18,9 +18,11 @@ Precision: rows are float32 — the device lane policy shared with every
 other jitted path (ops/device_query.py docstring).  Integer fields
 (int sums, bare counts) stay on exact host numpy scatter ufuncs at
 native width, with one deliberate exception: when the aggregation is
-avg-bearing (avg rewrites to sum + count and the float numerator is
-already banked), the shared count denominator rides the bank too as
-float32 add rows.  Float32 integer arithmetic is exact below 2**24;
+avg- or stdDev-bearing (avg rewrites to sum + count, stdDev to
+sum + sumsq + count — the sumsq row is a DOUBLE "sum"-op field and
+banks like any other float sum — and the float numerators are already
+banked), the shared count denominator rides the bank too as float32
+add rows.  Float32 integer arithmetic is exact below 2**24;
 ``count_overflow_risk`` lets the runtime force a flush barrier before
 any row could cross that bound, and the flush merge casts count values
 back to exact ints (aggregation/runtime.py ``_flush_bank``).
@@ -47,7 +49,8 @@ class DeviceBucketBank:
     """Device rows for the float base fields of running finest buckets.
 
     ``fields``: the eligible BaseFields (op in sum/min/max over float
-    arguments, plus the count denominator of avg-bearing selects).
+    arguments — including the stdDev sumsq row — plus the count
+    denominator of avg- or stdDev-bearing selects).
     One [cap+1] float32 device array per field; ``rows`` maps
     (bucket_start, group_key) -> row index.
     """

@@ -141,9 +141,11 @@ class IncrementalRewrite:
         self.compiler = compiler
         self.final_scope = final_scope
         self.fields: Dict[str, BaseField] = {}
-        # avg decomposes to sum + count; the device bank uses this to
-        # decide whether the count denominator should ride the device
+        # avg decomposes to sum + count, stdDev to sum + sumsq + count;
+        # the device bank uses these to decide whether the count
+        # denominator should ride the device
         self.saw_avg = False
+        self.saw_stddev = False
 
     def _field(self, op: str, arg_expr: Optional[Expression], type_: AttrType) -> str:
         key = f"__{op}_{'' if arg_expr is None else repr(arg_expr)}"
@@ -179,6 +181,7 @@ class IncrementalRewrite:
                 if name == "avg":
                     self.saw_avg = True
                     return ArithmeticOp("/", sum_v, cnt_v)
+                self.saw_stddev = True
                 sq = ArithmeticOp("*", a, a)
                 sumsq_v = Variable(attribute=self._field("sum", sq, AttrType.DOUBLE))
                 mean = ArithmeticOp("/", sum_v, cnt_v)
@@ -430,14 +433,19 @@ class AggregationRuntime:
                 if f.op in ("sum", "min", "max")
                 and f.type in (AttrType.FLOAT, AttrType.DOUBLE)
             ]
-            # avg(x) over a float argument rewrites to _SUM/_COUNT; with
-            # the numerator banked above, banking the shared count
-            # denominator too lets avg-bearing ingest skip the host
-            # reduction entirely.  Count rows are float32 on the device
-            # — exact below 2**24, enforced by the overflow barrier in
-            # _bank_ingest — and cast back to exact ints at flush merge.
-            # Without an avg, count keeps the exact host path.
-            if rw.saw_avg and any(f.op == "sum" for f in bank_fields):
+            # avg(x) over a float argument rewrites to _SUM/_COUNT and
+            # stdDev(x) to _SUM/_SUMSQ/_COUNT (the sumsq row is a
+            # DOUBLE "sum"-op field, so it is already banked above);
+            # with the numerators banked, banking the shared count
+            # denominator too lets avg- and stdDev-bearing ingest skip
+            # the host reduction entirely.  Count rows are float32 on
+            # the device — exact below 2**24, enforced by the overflow
+            # barrier in _bank_ingest — and cast back to exact ints at
+            # flush merge.  Without an avg/stdDev, count keeps the
+            # exact host path.
+            if (rw.saw_avg or rw.saw_stddev) and any(
+                f.op == "sum" for f in bank_fields
+            ):
                 bank_fields += [
                     f for f in self.base_fields if f.op == "count"
                 ]

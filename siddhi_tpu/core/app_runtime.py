@@ -111,8 +111,10 @@ class SiddhiAppRuntime:
         # an auto controller would re-deepen it), and collapse the
         # ingest staging window back to synchronous
         for rt in self._device_runtimes():
-            rt.emit_queue.depth = 1
-            rt.emit_queue.controller = None
+            eq = getattr(rt, "emit_queue", None)
+            if eq is not None:
+                eq.depth = 1
+                eq.controller = None
             stage = getattr(rt, "ingest_stage", None)
             if stage is not None:
                 stage.flush()
@@ -208,6 +210,10 @@ class SiddhiAppRuntime:
             pp = getattr(qr, "pattern_processor", None)
             if pp is not None and hasattr(pp, "close"):
                 pp.close()
+            # multiplexed tenants free their shared-engine seat here
+            dr = getattr(qr, "device_runtime", None)
+            if dr is not None and hasattr(dr, "close"):
+                dr.close()
         for pr in self.partitions.values():
             for qr in getattr(pr, "dense_query_runtimes", {}).values():
                 pp = getattr(qr, "pattern_processor", None)

@@ -67,6 +67,11 @@ class SiddhiContext:
         # a fresh runtime for the same app picks it up and replays
         # post-checkpoint batches (util/faults.py InputJournal).
         self.input_journals: Dict[str, object] = {}
+        # Multiplex groups live on the MANAGER context because grouping
+        # is cross-app: distinct apps under one manager share engines
+        # when their queries fingerprint alike (multiplex/registry.py).
+        # Lazily created by the planner on first @app:multiplex app.
+        self.multiplex_registry = None
 
 
 class SiddhiAppContext:
@@ -111,6 +116,12 @@ class SiddhiAppContext:
         # size before incremental aggregation uses the jitted device
         # segment-reduce instead of the host np.add.at path
         self.tpu_agg_min_batch = 512
+        # @app:multiplex(slots='N'): pack this app's eligible queries
+        # into manager-wide shared device engines (multiplex/) so ONE
+        # jitted step serves every compatible tenant per cycle.  Off by
+        # default; slots bounds the tenant axis of each shared engine.
+        self.multiplex = False
+        self.multiplex_slots = 8
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -1484,6 +1484,55 @@ class DensePatternEngine:
             state["deadline"] = conv("deadline", dshift.astype(np.int32))
         return state, rel64
 
+    def shift_row_ts(self, rows: Dict[str, np.ndarray],
+                     delta: int) -> Dict[str, np.ndarray]:
+        """Re-express HOST-side state rows against a base shifted by
+        ``delta`` (new_base = old_base + delta), both directions.
+
+        The multiplex group engine shares one ``base_ts`` across
+        tenants: restoring a tenant snapshot taken under a different
+        anchor, or admitting a tenant whose events predate the group
+        anchor (a group-wide down-shift, delta < 0), rewrites the
+        ``first_ts``/``deadline`` anchors with the same semantics as
+        :meth:`maybe_re_anchor` — forward shifts expire instances that
+        fall out of the ``within`` horizon (or clamp inert anchors to
+        stay set), backward shifts only grow the values, bounded by the
+        int32 range.  ``rows`` must already be HOST numpy arrays (both
+        callers fetch before shifting) — no device materialization
+        happens here."""
+        out = dict(rows)
+        first = rows["first_ts"].astype(np.int64)
+        shifted = np.where(first > 0, first - delta, 0)
+        if int(shifted.max(initial=0)) >= 2**31:
+            raise SiddhiAppRuntimeError(
+                "dense NFA: timestamp shift exceeds the int32 "
+                "relative-time range")
+        if delta > 0:
+            if self.within_ms is not None:
+                dead = (first > 0) & (shifted <= 0)
+                if dead.any():
+                    active = rows["active"].copy()
+                    counts = rows["counts"].copy()
+                    active[dead] = False
+                    counts[dead] = 0
+                    shifted = np.where(dead, 0, shifted)
+                    out["active"] = active
+                    out["counts"] = counts
+            else:
+                shifted = np.where(first > 0, np.maximum(shifted, 1), 0)
+        out["first_ts"] = shifted.astype(np.int32)
+        if "deadline" in rows:
+            dlv = rows["deadline"].astype(np.int64)
+            dshift = np.where(dlv > 0, dlv - delta, 0)
+            if delta > 0:
+                dshift = np.where(dlv > 0, np.maximum(dshift, 1), 0)
+            elif int(dshift.max(initial=0)) >= 2**31:
+                raise SiddhiAppRuntimeError(
+                    "dense NFA: timestamp shift exceeds the int32 "
+                    "relative-time range")
+            out["deadline"] = dshift.astype(np.int32)
+        return out
+
     def process(self, state, stream_key: str, part_idx: np.ndarray, cols: Dict[str, np.ndarray], ts: np.ndarray):
         """Process a batch, splitting rounds so each partition appears at
         most once per step (scatter collisions would race).  Rounds are

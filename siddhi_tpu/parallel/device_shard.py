@@ -109,6 +109,12 @@ class ShardedDeviceQueryEngine:
         else:
             self.per_shard = axis_len // self.n_shards
             self.rows_per_shard = self.per_shard + 1  # +1 scratch row
+        # hot-pane flush batching: empty tumbling panes skip the
+        # shard-mapped flush dispatch entirely (a zero-fill pane's
+        # accumulators are already at their reset values, so the step
+        # would be a state no-op emitting nothing) — a batch that jumps
+        # K pane boundaries costs ONE dispatch, not K
+        self.flush_skips = 0
 
         jnp = engine.jnp
         a = axis_name
@@ -523,6 +529,15 @@ class ShardedDeviceQueryEngine:
         as a "flush" chunk (count-gated — an all-empty pane's columns
         are never transferred)."""
         eng = self.engine
+        if eng.window_name == "timeBatch" and not eng._pane_fill:
+            # no passing event touched this pane: every accumulator is
+            # already at its reset value and the flush would emit zero
+            # rows — skip the device dispatch, keep host bookkeeping.
+            # timeBatch only: its fill count is final when the pane
+            # closes, while lengthBatch increments AFTER the closing
+            # flush (and only ever closes full panes anyway)
+            self.flush_skips += 1
+            return state
         fi = getattr(eng, "faults", None)
         if fi is not None:
             fi.check("step.shard")

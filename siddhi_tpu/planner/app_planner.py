@@ -154,6 +154,29 @@ class AppPlanner:
                         "be a positive integer")
                 self.app_context.tpu_agg_min_batch = nab
 
+        # @app:multiplex(slots='N'): opt this app's eligible queries into
+        # manager-wide shared device engines (multiplex/) — one jitted
+        # step per cycle serves every structurally-compatible tenant
+        # across ALL apps under the manager.  Ineligible queries fall
+        # back to dedicated engines with a counted reason.
+        mux_ann = find_annotation(siddhi_app.annotations, "app:multiplex")
+        if mux_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:multiplex needs @app:execution('tpu')")
+            self.app_context.multiplex = True
+            slots = mux_ann.element("slots") or mux_ann.element()
+            if slots:
+                try:
+                    ns = int(slots)
+                except ValueError:
+                    ns = -1
+                if ns < 2 or ns > 64:
+                    raise SiddhiAppCreationError(
+                        f"@app:multiplex: slots='{slots}' must be an "
+                        "integer in 2..64")
+                self.app_context.multiplex_slots = ns
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")

@@ -428,6 +428,16 @@ class QueryPlanner:
         ):
             import logging
 
+            # @app:multiplex: try seating the pattern in a manager-wide
+            # shared dense engine first; ineligibility is counted
+            # (multiplexFallbackReason) and falls through to the
+            # dedicated dense path below
+            if self.app.app_context.multiplex:
+                from siddhi_tpu.multiplex.planner import MultiplexPlanner
+
+                qr = MultiplexPlanner(self).try_state(query, name, st)
+                if qr is not None:
+                    return qr
             try:
                 qr = self._plan_dense_state(query, name, st)
                 logging.getLogger("siddhi_tpu").info(
@@ -640,6 +650,14 @@ class QueryPlanner:
         ):
             import logging
 
+            # @app:multiplex: shared tumbling engine attempt first, with
+            # counted fallback to the dedicated device path
+            if self.app.app_context.multiplex:
+                from siddhi_tpu.multiplex.planner import MultiplexPlanner
+
+                qr = MultiplexPlanner(self).try_single(query, name, s)
+                if qr is not None:
+                    return qr
             try:
                 qr = self._plan_device_single(query, name, s)
                 logging.getLogger("siddhi_tpu").info(

@@ -174,6 +174,14 @@ class StatisticsManager:
         # silent
         self.sharded_fallbacks: Dict[str, int] = {}
         self.sharded_fallback_reasons: Dict[str, str] = {}
+        # queries under @app:multiplex that could not be seated in a
+        # shared engine (incompatible shape/feature): count + last
+        # reason per query, populated by the multiplex planner; and the
+        # placements that DID land, keyed by query with their group
+        # fingerprint + seat occupancy at placement time
+        self.multiplex_fallbacks: Dict[str, int] = {}
+        self.multiplex_fallback_reasons: Dict[str, str] = {}
+        self.multiplex_placements: Dict[str, str] = {}
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -210,6 +218,19 @@ class StatisticsManager:
             self.sharded_fallbacks.get(qname, 0) + 1)
         self.sharded_fallback_reasons[qname] = reason
 
+    def record_multiplex_fallback(self, qname: str, reason: str):
+        """A query under @app:multiplex is running on a dedicated
+        engine; counted per query with the last reason kept."""
+        self.multiplex_fallbacks[qname] = (
+            self.multiplex_fallbacks.get(qname, 0) + 1)
+        self.multiplex_fallback_reasons[qname] = reason
+
+    def record_multiplex_placement(self, qname: str, fingerprint: str,
+                                   occupied: int):
+        """A query seated in a shared multiplex group."""
+        self.multiplex_placements[qname] = (
+            f"{fingerprint[:12]}:{occupied}")
+
     def stats(self) -> Dict[str, object]:
         """Metric name -> value.  Values are floats except the
         ``Queries.<name>.loweredTo`` /
@@ -242,6 +263,12 @@ class StatisticsManager:
             out[self._metric("Queries", qname, "shardedFallbacks")] = n
             out[self._metric("Queries", qname, "shardedFallbackReason")] = (
                 self.sharded_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.multiplex_fallbacks.items()):
+            out[self._metric("Queries", qname, "multiplexFallbacks")] = n
+            out[self._metric("Queries", qname, "multiplexFallbackReason")] = (
+                self.multiplex_fallback_reasons.get(qname, ""))
+        for qname, gp in list(self.multiplex_placements.items()):
+            out[self._metric("Queries", qname, "multiplexGroup")] = gp
         return out
 
     def reset(self):

@@ -227,3 +227,54 @@ class TestAggregationPurge:
         agg = rt.aggregations["A"]
         assert len(agg.stores["seconds"].finished) >= 1
         rt.shutdown()
+
+
+class TestStdDevDeviceBank:
+    """stdDev decomposes to sum + sumsq + count; in tpu mode ALL three
+    base fields must ride the device bucket bank (the sumsq row is a
+    DOUBLE "sum"-op field, and the shared count denominator banks for
+    stdDev exactly as it does for avg), so stdDev-bearing ingest skips
+    the host reduction entirely."""
+
+    APP = (
+        "{mode}@app:playback "
+        "define stream S (sym string, price double, ts long); "
+        "define aggregation A from S select sym, stdDev(price) as sd "
+        "group by sym aggregate by ts every sec...min;"
+    )
+
+    def _run(self, manager, mode, probe=False):
+        import numpy as np
+
+        rt = manager.create_siddhi_app_runtime(self.APP.format(mode=mode))
+        rt.start()
+        agg = rt.aggregations["A"]
+        if probe:
+            assert agg._bank is not None
+            assert set(agg._bank.names) == {f.name for f in agg.base_fields}
+        rng = np.random.default_rng(7)
+        n = 400
+        ts = np.sort(BASE + rng.integers(0, 5_000, n)).astype(np.int64)
+        for i in range(0, n, 50):
+            for j in range(i, min(i + 50, n)):
+                h = rt.get_input_handler("S")
+                h.send([f"s{int(rng.integers(0, 8))}",
+                        float(rng.uniform(1, 100)), int(ts[j])])
+        out = rt.query(
+            f"from A within {BASE - 1000}, {BASE + 100_000} per 'seconds' "
+            "select sym, sd;")
+        rt.shutdown()
+        return sorted([list(e.data) for e in out], key=lambda r: r[0])
+
+    def test_stddev_banks_count_and_matches_host(self, manager):
+        host = self._run(manager, "")
+        m2 = SiddhiManager()
+        try:
+            dev = self._run(m2, "@app:execution('tpu') ", probe=True)
+        finally:
+            m2.shutdown()
+        assert len(host) == len(dev) > 0
+        for a, b in zip(host, dev):
+            assert a[0] == b[0]
+            # float32 device lanes + sum/sumsq decomposition tolerance
+            assert b[1] == pytest.approx(a[1], abs=5e-3, rel=1e-3), (a, b)

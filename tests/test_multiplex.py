@@ -1,0 +1,498 @@
+"""Multi-tenant engine multiplexing differential suite.
+
+``@app:multiplex(slots='N')`` packs compatible queries from MANY apps on
+one SiddhiManager into shared device engines (siddhi_tpu/multiplex/):
+tumbling-window device queries tile their accumulator state by seat,
+dense-NFA patterns take one partition row each, and one jitted step per
+batch cycle serves every seated tenant.
+
+The contract under test is bit-identical outputs versus the same apps
+running dedicated engines — including under transient injected faults,
+poison quarantine of one tenant, and crash + journal replay of one
+tenant while the others keep flowing.  Incompatible shapes must fall
+back to dedicated engines with a counted, readable reason.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SimulatedCrashError,
+)
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+
+def _collector(res):
+    return lambda events: res.extend(tuple(e.data) for e in events)
+
+
+def _series(n, seed, off):
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, 3, size=n)
+    vs = rng.integers(1, 100, size=n).astype(float) + off
+    return [([int(k), float(v)], 1000 + j * 250)
+            for j, (k, v) in enumerate(zip(ks, vs))]
+
+
+class TestMultiplexDifferential:
+    """N multiplexed tenants == N dedicated runtimes, bit for bit."""
+
+    TWO_SHAPE_APP = """
+@app:name('t{i}') @app:execution('tpu') @app:playback {mux}
+define stream S (k long, v double);
+define stream A (v double);
+define stream B (w double);
+@info(name='qw') from S#window.lengthBatch(4)
+select k, sum(v) as s, count() as c group by k insert into OutW;
+@info(name='qp') from every e1=A[v > 2] -> e2=B[w > e1.v]
+select e1.v as v1, e2.w as w2 insert into OutP;
+"""
+
+    def _run_two_shapes(self, multiplex, n=8, nev=24):
+        mgr = SiddhiManager()
+        try:
+            outs = {i: {"w": [], "p": []} for i in range(n)}
+            rts = []
+            for i in range(n):
+                rt = mgr.create_siddhi_app_runtime(self.TWO_SHAPE_APP.format(
+                    i=i, mux="@app:multiplex(slots='8')" if multiplex else ""))
+                rts.append(rt)
+                rt.add_callback("OutW", _collector(outs[i]["w"]))
+                rt.add_callback("OutP", _collector(outs[i]["p"]))
+                rt.start()
+            hs = [rt.get_input_handler("S") for rt in rts]
+            ha = [rt.get_input_handler("A") for rt in rts]
+            hb = [rt.get_input_handler("B") for rt in rts]
+            sends = {i: _series(nev, 11 + i, 10 * i) for i in range(n)}
+            for j in range(nev):
+                for i in range(n):
+                    row, ts = sends[i][j]
+                    hs[i].send(list(row), timestamp=ts)
+                    if j % 2 == 0:
+                        ha[i].send([float(j % 7 + i)], timestamp=ts)
+                    else:
+                        hb[i].send([float(j % 5 + i)], timestamp=ts)
+            low = {name: eng for rt in rts
+                   for name, eng in rt.lowering().items()}
+            for rt in rts:
+                rt.shutdown()
+            return outs, low
+        finally:
+            mgr.shutdown()
+
+    def test_eight_tenants_two_shapes_bit_identical(self):
+        mux, lowm = self._run_two_shapes(True)
+        ded, lowd = self._run_two_shapes(False)
+        assert lowm == {"qw": "multiplex", "qp": "multiplex"}
+        assert lowd == {"qw": "device", "qp": "dense"}
+        assert any(mux[i]["w"] for i in mux) and any(mux[i]["p"] for i in mux)
+        assert mux == ded
+
+    def test_timebatch_groupby_staggered_timestamps(self):
+        APP = """
+@app:name('m{i}') @app:execution('tpu') @app:playback {mux}
+define stream S (g double, price double);
+@info(name='q') from S#window.timeBatch(10)
+select g, sum(price) as total, max(price) as mx
+group by g insert into Out;
+"""
+
+        def run(multiplex, n=4, nev=12):
+            mgr = SiddhiManager()
+            try:
+                outs = {i: [] for i in range(n)}
+                rts = []
+                for i in range(n):
+                    rt = mgr.create_siddhi_app_runtime(APP.format(
+                        i=i,
+                        mux="@app:multiplex(slots='8')" if multiplex else ""))
+                    rts.append(rt)
+                    rt.add_callback("Out", _collector(outs[i]))
+                    rt.start()
+                hs = [rt.get_input_handler("S") for rt in rts]
+                for k in range(nev):
+                    for i, h in enumerate(hs):
+                        # tenants live at staggered wall-clock offsets, so
+                        # their pane boundaries interleave inside the group
+                        h.send([float(k % 2), float(k + 100 * i)],
+                               timestamp=1000 + 3 * k + i)
+                for rt in rts:
+                    rt.shutdown()
+                return outs
+            finally:
+                mgr.shutdown()
+
+        mux = run(True)
+        ded = run(False)
+        assert any(mux[i] for i in mux)
+        assert mux == ded
+
+    def test_one_shared_step_per_batch_cycle(self):
+        """8 tenants' sub-batches combine into ~1 jitted step per cycle,
+        not 8 — the whole point of seat-packing."""
+        APP = """
+@app:name('m{i}') @app:execution('tpu') @app:multiplex(slots='8')
+define stream S (g double, price double);
+@info(name='q') from S#window.lengthBatch(16)
+select g, sum(price) as total group by g insert into Out;
+"""
+        mgr = SiddhiManager()
+        try:
+            rts = [mgr.create_siddhi_app_runtime(APP.format(i=i))
+                   for i in range(8)]
+            for rt in rts:
+                rt.add_callback("Out", lambda ev: None)
+                rt.start()
+            hs = [rt.get_input_handler("S") for rt in rts]
+            cycles = 20
+            for k in range(cycles):
+                for i, h in enumerate(hs):
+                    h.send([float(k % 3), float(k + i)], timestamp=1000 + k)
+            reg = mgr.siddhi_context.multiplex_registry
+            groups = reg.open_groups()
+            assert len(groups) == 1 and reg.seats_placed == 8
+            g = groups[0]
+            assert g.occupied_count() == 8
+            # slow (per-tenant fallback) steps only on first-contact JIT
+            # warmup; steady state is one combined step per send cycle
+            assert g.combined_steps <= cycles + 2
+            assert g.combined_steps + g.slow_steps < 8 * cycles / 2
+            for rt in rts:
+                rt.shutdown()
+        finally:
+            mgr.shutdown()
+
+
+class TestMultiplexFaults:
+    pytestmark = pytest.mark.faults
+
+    APP = ("@app:name('m{i}') @app:playback @app:execution('tpu') "
+           "@app:multiplex(slots='4') {faults}"
+           "define stream S (k long, v double); "
+           "@info(name='q') from S#window.lengthBatch(4) "
+           "select k, sum(v) as s group by k insert into Out;")
+
+    N = 3
+    NEV = 24
+
+    def _run(self, tenant1_faults=""):
+        sends = {i: _series(self.NEV, 11 + i, 1000 * i) for i in range(self.N)}
+        mgr = SiddhiManager()
+        try:
+            outs = {i: [] for i in range(self.N)}
+            rts = []
+            for i in range(self.N):
+                rt = mgr.create_siddhi_app_runtime(self.APP.format(
+                    i=i, faults=tenant1_faults if i == 1 else ""))
+                rts.append(rt)
+                rt.add_callback("Out", _collector(outs[i]))
+                rt.start()
+            hs = [rt.get_input_handler("S") for rt in rts]
+            for j in range(self.NEV):
+                for i in range(self.N):
+                    row, ts = sends[i][j]
+                    hs[i].send(list(row), timestamp=ts)
+            fi = rts[1].app_context.fault_injector
+            stats = fi.stats.as_dict() if fi else {}
+            for rt in rts:
+                rt.shutdown()
+            return outs, stats
+        finally:
+            mgr.shutdown()
+
+    def test_transient_faults_on_one_tenant_bit_identical(self):
+        ref, _ = self._run()
+        got, st = self._run(
+            "@app:faults(transfer.retry.scale='0.001', "
+            "ingest.put='transient:count=3', "
+            "emit.drain='transient:count=2') ")
+        assert st["faults_injected"] >= 5
+        assert st["transfer_retries"] >= 3 and st["drains_recovered"] >= 2
+        assert got == ref
+
+    def test_poison_quarantine_isolates_tenant(self):
+        """Tenant 1's state poisons mid-run; it quarantines without
+        stalling the group — tenants 0/2 stay bit-identical."""
+        ref, _ = self._run()
+        got, st = self._run("@app:faults(state.poison='poison:count=1:after=5') ")
+        assert st["poison_quarantines"] >= 1
+        assert got[0] == ref[0] and got[2] == ref[2]
+
+    def test_crash_and_journal_replay_one_tenant(self):
+        """Tenant 1 checkpoints, crashes mid-run, restores + replays its
+        journal on a fresh runtime — all three tenants end bit-identical
+        to a run that never crashed (same shared group throughout)."""
+        sends = {i: _series(30, 11 + i, 1000 * i) for i in range(self.N)}
+
+        def reference():
+            mgr = SiddhiManager()
+            try:
+                outs = {i: [] for i in range(self.N)}
+                rts = []
+                for i in range(self.N):
+                    rt = mgr.create_siddhi_app_runtime(
+                        self.APP.format(i=i, faults=""))
+                    rts.append(rt)
+                    rt.add_callback("Out", _collector(outs[i]))
+                    rt.start()
+                hs = [rt.get_input_handler("S") for rt in rts]
+                for j in range(30):
+                    for i in range(self.N):
+                        row, ts = sends[i][j]
+                        hs[i].send(list(row), timestamp=ts)
+                for rt in rts:
+                    rt.shutdown()
+                return outs
+            finally:
+                mgr.shutdown()
+
+        def crashed():
+            mgr = SiddhiManager()
+            mgr.set_persistence_store(InMemoryPersistenceStore())
+            try:
+                outs = {i: [] for i in range(self.N)}
+                rts = {}
+                for i in range(self.N):
+                    rt = mgr.create_siddhi_app_runtime(self.APP.format(
+                        i=i, faults="@app:faults(journal='256') "))
+                    rts[i] = rt
+                    rt.add_callback("Out", _collector(outs[i]))
+                    rt.start()
+                hs = {i: rts[i].get_input_handler("S")
+                      for i in range(self.N)}
+                for j in range(30):
+                    if j == 10:
+                        rts[1].persist()
+                    if j == 20:
+                        rts[1].app_context.fault_injector.configure(
+                            "ingest", "crash", count=1)
+                        row, ts = sends[1][j]
+                        with pytest.raises(SimulatedCrashError):
+                            hs[1].send(list(row), timestamp=ts)
+                        rts[1].shutdown()  # seat freed, group lives on
+                        rt2 = mgr.create_siddhi_app_runtime(self.APP.format(
+                            i=1, faults="@app:faults(journal='256') "))
+                        rt2.add_callback("Out", _collector(outs[1]))
+                        rt2.start()
+                        # the crashed send WAS journaled: replay covers it
+                        assert rt2.restore_last_revision() is not None
+                        rts[1] = rt2
+                        hs[1] = rt2.get_input_handler("S")
+                        for i in (0, 2):
+                            row, ts = sends[i][j]
+                            hs[i].send(list(row), timestamp=ts)
+                        continue
+                    for i in range(self.N):
+                        row, ts = sends[i][j]
+                        hs[i].send(list(row), timestamp=ts)
+                for i in range(self.N):
+                    rts[i].shutdown()
+                return outs
+            finally:
+                mgr.shutdown()
+
+        ref = reference()
+        got = crashed()
+        assert got == ref
+
+
+class TestMultiplexFallback:
+    def test_sliding_window_falls_back_with_counted_reason(self):
+        APP = """
+@app:name('fb') @app:execution('tpu') @app:multiplex(slots='4')
+@app:statistics('basic')
+define stream S (k long, v double);
+@info(name='q1') from S#window.length(4)
+select k, sum(v) as s group by k insert into Out;
+@info(name='q2') from S#window.lengthBatch(4)
+select k, sum(v) as s group by k insert into Out2;
+"""
+        mgr = SiddhiManager()
+        try:
+            rt = mgr.create_siddhi_app_runtime(APP)
+            rt.add_callback("Out", lambda e: None)
+            rt.add_callback("Out2", lambda e: None)
+            rt.start()
+            h = rt.get_input_handler("S")
+            for k in range(8):
+                h.send([k % 2, float(k)], timestamp=1000 + k)
+            assert rt.lowering() == {"q1": "device", "q2": "multiplex"}
+            st = rt.statistics()
+            pre = "io.siddhi.SiddhiApps.fb.Siddhi.Queries."
+            assert st[pre + "q1.multiplexFallbacks"] == 1
+            assert "tumbling" in st[pre + "q1.multiplexFallbackReason"]
+            assert st[pre + "q2.multiplexGroup"]
+            rt.shutdown()
+        finally:
+            mgr.shutdown()
+
+    def test_multiplex_requires_tpu_mode(self):
+        with pytest.raises(SiddhiAppCreationError, match="tpu"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@app:multiplex define stream S (v double); "
+                "@info(name='q') from S select v insert into Out;")
+
+    def test_slots_out_of_range_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="slots"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@app:execution('tpu') @app:multiplex(slots='1') "
+                "define stream S (v double); "
+                "@info(name='q') from S select v insert into Out;")
+
+
+class TestFlushSkipRegressions:
+    """Hot-pane flush batching must never skip a pane that holds data."""
+
+    APP = """
+@app:name('m{i}') @app:execution('tpu') @app:playback {mux}
+define stream S (k long, v double);
+@info(name='q') from S[v > 1.0]#window.{win}
+select k, sum(v) as s group by k insert into Out;
+"""
+
+    def _run(self, multiplex, win, sends_fn, n=3):
+        mgr = SiddhiManager()
+        try:
+            outs = {i: [] for i in range(n)}
+            rts = []
+            for i in range(n):
+                rt = mgr.create_siddhi_app_runtime(self.APP.format(
+                    i=i, win=win,
+                    mux="@app:multiplex(slots='4')" if multiplex else ""))
+                rts.append(rt)
+                rt.add_callback("Out", _collector(outs[i]))
+                rt.start()
+            sends_fn([rt.get_input_handler("S") for rt in rts])
+            reg = mgr.siddhi_context.multiplex_registry
+            skips = (sum(g.flush_skips for g in reg.open_groups())
+                     if reg else 0)
+            for rt in rts:
+                rt.shutdown()
+            return outs, skips
+        finally:
+            mgr.shutdown()
+
+    def test_lengthbatch_pane_filled_by_one_batch(self):
+        """A lengthBatch pane closed by a single oversized batch is FULL
+        at flush time even though the engine's fill counter still reads
+        0 (it increments after the closing flush) — the empty-pane skip
+        must not fire for lengthBatch."""
+
+        def big_batches(hs):
+            for j in range(3):
+                for i, h in enumerate(hs):
+                    h.send([Event(1000 + 10 * j + t,
+                                  [int(t % 2), float(2 + t + 10 * i)])
+                            for t in range(6)])
+
+        mux, _ = self._run(True, "lengthBatch(4)", big_batches)
+        ded, _ = self._run(False, "lengthBatch(4)", big_batches)
+        assert any(mux[i] for i in mux)
+        assert mux == ded
+
+    def test_timebatch_gaps_skip_empty_panes_bit_identical(self):
+        """Timestamp gaps close empty timeBatch panes; those flushes are
+        coalesced away (counted) without changing any output."""
+
+        def gap_sends(hs):
+            for j, t in enumerate([1000, 1002, 1050, 1052, 1200, 1201, 1500]):
+                for i, h in enumerate(hs):
+                    # one event per tenant fails the filter: its pane is
+                    # empty despite receiving traffic
+                    v = 0.5 if j == 2 else float(5 + j + 10 * i)
+                    h.send([int(j % 2), v], timestamp=t)
+
+        mux, skips = self._run(True, "timeBatch(10)", gap_sends)
+        ded, _ = self._run(False, "timeBatch(10)", gap_sends)
+        assert mux == ded
+        assert skips > 0
+
+    def test_sharded_timebatch_gap_skips(self):
+        """The same empty-pane skip on the mesh-sharded engine path
+        (parallel/device_shard.py): identical rows, counted skips."""
+
+        def run(devices):
+            APP = ("@app:name('sh') @app:execution('tpu', partitions='16'%s) "
+                   "@app:playback "
+                   "define stream S (k long, v double); "
+                   "@info(name='q') from S[v > 1.0]#window.timeBatch(10) "
+                   "select k, sum(v) as s group by k insert into Out;"
+                   ) % (", devices='8'" if devices else "")
+            mgr = SiddhiManager()
+            try:
+                rt = mgr.create_siddhi_app_runtime(APP)
+                got = []
+                rt.add_callback("Out", _collector(got))
+                rt.start()
+                h = rt.get_input_handler("S")
+                for j, t in enumerate([1000, 1002, 1050, 1052,
+                                       1200, 1201, 1500]):
+                    h.send([int(j % 2), float(5 + j)], timestamp=t)
+                qr = rt.query_runtimes["q"]
+                eng = qr.device_runtime.engine
+                skips = getattr(eng, "flush_skips", None)
+                rt.shutdown()
+                return got, skips
+            finally:
+                mgr.shutdown()
+
+        sharded, skips = run(True)
+        single, _ = run(False)
+        assert sharded == single and len(sharded) > 0
+        assert skips and skips > 0
+
+
+class TestMultiplexPersistence:
+    def test_persist_restore_forgets_post_persist_event(self):
+        """restore() rewinds exactly one tenant's seat state mid-pane;
+        the other tenants' accumulators are untouched."""
+        APP = """
+@app:name('m{i}') @app:execution('tpu') @app:playback {mux}
+define stream S (g double, price double);
+@info(name='q') from S#window.lengthBatch(6)
+select g, sum(price) as total group by g insert into Out;
+"""
+
+        def run(multiplex):
+            mgr = SiddhiManager()
+            mgr.set_persistence_store(InMemoryPersistenceStore())
+            try:
+                outs = {i: [] for i in range(3)}
+                rts = []
+                for i in range(3):
+                    rt = mgr.create_siddhi_app_runtime(APP.format(
+                        i=i,
+                        mux="@app:multiplex(slots='4')" if multiplex else ""))
+                    rts.append(rt)
+                    rt.add_callback("Out", _collector(outs[i]))
+                    rt.start()
+                hs = [rt.get_input_handler("S") for rt in rts]
+                for k in range(4):
+                    for i, h in enumerate(hs):
+                        h.send([float(k % 2), float(k + 10 * i)],
+                               timestamp=1000 + k)
+                # persist tenant 1 mid-pane, send one stray event, then
+                # restore: the stray must be forgotten
+                rts[1].persist()
+                hs[1].send([0.0, 999.0], timestamp=1005)
+                rts[1].restore_last_revision()
+                for k in range(4, 6):
+                    for i, h in enumerate(hs):
+                        h.send([float(k % 2), float(k + 10 * i)],
+                               timestamp=1000 + k)
+                for rt in rts:
+                    rt.shutdown()
+                return outs
+            finally:
+                mgr.shutdown()
+
+        mux = run(True)
+        ded = run(False)
+        assert any(mux[i] for i in mux)
+        assert mux == ded
+        # no pane ever saw the rolled-back 999 event
+        assert all(total < 900 for rows in mux.values()
+                   for (_g, total) in rows)
