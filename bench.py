@@ -78,6 +78,15 @@ MUX_STEPS = 10       # the combined fast path carries the steady state
 MUX_WARMUP = 2
 MUX_WINDOWS = 3
 
+# fused stream-graph measurement (planner/fusion.py): one 3-stage
+# filter -> window -> pattern app under @app:fuse (one jitted program
+# per batch cycle, intermediates resident in HBM) vs the same app
+# hopping host-side through its junctions between every stage
+FUSE_BATCH = 8_192
+FUSE_STEPS = 12
+FUSE_WARMUP = 2
+FUSE_WINDOWS = 3
+
 # CPU-backend smoke fallback (device backend unreachable): reduced
 # sizes so the number exists in seconds, clearly labeled as NOT the
 # chip measurement
@@ -91,6 +100,8 @@ SMOKE_SHWIN_STEPS = 4
 SMOKE_MUX_TENANTS = 4
 SMOKE_MUX_BATCH = 2_048
 SMOKE_MUX_STEPS = 4
+SMOKE_FUSE_BATCH = 2_048
+SMOKE_FUSE_STEPS = 5
 
 
 def pattern_query() -> str:
@@ -426,6 +437,96 @@ def bench_multiplexed(tenants=MUX_TENANTS, keys=MUX_KEYS,
     return out
 
 
+def bench_fused_pipeline(batch=FUSE_BATCH, steps=FUSE_STEPS,
+                         warmup=FUSE_WARMUP, windows=FUSE_WINDOWS):
+    """Device-resident stream-graph fusion: a 3-stage
+    filter -> sliding-window sum -> dense-pattern app run once under
+    ``@app:fuse`` (the whole chain is ONE jitted program per batch
+    cycle; intermediate event columns live in HBM) and once on the
+    junction path (every hop builds an EventBatch, dispatches through
+    its StreamJunction, and re-uploads).  Reports ``fusedHops`` — the
+    junction dispatches the fused program kept device-resident — next
+    to ``junctionHops``, the dispatches the unfused run actually
+    performed on the intermediate streams."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    APP = ("@app:name('fusebench{tag}') @app:playback "
+           "@app:execution('tpu') {fuse}"
+           "define stream SIn (sym int, price float, vol int); "
+           "define stream Mid (sym int, price float, vol int); "
+           "define stream Win (sym int, total double); "
+           "@info(name='q1') from SIn[price > 4.0] "
+           "select sym, price, vol insert into Mid; "
+           "@info(name='q2') from Mid#window.length(64) "
+           "select sym, sum(price) as total insert into Win; "
+           "@info(name='q3') from every e1=Win[total > 1540.0] "
+           "-> e2=Win[total > e1.total] "
+           "select e1.sym as s1, e1.total as t1, e2.total as t2 "
+           "insert into Out;")
+
+    def run(fuse):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(APP.format(
+                tag="F" if fuse else "J",
+                fuse="@app:fuse " if fuse else ""))
+            rows = [0]
+            rt.add_callback("Out", lambda evs: rows.__setitem__(
+                0, rows[0] + len(evs)))
+            rt.start()
+            if fuse:
+                assert rt.lowering() == {
+                    "q1": "fused", "q2": "fused", "q3": "fused"}, \
+                    "bench chain failed to fuse"
+            h = rt.get_input_handler("SIn")
+            rng = np.random.default_rng(31)
+
+            def mk(i):
+                sym = ((np.arange(batch, dtype=np.int64) * 524287
+                        + i * batch) % 8)
+                price = rng.uniform(0.0, 30.0, batch).astype(np.float32)
+                vol = rng.integers(1, 100, batch)
+                ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+                return EventBatch(
+                    "SIn", ["sym", "price", "vol"],
+                    {"sym": sym, "price": price, "vol": vol}, ts)
+
+            bs = [mk(i) for i in range(warmup + steps)]
+            for b in bs[:warmup]:
+                h.send_batch(b)
+            window_rates = []
+            for _w in range(windows):
+                t_w = time.perf_counter()
+                for b in bs[warmup:]:
+                    h.send_batch(b)
+                window_rates.append(
+                    batch * steps / (time.perf_counter() - t_w))
+            qr = rt.query_runtimes["q3"]
+            inter = (rt.junctions["Mid"].dispatches
+                     + rt.junctions["Win"].dispatches)
+            stats = (qr.device_runtime.stats()
+                     if fuse else {"fused_hops": 0})
+            rt.shutdown()
+            return (float(np.median(window_rates)), window_rates,
+                    stats, inter, rows[0])
+        finally:
+            m.shutdown()
+
+    f_rate, f_windows, f_stats, f_inter, _ = run(True)
+    j_rate, _j_windows, _, j_inter, _ = run(False)
+    assert f_inter == 0, "fused run dispatched an intermediate junction"
+    return {
+        "events_per_sec": f_rate,
+        "window_rates": [round(r, 1) for r in f_windows],
+        "junction_events_per_sec": j_rate,
+        "vs_junction": round(f_rate / j_rate, 3),
+        "fusedHops": f_stats["fused_hops"],
+        "junctionHops": j_inter,
+        "step_invocations": f_stats["step_invocations"],
+    }
+
+
 def bench_host_baseline():
     """Measured host-engine (ops/nfa.py) rate on the same partitioned
     pattern — the CPU reference side of the comparison."""
@@ -606,6 +707,17 @@ def main():
                 "dispatches_per_cycle"]
         except Exception as e:
             out["cpu_smoke_multiplexed_error"] = str(e)
+        try:
+            fp = bench_fused_pipeline(
+                batch=SMOKE_FUSE_BATCH, steps=SMOKE_FUSE_STEPS,
+                warmup=1, windows=2)
+            out["cpu_smoke_fused_pipeline_events_per_sec"] = round(
+                fp["events_per_sec"], 1)
+            out["cpu_smoke_fused_vs_junction"] = fp["vs_junction"]
+            out["cpu_smoke_fusedHops"] = fp["fusedHops"]
+            out["cpu_smoke_junctionHops"] = fp["junctionHops"]
+        except Exception as e:
+            out["cpu_smoke_fused_pipeline_error"] = str(e)
         print(json.dumps(out))
         return
     if not _probe_with_retry():
@@ -632,6 +744,11 @@ def main():
                 "cpu_smoke_multiplexed_events_per_sec"),
             "cpu_smoke_multiplexed_dispatches_per_cycle": smoke.get(
                 "cpu_smoke_multiplexed_dispatches_per_cycle"),
+            "fused_pipeline_events_per_sec_per_chip": None,
+            "cpu_smoke_fused_pipeline_events_per_sec": smoke.get(
+                "cpu_smoke_fused_pipeline_events_per_sec"),
+            "cpu_smoke_fused_vs_junction": smoke.get(
+                "cpu_smoke_fused_vs_junction"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
                 "kernel smoke + 8-virtual-device sharded-window smoke — "
@@ -642,6 +759,7 @@ def main():
     product = bench_product()
     shwin = bench_sharded_window()
     mux = bench_multiplexed()
+    fused = bench_fused_pipeline()
     host = bench_host_baseline()
     workload_rows = None
     if "--workloads" in sys.argv:
@@ -693,6 +811,12 @@ def main():
         "multiplexed_dispatches_per_cycle": mux["dispatches_per_cycle"],
         "multiplexed_combined_steps": mux["combined_steps"],
         "multiplexed_window_rates": mux["window_rates"],
+        "fused_pipeline_events_per_sec_per_chip": round(
+            fused["events_per_sec"], 1),
+        "fused_pipeline_vs_junction": fused["vs_junction"],
+        "fused_pipeline_fusedHops": fused["fusedHops"],
+        "fused_pipeline_junctionHops": fused["junctionHops"],
+        "fused_pipeline_window_rates": fused["window_rates"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

@@ -14,18 +14,32 @@ barriers; the bank does the same for aggregation STATE, so tpu-mode
 ingest performs no per-batch device→host flush (the former
 ``_device_reduce`` fetched a [U] reduction every batch).
 
-Precision: rows are float32 — the device lane policy shared with every
-other jitted path (ops/device_query.py docstring).  Integer fields
-(int sums, bare counts) stay on exact host numpy scatter ufuncs at
-native width, with one deliberate exception: when the aggregation is
-avg- or stdDev-bearing (avg rewrites to sum + count, stdDev to
-sum + sumsq + count — the sumsq row is a DOUBLE "sum"-op field and
-banks like any other float sum — and the float numerators are already
-banked), the shared count denominator rides the bank too as float32
-add rows.  Float32 integer arithmetic is exact below 2**24;
-``count_overflow_risk`` lets the runtime force a flush barrier before
-any row could cross that bound, and the flush merge casts count values
-back to exact ints (aggregation/runtime.py ``_flush_bank``).
+Precision: float rows are float32 — the device lane policy shared with
+every other jitted path (ops/device_query.py docstring).  Two integer
+shapes ride the bank exactly:
+
+* count denominators of avg- or stdDev-bearing selects (avg rewrites
+  to sum + count, stdDev to sum + sumsq + count — the sumsq row is a
+  DOUBLE "sum"-op field and banks like any other float sum) ride as
+  float32 add rows, exact below 2**24; ``count_overflow_risk`` lets
+  the runtime force a flush barrier before any row could cross that
+  bound, and the flush merge casts count values back to exact ints
+  (aggregation/runtime.py ``_flush_bank``).
+
+* LONG "sum" fields (``sum(intcol)`` widens INT→LONG) ride as a
+  hi/lo int32 PAIR of rows: hi accumulates ``v >> 16`` and lo
+  ``v & 0xFFFF`` (identities 0), and the flush merge recombines
+  ``hi * 65536 + lo`` — exact for signed values because arithmetic
+  shift/mask are two's-complement floor-div/mod, so
+  ``v == (v >> 16) * 65536 + (v & 0xFFFF)`` and addition distributes
+  over the split.  ``long_overflow_risk`` bounds both int32 lanes
+  conservatively (lo grows ≤ 65535 per event; hi by the batch's max
+  magnitude) and forces a flush barrier — or, for a single batch whose
+  values are alone too hot for int32, the exact host path — before
+  either lane could wrap.
+
+Other integer fields (bare counts without avg/stdDev, int min/max,
+last/set) keep the exact host numpy scatter ufuncs at native width.
 
 Row layout: ``cap`` assignable rows + one dump row (index ``cap``) that
 absorbs padded lanes and out-of-order events, which take the host
@@ -38,21 +52,31 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from siddhi_tpu.query_api import AttrType
+
 _IDENTITY = {"sum": 0.0, "count": 0.0, "min": np.inf, "max": -np.inf}
 
 # float32 holds consecutive integers exactly up to 2**24: the largest
 # count any bank row may accumulate between flushes
 COUNT_EXACT_MAX = 1 << 24
 
+# LONG sums split per event into hi = v >> 16 (signed) and
+# lo = v & 0xFFFF (in [0, 65535]); each lane accumulates in int32 and
+# the flush merge recombines hi * 65536 + lo exactly
+_LONG_LO_BITS = 16
+_LONG_LO_MAX = (1 << _LONG_LO_BITS) - 1
+_I32_MAX = (1 << 31) - 1
+
 
 class DeviceBucketBank:
     """Device rows for the float base fields of running finest buckets.
 
     ``fields``: the eligible BaseFields (op in sum/min/max over float
-    arguments — including the stdDev sumsq row — plus the count
-    denominator of avg- or stdDev-bearing selects).
-    One [cap+1] float32 device array per field; ``rows`` maps
-    (bucket_start, group_key) -> row index.
+    arguments — including the stdDev sumsq row — LONG sums, plus the
+    count denominator of avg- or stdDev-bearing selects).
+    One [cap+1] float32 device array per field — except LONG sums,
+    which own a hi/lo int32 PAIR of [cap+1] arrays; ``rows`` maps
+    (bucket_start, group_key) -> row index shared by every lane.
     """
 
     def __init__(self, fields, cap: int = 4096):
@@ -62,17 +86,37 @@ class DeviceBucketBank:
         self.cap = int(cap)
         self.rows: Dict[Tuple[int, Tuple], int] = {}
         self._free: List[int] = list(range(self.cap))
-        self._arrays = None  # per-field jnp [cap+1]; lazy (jax import)
+        self._arrays = None  # per-lane jnp [cap+1]; lazy (jax import)
         self._scatter = None
+        # lane plan: each field owns one float32 row, except LONG sums
+        # which own an exact hi/lo int32 pair (module docstring)
+        self._lanes: List[Tuple[str, str]] = []  # (op, "f32"|"i32")
+        self._field_lanes: List[Tuple[int, ...]] = []
+        for f in self.fields:
+            if f.op == "sum" and f.type == AttrType.LONG:
+                self._field_lanes.append((len(self._lanes),
+                                          len(self._lanes) + 1))
+                self._lanes += [("sum", "i32"), ("sum", "i32")]
+            else:
+                self._field_lanes.append((len(self._lanes),))
+                self._lanes.append((f.op, "f32"))
+        self.long_names: List[str] = [
+            f.name for f, ln in zip(self.fields, self._field_lanes)
+            if len(ln) == 2
+        ]
         # flush-barrier evidence for tests/bench: ingest batches absorbed
         # on device vs host materializations
         self.scatters = 0
         self.flushes = 0
         # events scattered since the last flush: upper-bounds the count
         # any single row may have accumulated (count rows are float32,
-        # exact only below COUNT_EXACT_MAX)
+        # exact only below COUNT_EXACT_MAX) and the lo int32 lane of a
+        # LONG sum (each event adds at most _LONG_LO_MAX)
         self._has_count = "count" in self.ops
         self.events_since_flush = 0
+        # per-LONG-field conservative bound on |hi| accumulated since
+        # the last flush (long_overflow_risk)
+        self._long_hi_used: Dict[str, int] = {}
 
     @property
     def dump_row(self) -> int:
@@ -85,6 +129,32 @@ class DeviceBucketBank:
         return (self._has_count
                 and self.events_since_flush + n > COUNT_EXACT_MAX)
 
+    @staticmethod
+    def _hi_bound(v: np.ndarray, n: int) -> int:
+        """Conservative bound on the |hi| lane growth one batch can
+        cause in any single row: every event at the batch's max
+        magnitude landing on one bucket.  Python ints — no int64
+        overflow on extreme inputs."""
+        m = max(abs(int(v.max())), abs(int(v.min())))
+        return n * ((m >> _LONG_LO_BITS) + 1)
+
+    def long_overflow_risk(self, fvals: Dict[str, np.ndarray],
+                           n: int) -> bool:
+        """True when scattering ``n`` more events with these values
+        could wrap either int32 lane of a LONG-sum pair row — the
+        caller must flush first (and if one batch is alone too hot,
+        fall back to the exact host path for the batch).  Always False
+        when no LONG sum is banked."""
+        if not self.long_names:
+            return False
+        if (self.events_since_flush + n) * _LONG_LO_MAX > _I32_MAX:
+            return True
+        return any(
+            self._long_hi_used.get(name, 0)
+            + self._hi_bound(fvals[name], n) > _I32_MAX
+            for name in self.long_names
+        )
+
     # -- device arrays -------------------------------------------------------
 
     def _ensure_arrays(self):
@@ -93,19 +163,20 @@ class DeviceBucketBank:
         import jax.numpy as jnp
 
         self._arrays = [
-            jnp.full(self.cap + 1, _IDENTITY[op], dtype=jnp.float32)
-            for op in self.ops
+            jnp.zeros(self.cap + 1, dtype=jnp.int32) if kind == "i32"
+            else jnp.full(self.cap + 1, _IDENTITY[op], dtype=jnp.float32)
+            for op, kind in self._lanes
         ]
 
     def _scatter_fn(self):
         if self._scatter is None:
             import jax
 
-            ops = self.ops
+            lanes = tuple(self._lanes)
 
             def fn(arrays, rows, vals):
                 out = []
-                for op, a, v in zip(ops, arrays, vals):
+                for (op, _kind), a, v in zip(lanes, arrays, vals):
                     if op in ("sum", "count"):
                         out.append(a.at[rows].add(v))
                     elif op == "min":
@@ -134,7 +205,7 @@ class DeviceBucketBank:
     def scatter(self, ev_rows: np.ndarray, fvals: Dict[str, np.ndarray]):
         """Accumulate one micro-batch in place: ``ev_rows`` [n] row per
         event (``dump_row`` for events that take the host path),
-        ``fvals`` the per-event float columns keyed by field name.  Rows
+        ``fvals`` the per-event value columns keyed by field name.  Rows
         are padded to a power of two so the jitted scatter sees a
         bounded shape variety; padded lanes target the dump row with the
         op identity."""
@@ -146,10 +217,23 @@ class DeviceBucketBank:
         rows_p = np.full(n_pad, self.dump_row, dtype=np.int32)
         rows_p[:n] = ev_rows
         vals = []
-        for name, op in zip(self.names, self.ops):
-            col = np.full(n_pad, _IDENTITY[op], dtype=np.float32)
-            col[:n] = fvals[name].astype(np.float32)
-            vals.append(jnp.asarray(col))
+        for fi, (name, op) in enumerate(zip(self.names, self.ops)):
+            lanes = self._field_lanes[fi]
+            if len(lanes) == 2:
+                # LONG sum: exact signed hi/lo split (padded lanes add
+                # the identity 0 to the dump row)
+                v = np.asarray(fvals[name]).astype(np.int64)
+                hi = np.zeros(n_pad, dtype=np.int32)
+                lo = np.zeros(n_pad, dtype=np.int32)
+                hi[:n] = (v >> _LONG_LO_BITS).astype(np.int32)
+                lo[:n] = (v & _LONG_LO_MAX).astype(np.int32)
+                vals += [jnp.asarray(hi), jnp.asarray(lo)]
+                self._long_hi_used[name] = (
+                    self._long_hi_used.get(name, 0) + self._hi_bound(v, n))
+            else:
+                col = np.full(n_pad, _IDENTITY[op], dtype=np.float32)
+                col[:n] = fvals[name].astype(np.float32)
+                vals.append(jnp.asarray(col))
         self._arrays = self._scatter_fn()(
             self._arrays, jnp.asarray(rows_p), vals)
         self.scatters += 1
@@ -169,10 +253,17 @@ class DeviceBucketBank:
         host = [np.asarray(a) for a in jax.device_get(self._arrays)]
         out: Dict[Tuple[int, Tuple], Dict[str, float]] = {}
         for key, row in self.rows.items():
-            out[key] = {
-                name: float(host[fi][row])
-                for fi, name in enumerate(self.names)
-            }
+            values: Dict[str, float] = {}
+            for fi, name in enumerate(self.names):
+                lanes = self._field_lanes[fi]
+                if len(lanes) == 2:
+                    # exact int recombination of the hi/lo pair
+                    values[name] = (
+                        int(host[lanes[0]][row]) * (_LONG_LO_MAX + 1)
+                        + int(host[lanes[1]][row]))
+                else:
+                    values[name] = float(host[lanes[0]][row])
+            out[key] = values
         self.flushes += 1
         self.clear()
         return out
@@ -184,3 +275,4 @@ class DeviceBucketBank:
         self._free = list(range(self.cap))
         self._arrays = None
         self.events_since_flush = 0
+        self._long_hi_used.clear()

@@ -422,20 +422,24 @@ class AggregationRuntime:
         self.field_ops: Dict[str, str] = {f.name: f.op for f in self.base_fields}
 
         # device-resident ingest (tpu mode): float sum/min/max base
-        # fields of running finest buckets accumulate in device rows and
-        # materialize to the host store only at flush barriers
-        # (aggregation/device_bank.py); integer/last/set fields keep the
-        # exact host path at native width
+        # fields of running finest buckets accumulate in device rows,
+        # LONG sums (``sum(intcol)`` widens INT→LONG) in exact hi/lo
+        # int32 pair rows, and both materialize to the host store only
+        # at flush barriers (aggregation/device_bank.py); remaining
+        # integer/last/set fields keep the exact host path at native
+        # width
         self._bank = None
         if self._device_segments:
             bank_fields = [
                 f for f in self.base_fields
-                if f.op in ("sum", "min", "max")
-                and f.type in (AttrType.FLOAT, AttrType.DOUBLE)
+                if (f.op in ("sum", "min", "max")
+                    and f.type in (AttrType.FLOAT, AttrType.DOUBLE))
+                or (f.op == "sum" and f.type == AttrType.LONG)
             ]
-            # avg(x) over a float argument rewrites to _SUM/_COUNT and
-            # stdDev(x) to _SUM/_SUMSQ/_COUNT (the sumsq row is a
-            # DOUBLE "sum"-op field, so it is already banked above);
+            # avg(x) over a numeric argument rewrites to _SUM/_COUNT
+            # and stdDev(x) to _SUM/_SUMSQ/_COUNT (the sumsq row is a
+            # DOUBLE "sum"-op field and an int avg's _SUM is a LONG
+            # sum, so both numerators are already banked above);
             # with the numerators banked, banking the shared count
             # denominator too lets avg- and stdDev-bearing ingest skip
             # the host reduction entirely.  Count rows are float32 on
@@ -688,6 +692,15 @@ class AggregationRuntime:
         # force a flush before this batch could push any row past that
         if bank.count_overflow_risk(len(ids)):
             self._flush_bank()
+        # LONG-sum hi/lo int32 pair rows must never wrap: flush when
+        # the conservative accumulated bound nears int32 range; a batch
+        # whose values are alone too hot for int32 takes the exact host
+        # path for every bank field (host merges and later bank flushes
+        # combine associatively, so mixing the paths stays exact)
+        if bank.long_overflow_risk(fvals, len(ids)):
+            self._flush_bank()
+            if bank.long_overflow_risk(fvals, len(ids)):
+                return set()
         run_keys = [k for k, r in zip(seg_keys, running) if r]
         if not bank.assign(run_keys):
             # capacity barrier: materialize every row and retry once

@@ -36,6 +36,8 @@ SCANNED = (
     "siddhi_tpu/ops/dense_nfa.py",
     "siddhi_tpu/parallel/device_shard.py",
     "siddhi_tpu/parallel/mesh.py",
+    "siddhi_tpu/ops/fused_graph.py",
+    "siddhi_tpu/core/fused_graph.py",
 )
 
 MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",

@@ -122,6 +122,12 @@ class SiddhiAppContext:
         # default; slots bounds the tenant axis of each shared engine.
         self.multiplex = False
         self.multiplex_slots = 8
+        # @app:fuse: fuse chains of device-lowered queries linked by
+        # `insert into` streams into ONE jitted program per chain, with
+        # intermediate event columns kept in HBM (planner/fusion.py).
+        # Off by default; ineligible chains fall back to the junction
+        # path with counted reasons.
+        self.fuse = False
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -1,0 +1,241 @@
+"""Product-side runtime of one fused chain (ops/fused_graph.py).
+
+`FusedChainRuntime` is the chain's analog of DeviceQueryRuntime
+(core/device_single.py): it converts the HEAD stream's junction batches
+to device columns, advances the whole chain with ONE jitted fused step,
+and emits the TAIL's output batches into the tail query's
+selector/output chain.  Intermediate streams never build EventBatches
+and never dispatch through their junctions — their event columns live
+in HBM between stages.
+
+It rides the same async machinery as the per-query runtimes — ingest
+staging window (core/ingest_stage.py), bounded pending-emit queue
+(core/emit_queue.py), fault choke-points (ingest.put / step.device /
+step.dense / emit.drain), NaN/Inf poison quarantine — and the same
+barriers: drain on snapshot/restore, rate-limiter fires, pull queries,
+and shutdown, so callback content and order stay bit-identical to the
+junction path.
+
+Snapshot/restore: the planner attaches this runtime as the TAIL
+query's ``device_runtime``, so QueryRuntime.snapshot_state persists the
+whole chain's state (per-stage device arrays + host epochs) under the
+tail query's name and crash replay (input journal) reproduces it.
+
+This module is scanned by the `host-sync-hazard` analysis rule with no
+allowlist entries: snapshots deep-copy through util.faults.host_copy,
+restores re-materialize with jnp.asarray, and every column fetch goes
+through the emit queue's coalesced drain.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats, PendingEmit
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import SiddhiAppRuntimeError
+from siddhi_tpu.core.ingest_stage import IngestStage, IngestStats
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class FusedChainRuntime:
+    """One fused chain: head-junction subscriber in, tail-query output
+    chain out, everything between device-resident."""
+
+    def __init__(self, graph, out_stream_id: str,
+                 emit: Callable[[EventBatch], None], emit_depth=1,
+                 clock: Optional[Callable[[], int]] = None, faults=None,
+                 ingest_depth=1):  # int or 'auto'
+        self.graph = graph
+        self.out_stream_id = out_stream_id
+        self.emit_cb = emit
+        self.state = graph.init_state()
+        self.step_invocations = 0  # fused program dispatches (tests)
+        # hops kept device-resident: (stages - 1) junction dispatches
+        # saved per fused dispatch (the bench's fusedHops counter)
+        self.hops_per_dispatch = (
+            len(graph.stages) + (1 if graph.dense is not None else 0) - 1)
+        self.fused_hops = 0
+        self.emit_stats = EmitStats()
+        self.faults = faults
+        graph.faults = faults
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
+                                    faults=faults, on_fault=self._on_fault)
+        self.ingest_stats = IngestStats()
+        graph.ingest_stats = self.ingest_stats
+        self.ingest_stage = IngestStage(
+            depth=ingest_depth, stats=self.ingest_stats, faults=faults,
+            on_fault=self._on_fault)
+        # last known-poison-free host copy of the chain state (only
+        # while a state.poison fault is armed — quarantine source)
+        self._last_good = None
+        self.clock = clock
+
+    def _on_fault(self, e: BaseException):
+        if self.faults is not None:
+            self.faults.notify(e)
+
+    def _poison_guard(self) -> bool:
+        """NaN/Inf quarantine over the WHOLE chain's state tuple, active
+        only while a ``state.poison`` fault is armed (the
+        DeviceQueryRuntime contract, applied chain-wide)."""
+        fi = self.faults
+        if fi is None or not fi.watches("state.poison"):
+            return False
+        from siddhi_tpu.util import faults as _faults
+
+        if fi.poisoned("state.poison"):
+            self.state = _faults.poison_state(self.state)
+        if not _faults.state_has_poison(self.state):
+            self._last_good = _faults.host_copy(self.state)
+            return False
+        fi.stats.poison_quarantines += 1
+        jnp = self.graph.jnp
+        if self._last_good is not None:
+            log.error("fused chain state poisoned (NaN/Inf); quarantining "
+                      "batch and re-materializing last clean state")
+            self.state = tuple(
+                {k: jnp.asarray(v) for k, v in st.items()}
+                for st in self._last_good)
+        else:
+            log.error("fused chain state poisoned (NaN/Inf) with no clean "
+                      "copy; quarantining batch and re-initializing")
+            self.state = self.graph.init_state()
+        return True
+
+    # -- event path ----------------------------------------------------------
+
+    def process_stream_batch(self, batch: EventBatch, keys=None):
+        cur = batch.only(ev.CURRENT)
+        n = len(cur)
+        if n == 0:
+            return
+        head = self.graph.stages[0]
+        cols = {
+            a: cur.columns[a]
+            for a in head.all_attrs if a in cur.columns
+        }
+        ts = cur.timestamps
+        self.state, pending = self.graph.process_batch_deferred(
+            self.state, cols, ts)
+        self.step_invocations += 1
+        self.fused_hops += self.hops_per_dispatch
+        if self._poison_guard():
+            return
+        now = self.clock() if self.clock is not None else None
+
+        def _finish(p=pending, t=now):
+            if p is None or p.resolve() == 0:
+                self.emit_queue.skip()
+                return
+            self.emit_queue.push(PendingEmit(
+                p.device_arrays(),
+                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt)))
+
+        self.ingest_stage.submit(
+            pending.probe() if pending is not None else None, _finish)
+
+    def drain(self):
+        """Flush barrier (snapshot/restore, rate-limiter fires, pull
+        queries, shutdown): staged batches enqueue first, then one
+        coalesced drain emits everything in the synchronous order."""
+        self.ingest_stage.flush()
+        self.emit_queue.drain()
+
+    def _emit_deferred(self, pending, host_arrays, now=None):
+        out_cols, out_ts = pending.materialize(host_arrays)
+        if len(out_ts) == 0:
+            return
+        mb = EventBatch(
+            self.out_stream_id, self.graph.output_names, out_cols,
+            out_ts, np.full(len(out_ts), ev.CURRENT, dtype=np.int8),
+        )
+        if now is not None:
+            mb.aux["emit_now"] = now
+        self.emit_cb(mb)
+
+    def close(self):
+        self.drain()
+
+    # -- scheduler task contract (the fused kinds have no pane timers;
+    # registration keeps the planner wiring uniform) -------------------------
+
+    def next_wakeup(self) -> Optional[int]:
+        return None
+
+    def fire(self, now: int):
+        self.drain()
+
+    def on_start(self, now: int):
+        pass
+
+    def on_time(self, now: int):
+        pass
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> Dict:
+        return {
+            "engine": "fused",
+            "stages": len(self.graph.stages)
+            + (1 if self.graph.dense is not None else 0),
+            "step_invocations": self.step_invocations,
+            "fused_hops": self.fused_hops,
+        }
+
+    # -- snapshot contract ---------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        self.drain()
+        from siddhi_tpu.util.faults import host_copy
+
+        snap: Dict = {
+            "chain": [host_copy(st) for st in self.state],
+            "hosts": [eng.host_snapshot() for eng in self.graph.stages],
+        }
+        if self.graph.dense is not None:
+            snap["dense_base_ts"] = self.graph.dense.base_ts
+        return snap
+
+    def restore(self, state: Dict):
+        self.drain()
+        self._last_good = None
+        g = self.graph
+        jnp = g.jnp
+        chain = state["chain"]
+        n_states = len(g.stages) + (1 if g.dense is not None else 0)
+        if len(chain) != n_states:
+            raise SiddhiAppRuntimeError(
+                f"fused-chain snapshot has {len(chain)} stage states; "
+                f"this chain has {n_states} — persist and restore must "
+                "use the same app definition")
+        restored: List = []
+        for si, st in enumerate(chain):
+            eng = g.stages[si] if si < len(g.stages) else g.dense
+            expect = {k: v.shape for k, v in eng.init_state_host().items()}
+            for k, v in st.items():
+                if k in expect and v.shape != expect[k]:
+                    raise SiddhiAppRuntimeError(
+                        f"fused-chain snapshot stage {si} array '{k}' has "
+                        f"shape {v.shape}; this chain expects {expect[k]}")
+            restored.append({k: jnp.asarray(v) for k, v in st.items()})
+        self.state = tuple(restored)
+        for eng, h in zip(g.stages, state["hosts"]):
+            eng.host_restore(h)
+        if g.dense is not None:
+            g.dense.base_ts = state.get("dense_base_ts")
+
+
+class _FusedChainReceiver:
+    """Head-junction subscriber feeding one fused chain."""
+
+    def __init__(self, runtime: FusedChainRuntime):
+        self.runtime = runtime
+
+    def receive(self, batch: EventBatch):
+        self.runtime.process_stream_batch(batch)

@@ -103,6 +103,10 @@ class StreamJunction:
         self._worker: Optional[threading.Thread] = None
         self._running = False
         self.throughput_tracker = None  # set when statistics enabled
+        # dispatch cycles through this junction (host hop accounting:
+        # fused chains keep this at 0 on intermediate streams — the
+        # bench/test `junctionHops` counter)
+        self.dispatches = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -180,6 +184,7 @@ class StreamJunction:
             self._dispatch(EventBatch.concat(batches))
 
     def _dispatch(self, batch: EventBatch):
+        self.dispatches += 1
         for r in self.receivers:
             try:
                 r.receive(batch)

@@ -177,6 +177,23 @@ class AppPlanner:
                         "integer in 2..64")
                 self.app_context.multiplex_slots = ns
 
+        # @app:fuse: fuse chains of device-lowered queries linked by
+        # `insert into` streams into ONE jitted multi-stage program per
+        # chain — intermediate event columns stay in HBM, no EventBatch
+        # builds or junction dispatches between stages
+        # (planner/fusion.py).  Ineligible chains fall back to the
+        # junction path with counted fusedFallbackReasons.
+        fuse_ann = find_annotation(siddhi_app.annotations, "app:fuse")
+        if fuse_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:fuse needs @app:execution('tpu')")
+            v = (fuse_ann.element() or "true").lower()
+            if v not in ("true", "false"):
+                raise SiddhiAppCreationError(
+                    f"@app:fuse('{v}'): expected 'true' or 'false'")
+            self.app_context.fuse = v == "true"
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")
@@ -545,12 +562,22 @@ class AppPlanner:
         from siddhi_tpu.core.partition import PartitionRuntime
 
         qp = QueryPlanner(self)
+        # @app:fuse pre-pass: detect chains of device-eligible queries
+        # linked by exclusive `insert into` streams and lower each chain
+        # to ONE fused engine (planner/fusion.py).  Chain members come
+        # back pre-planned, keyed by query identity; everything else
+        # takes the ordinary per-query path below.
+        fused: Dict[int, object] = {}
+        if self.app_context.fuse:
+            from siddhi_tpu.planner.fusion import plan_fused_chains
+
+            fused = plan_fused_chains(self, qp)
         qi = 0
         pi = 0
         self.partition_runtimes: Dict[str, object] = {}
         for element in self.siddhi_app.execution_elements:
             if isinstance(element, Query):
-                qr = qp.plan(element, qi)
+                qr = fused.pop(id(element), None) or qp.plan(element, qi)
                 qi += 1
                 if qr.name in self.query_runtimes:
                     raise SiddhiAppCreationError(f"duplicate query name '{qr.name}'")

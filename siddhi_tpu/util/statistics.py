@@ -182,6 +182,12 @@ class StatisticsManager:
         self.multiplex_fallbacks: Dict[str, int] = {}
         self.multiplex_fallback_reasons: Dict[str, str] = {}
         self.multiplex_placements: Dict[str, str] = {}
+        # queries under @app:fuse whose chain (or chain membership)
+        # could not stay device-resident and went down the junction
+        # path: count + last reason per query, populated by the fusion
+        # planner so the downgrade is never silent
+        self.fused_fallbacks: Dict[str, int] = {}
+        self.fused_fallback_reasons: Dict[str, str] = {}
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -224,6 +230,14 @@ class StatisticsManager:
         self.multiplex_fallbacks[qname] = (
             self.multiplex_fallbacks.get(qname, 0) + 1)
         self.multiplex_fallback_reasons[qname] = reason
+
+    def record_fused_fallback(self, qname: str, reason: str):
+        """A query under @app:fuse is hopping through its junction
+        instead of a fused device chain; counted per query with the
+        last reason kept."""
+        self.fused_fallbacks[qname] = (
+            self.fused_fallbacks.get(qname, 0) + 1)
+        self.fused_fallback_reasons[qname] = reason
 
     def record_multiplex_placement(self, qname: str, fingerprint: str,
                                    occupied: int):
@@ -269,6 +283,10 @@ class StatisticsManager:
                 self.multiplex_fallback_reasons.get(qname, ""))
         for qname, gp in list(self.multiplex_placements.items()):
             out[self._metric("Queries", qname, "multiplexGroup")] = gp
+        for qname, n in list(self.fused_fallbacks.items()):
+            out[self._metric("Queries", qname, "fusedFallbacks")] = n
+            out[self._metric("Queries", qname, "fusedFallbackReason")] = (
+                self.fused_fallback_reasons.get(qname, ""))
         return out
 
     def reset(self):

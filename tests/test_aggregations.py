@@ -278,3 +278,92 @@ class TestStdDevDeviceBank:
             assert a[0] == b[0]
             # float32 device lanes + sum/sumsq decomposition tolerance
             assert b[1] == pytest.approx(a[1], abs=5e-3, rel=1e-3), (a, b)
+
+
+class TestLongSumDeviceBank:
+    """sum(intcol) widens INT→LONG; in tpu mode LONG sums ride the
+    device bucket bank as hi/lo int32 pair rows (hi += v >> 16,
+    lo += v & 0xFFFF, flush merge hi * 65536 + lo) — EXACTLY, unlike
+    the float32 lanes.  An avg over the same int argument shares the
+    banked _SUM numerator and banks its count denominator too."""
+
+    APP = (
+        "{mode}@app:playback "
+        "define stream S (sym string, v int, ts long); "
+        "define aggregation A from S select sym, sum(v) as total, "
+        "avg(v) as mean group by sym aggregate by ts every sec...min;"
+    )
+
+    def _run(self, manager, mode, vals, probe=False):
+        import numpy as np
+
+        rt = manager.create_siddhi_app_runtime(self.APP.format(mode=mode))
+        rt.start()
+        agg = rt.aggregations["A"]
+        if probe:
+            assert agg._bank is not None
+            # the LONG _SUM field owns a pair lane; count banks with it
+            assert agg._bank.long_names, agg._bank.names
+            assert set(agg._bank.names) == {f.name for f in agg.base_fields}
+        rng = np.random.default_rng(11)
+        n = len(vals)
+        ts = np.sort(BASE + rng.integers(0, 5_000, n)).astype(np.int64)
+        h = rt.get_input_handler("S")
+        for j in range(n):
+            h.send([f"s{int(rng.integers(0, 6))}", int(vals[j]), int(ts[j])])
+        out = rt.query(
+            f"from A within {BASE - 1000}, {BASE + 100_000} per 'seconds' "
+            "select sym, total, mean;")
+        rt.shutdown()
+        return sorted([list(e.data) for e in out], key=lambda r: r[0])
+
+    def _diff(self, manager, vals):
+        host = self._run(manager, "", vals)
+        m2 = SiddhiManager()
+        try:
+            dev = self._run(m2, "@app:execution('tpu') ", vals, probe=True)
+        finally:
+            m2.shutdown()
+        assert len(host) == len(dev) > 0
+        for a, b in zip(host, dev):
+            assert a[0] == b[0], (a, b)
+            # hi/lo int32 pair rows are exact — no tolerance
+            assert a[1] == b[1], ("LONG sum must be exact", a, b)
+            assert b[2] == pytest.approx(a[2], rel=1e-6), (a, b)
+
+    def test_long_sum_exact_on_bank_path(self, manager):
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        self._diff(manager, rng.integers(-100_000, 100_000, 500))
+
+    def test_long_sum_negative_heavy_exact(self, manager):
+        import numpy as np
+
+        # all-negative sums exercise the signed two's-complement split
+        # (hi goes negative while lo stays in [0, 65535])
+        rng = np.random.default_rng(5)
+        self._diff(manager, rng.integers(-(2**31), -1, 300))
+
+    def test_overflow_risk_forces_flush_or_host_path(self):
+        from siddhi_tpu.aggregation.device_bank import DeviceBucketBank
+        from siddhi_tpu.aggregation.runtime import BaseField
+        from siddhi_tpu.query_api import AttrType
+        import numpy as np
+
+        f = BaseField("_SUM0", "sum", None, AttrType.LONG)
+        bank = DeviceBucketBank([f], cap=8)
+        v = np.asarray([2**40, -(2**40)], dtype=np.int64)
+        assert not bank.long_overflow_risk({"_SUM0": v}, 2)
+        # a batch whose per-event hi magnitude alone nears int32 must
+        # report risk even on an empty bank (host-path fallback)
+        hot = np.asarray([2**50], dtype=np.int64)
+        assert bank.long_overflow_risk({"_SUM0": hot}, 1)
+        # accumulated moderate batches eventually trip the barrier too
+        bank.rows[(0, ())] = 0
+        bank.scatter(np.zeros(2, dtype=np.int32), {"_SUM0": v})
+        assert bank._long_hi_used["_SUM0"] > 0
+        bank._long_hi_used["_SUM0"] = (1 << 31) - 10
+        assert bank.long_overflow_risk({"_SUM0": v}, 2)
+        bank.clear()
+        assert not bank.long_overflow_risk({"_SUM0": v}, 2)

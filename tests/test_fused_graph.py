@@ -1,0 +1,448 @@
+"""Device-resident stream-graph fusion differential suite.
+
+``@app:fuse`` (planner/fusion.py) lowers `insert into` chains whose
+intermediate streams have exactly one device producer and one device
+consumer into ONE jitted multi-stage program (ops/fused_graph.py +
+core/fused_graph.py): intermediate event columns stay in HBM, no
+EventBatch is built and no junction dispatch happens between stages.
+
+The contract under test is bit-identical callbacks versus the same app
+running per-query engines with junction hops — across chain shapes
+(filter→filter, filter→window→filter, filter→window→dense-pattern),
+under transient ingest/emit faults, crash + journal replay, and
+persist/restore mid-chain — plus dispatch accounting (one jitted step
+per batch cycle, zero intermediate dispatches, zero intermediate
+EventBatches) and counted, readable fallback reasons for unfusable
+chains.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SimulatedCrashError,
+)
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+
+def _collector(res):
+    return lambda events: res.extend(
+        (e.timestamp, tuple(e.data)) for e in events)
+
+
+def _sends(n, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    ts = 1000
+    for _ in range(n):
+        out.append(([int(rng.integers(0, 5)),
+                     float(np.float32(rng.uniform(0, 30))),
+                     int(rng.integers(1, 100))], ts))
+        ts += 3
+    return out
+
+
+TWO_STAGE = """
+@app:name('f2{tag}') @app:playback @app:execution('tpu') {fuse}
+define stream SIn (sym int, price float, vol int);
+
+@info(name='q1') from SIn[price > 10.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid[vol > 50]
+select sym, price insert into Out;
+"""
+
+THREE_STAGE = """
+@app:name('f3{tag}') @app:playback @app:execution('tpu') {fuse}{faults}
+define stream SIn (sym int, price float, vol int);
+define stream Mid (sym int, price float, vol int);
+define stream Win (sym int, total double);
+
+@info(name='q1') from SIn[price > 10.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid#window.length(8)
+select sym, sum(price) as total insert into Win;
+@info(name='q3') from Win[total > 50.0]
+select sym, total insert into Out;
+"""
+
+DENSE_TAIL = """
+@app:name('fd{tag}') @app:playback @app:execution('tpu') {fuse}
+define stream SIn (sym int, price float, vol int);
+define stream Mid (sym int, price float, vol int);
+define stream Win (sym int, total double);
+
+@info(name='q1') from SIn[price > 5.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid#window.length(4)
+select sym, sum(price) as total insert into Win;
+@info(name='q3') from every e1=Win[total > 30.0] -> e2=Win[total > e1.total]
+select e1.sym as s1, e1.total as t1, e2.total as t2 insert into Out;
+"""
+
+
+def _run_app(app_text, fuse, sends, tag_extra="", faults="", mgr=None):
+    own = mgr is None
+    if own:
+        mgr = SiddhiManager()
+    try:
+        rt = mgr.create_siddhi_app_runtime(app_text.format(
+            tag=("F" if fuse else "J") + tag_extra,
+            fuse="@app:fuse" if fuse else "", faults=faults))
+        got = []
+        rt.add_callback("Out", _collector(got))
+        rt.start()
+        h = rt.get_input_handler("SIn")
+        for row, ts in sends:
+            h.send(list(row), timestamp=ts)
+        low = rt.lowering()
+        junc = {k: j.dispatches for k, j in rt.junctions.items()}
+        fi = rt.app_context.fault_injector
+        fstats = fi.stats.as_dict() if fi else {}
+        rt.shutdown()
+        return got, low, junc, fstats
+    finally:
+        if own:
+            mgr.shutdown()
+
+
+class TestFusedDifferential:
+    """Fused chains == junction hops, bit for bit, per chain shape."""
+
+    def test_two_stage_filter_filter_undeclared_intermediate(self):
+        # Mid is never declared: the planner synthesizes its schema from
+        # the producer's output spec
+        sends = _sends(60, 3)
+        gf, lf, jf, _ = _run_app(TWO_STAGE, True, sends)
+        gj, lj, _, _ = _run_app(TWO_STAGE, False, sends)
+        assert lf == {"q1": "fused", "q2": "fused"}
+        assert "fused" not in lj.values()
+        assert len(gf) > 0 and gf == gj
+        assert jf.get("Mid", 0) == 0
+
+    def test_three_stage_filter_window_filter(self):
+        sends = _sends(90, 0)
+        gf, lf, jf, _ = _run_app(THREE_STAGE, True, sends)
+        gj, lj, jjn, _ = _run_app(THREE_STAGE, False, sends)
+        assert lf == {"q1": "fused", "q2": "fused", "q3": "fused"}
+        assert len(gf) > 0 and gf == gj
+        # intermediate junctions never dispatch on the fused path; the
+        # junction path hops through both
+        assert jf.get("Mid", 0) == 0 and jf.get("Win", 0) == 0
+        assert jjn["Mid"] > 0 and jjn["Win"] > 0
+
+    def test_three_stage_dense_pattern_tail(self):
+        sends = _sends(75, 7)
+        gf, lf, jf, _ = _run_app(DENSE_TAIL, True, sends)
+        gj, lj, _, _ = _run_app(DENSE_TAIL, False, sends)
+        assert lf == {"q1": "fused", "q2": "fused", "q3": "fused"}
+        assert lj["q3"] == "dense"
+        assert len(gf) > 0 and gf == gj
+        assert jf.get("Mid", 0) == 0 and jf.get("Win", 0) == 0
+
+    def test_large_batches_chunked_bit_identical(self):
+        # many-row junction batches exercise the chunked ingest path
+        rng = np.random.default_rng(21)
+        sends = []
+        for b in range(6):
+            rows = [[int(rng.integers(0, 5)),
+                     float(np.float32(rng.uniform(0, 30))),
+                     int(rng.integers(1, 100))] for _ in range(64)]
+            sends.append((rows, 1000 + 50 * b))
+
+        def run(fuse):
+            mgr = SiddhiManager()
+            try:
+                rt = mgr.create_siddhi_app_runtime(THREE_STAGE.format(
+                    tag="BF" if fuse else "BJ",
+                    fuse="@app:fuse" if fuse else "", faults=""))
+                got = []
+                rt.add_callback("Out", _collector(got))
+                rt.start()
+                h = rt.get_input_handler("SIn")
+                from siddhi_tpu.core.event import Event
+                for rows, ts in sends:
+                    h.send([Event(ts + i, list(r))
+                            for i, r in enumerate(rows)])
+                rt.shutdown()
+                return got
+            finally:
+                mgr.shutdown()
+
+        gf, gj = run(True), run(False)
+        assert len(gf) > 0 and gf == gj
+
+
+class TestFusedDispatchAccounting:
+    """One jitted program per batch cycle; intermediates stay in HBM."""
+
+    def test_one_jit_per_cycle_and_hop_counters(self):
+        n = 40
+        sends = _sends(n, 5)
+        mgr = SiddhiManager()
+        try:
+            rt = mgr.create_siddhi_app_runtime(THREE_STAGE.format(
+                tag="A", fuse="@app:fuse", faults=""))
+            rt.add_callback("Out", lambda e: None)
+            rt.start()
+            h = rt.get_input_handler("SIn")
+            for row, ts in sends:
+                h.send(list(row), timestamp=ts)
+            dr = rt.query_runtimes["q3"].device_runtime
+            st = dr.stats()
+            # the WHOLE 3-stage chain advances with ONE fused dispatch
+            # per batch cycle — not one per stage
+            assert st["engine"] == "fused" and st["stages"] == 3
+            assert st["step_invocations"] == n
+            assert st["fused_hops"] == 2 * n  # (stages - 1) per dispatch
+            assert rt.junctions["SIn"].dispatches == n
+            assert rt.junctions["Mid"].dispatches == 0
+            assert rt.junctions["Win"].dispatches == 0
+            rt.shutdown()
+        finally:
+            mgr.shutdown()
+
+    def test_no_intermediate_eventbatches(self, monkeypatch):
+        """The fused path must never materialize an EventBatch on an
+        intermediate stream — its columns live in HBM between stages."""
+        built = []
+        orig = EventBatch.__init__
+
+        def counting(self, stream_id, *a, **k):
+            built.append(stream_id)
+            orig(self, stream_id, *a, **k)
+
+        sends = _sends(50, 9)
+        monkeypatch.setattr(EventBatch, "__init__", counting)
+        _run_app(THREE_STAGE, True, sends, tag_extra="NB")
+        fused_built = list(built)
+        built.clear()
+        _run_app(THREE_STAGE, False, sends, tag_extra="NB")
+        junction_built = list(built)
+        assert "Mid" not in fused_built and "Win" not in fused_built
+        assert "Mid" in junction_built and "Win" in junction_built
+        assert len(fused_built) < len(junction_built)
+
+
+class TestFusedFaults:
+    pytestmark = pytest.mark.faults
+
+    def test_transient_ingest_emit_faults_bit_identical(self):
+        sends = _sends(80, 13)
+        ref, _, _, _ = _run_app(THREE_STAGE, True, sends, tag_extra="T0")
+        got, low, junc, st = _run_app(
+            THREE_STAGE, True, sends, tag_extra="T1",
+            faults="@app:faults(transfer.retry.scale='0.001', "
+                   "ingest.put='transient:count=3', "
+                   "emit.drain='transient:count=2') ")
+        assert low == {"q1": "fused", "q2": "fused", "q3": "fused"}
+        assert st["faults_injected"] >= 5
+        assert st["transfer_retries"] >= 3 and st["drains_recovered"] >= 2
+        assert junc.get("Mid", 0) == 0 and junc.get("Win", 0) == 0
+        assert got == ref
+
+    def test_crash_and_journal_replay(self):
+        """Checkpoint, crash mid-run, restore + journal replay on a
+        fresh runtime — bit-identical to a run that never crashed."""
+        sends = _sends(30, 17)
+        ref, _, _, _ = _run_app(THREE_STAGE, True, sends, tag_extra="C0")
+
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        try:
+            faults = "@app:faults(journal='256') "
+            app = THREE_STAGE.format(tag="FC1", fuse="@app:fuse",
+                                     faults=faults)
+            rt = mgr.create_siddhi_app_runtime(app)
+            got = []
+            rt.add_callback("Out", _collector(got))
+            rt.start()
+            h = rt.get_input_handler("SIn")
+            for j, (row, ts) in enumerate(sends):
+                if j == 10:
+                    rt.persist()
+                if j == 20:
+                    rt.app_context.fault_injector.configure(
+                        "ingest", "crash", count=1)
+                    with pytest.raises(SimulatedCrashError):
+                        h.send(list(row), timestamp=ts)
+                    rt.shutdown()
+                    rt = mgr.create_siddhi_app_runtime(app)
+                    rt.add_callback("Out", _collector(got))
+                    rt.start()
+                    # the crashed send WAS journaled: replay covers it
+                    assert rt.restore_last_revision() is not None
+                    h = rt.get_input_handler("SIn")
+                    continue
+                h.send(list(row), timestamp=ts)
+            assert rt.lowering() == {
+                "q1": "fused", "q2": "fused", "q3": "fused"}
+            rt.shutdown()
+        finally:
+            mgr.shutdown()
+        assert got == ref
+
+
+class TestFusedPersistence:
+    def test_persist_restore_forgets_post_persist_event(self):
+        """restore() rewinds the WHOLE chain's device state mid-window
+        (q2's accumulator is partially filled at the checkpoint)."""
+
+        def run(fuse):
+            mgr = SiddhiManager()
+            mgr.set_persistence_store(InMemoryPersistenceStore())
+            try:
+                rt = mgr.create_siddhi_app_runtime(THREE_STAGE.format(
+                    tag="PF" if fuse else "PJ",
+                    fuse="@app:fuse" if fuse else "", faults=""))
+                got = []
+                rt.add_callback("Out", _collector(got))
+                rt.start()
+                h = rt.get_input_handler("SIn")
+                sends = _sends(40, 19)
+                for row, ts in sends[:20]:
+                    h.send(list(row), timestamp=ts)
+                rt.persist()
+                # stray event lands in q2's window, then is rolled back
+                h.send([0, 29.0, 99], timestamp=5000)
+                rt.restore_last_revision()
+                for row, ts in sends[20:]:
+                    h.send(list(row), timestamp=ts)
+                rt.shutdown()
+                return got
+            finally:
+                mgr.shutdown()
+
+        gf, gj = run(True), run(False)
+        assert len(gf) > 0 and gf == gj
+
+
+class TestFusedFallback:
+    """Unfusable chains drop to junction dispatch with a counted,
+    readable reason — never silently."""
+
+    def _stats(self, app_text, out_streams=("Out",), sends=None):
+        mgr = SiddhiManager()
+        try:
+            rt = mgr.create_siddhi_app_runtime(app_text)
+            for s in out_streams:
+                rt.add_callback(s, lambda e: None)
+            rt.start()
+            if sends:
+                h = rt.get_input_handler("SIn")
+                for row, ts in sends:
+                    h.send(list(row), timestamp=ts)
+            low = rt.lowering()
+            st = rt.statistics()
+            rt.shutdown()
+            return low, st
+        finally:
+            mgr.shutdown()
+
+    def test_async_intermediate_falls_back(self):
+        APP = """
+@app:name('fba') @app:execution('tpu') @app:fuse @app:statistics('basic')
+define stream SIn (sym int, price float);
+@async(buffer.size='16')
+define stream Mid (sym int, price float);
+@info(name='q1') from SIn[price > 1.0] select sym, price insert into Mid;
+@info(name='q2') from Mid select sym, price insert into Out;
+"""
+        low, st = self._stats(APP)
+        assert "fused" not in low.values()
+        pre = "io.siddhi.SiddhiApps.fba.Siddhi.Queries."
+        assert st[pre + "q1.fusedFallbacks"] == 1
+        assert "@async" in st[pre + "q1.fusedFallbackReason"]
+
+    def test_table_hop_falls_back(self):
+        APP = """
+@app:name('fbt') @app:execution('tpu') @app:fuse @app:statistics('basic')
+define stream SIn (sym int, price float);
+define table T (sym int, price float);
+@info(name='q1') from SIn[price > 1.0] select sym, price insert into T;
+"""
+        low, st = self._stats(APP, out_streams=())
+        assert "fused" not in low.values()
+        pre = "io.siddhi.SiddhiApps.fbt.Siddhi.Queries."
+        assert st[pre + "q1.fusedFallbacks"] == 1
+        assert "table" in st[pre + "q1.fusedFallbackReason"]
+
+    def test_multi_consumer_intermediate_falls_back(self):
+        APP = """
+@app:name('fbm') @app:execution('tpu') @app:fuse @app:statistics('basic')
+define stream SIn (sym int, price float);
+define stream Mid (sym int, price float);
+@info(name='q1') from SIn[price > 1.0] select sym, price insert into Mid;
+@info(name='q2') from Mid select sym, price insert into Out;
+@info(name='q3') from Mid[price > 2.0] select sym, price insert into Out2;
+"""
+        low, st = self._stats(APP, out_streams=("Out", "Out2"))
+        assert "fused" not in low.values()
+        pre = "io.siddhi.SiddhiApps.fbm.Siddhi.Queries."
+        assert st[pre + "q1.fusedFallbacks"] == 1
+        assert "one consumer" in st[pre + "q1.fusedFallbackReason"]
+
+    def test_host_only_interior_stage_falls_back(self):
+        # a STRING intermediate attribute has no device-resident lane
+        APP = """
+@app:name('fbs') @app:execution('tpu') @app:fuse @app:statistics('basic')
+define stream SIn (sym string, price float);
+@info(name='q1') from SIn[price > 1.0] select sym, price insert into Mid;
+@info(name='q2') from Mid[price > 2.0] select sym, price insert into Out;
+"""
+        low, st = self._stats(APP)
+        assert "fused" not in low.values()
+        pre = "io.siddhi.SiddhiApps.fbs.Siddhi.Queries."
+        assert st[pre + "q1.fusedFallbacks"] >= 1
+        assert "lane" in st[pre + "q1.fusedFallbackReason"]
+
+    def test_unfusable_tail_truncates_chain_prefix_still_fuses(self):
+        """A group-by tail cannot fuse, but the q1→q2 prefix must still
+        lower — per-chain truncation, not all-or-nothing."""
+        APP = """
+@app:name('fbg') @app:playback @app:execution('tpu') @app:fuse
+@app:statistics('basic')
+define stream SIn (sym int, price float, vol int);
+define stream Mid (sym int, price float, vol int);
+define stream Win (sym int, total double);
+@info(name='q1') from SIn[price > 5.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid#window.length(4)
+select sym, sum(price) as total insert into Win;
+@info(name='q3') from Win select sym, sum(total) as s
+group by sym insert into Out;
+"""
+        low, st = self._stats(APP, sends=_sends(30, 23))
+        assert low["q1"] == "fused" and low["q2"] == "fused"
+        assert low["q3"] != "fused"
+        pre = "io.siddhi.SiddhiApps.fbg.Siddhi.Queries."
+        assert st[pre + "q3.fusedFallbacks"] >= 1
+        assert "group-by" in st[pre + "q3.fusedFallbackReason"]
+
+    def test_truncated_prefix_bit_identical(self):
+        APP = """
+@app:name('ftr{tag}') @app:playback @app:execution('tpu') {fuse}{faults}
+define stream SIn (sym int, price float, vol int);
+define stream Mid (sym int, price float, vol int);
+define stream Win (sym int, total double);
+@info(name='q1') from SIn[price > 5.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid#window.length(4)
+select sym, sum(price) as total insert into Win;
+@info(name='q3') from Win select sym, sum(total) as s
+group by sym insert into Out;
+"""
+        sends = _sends(45, 29)
+        gf, lf, _, _ = _run_app(APP, True, sends)
+        gj, _, _, _ = _run_app(APP, False, sends)
+        assert lf["q1"] == "fused" and lf["q2"] == "fused"
+        assert len(gf) > 0 and gf == gj
+
+    def test_fuse_requires_tpu_mode(self):
+        with pytest.raises(SiddhiAppCreationError, match="tpu"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@app:fuse define stream S (v double); "
+                "@info(name='q') from S select v insert into Out;")
