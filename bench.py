@@ -87,6 +87,18 @@ FUSE_STEPS = 12
 FUSE_WARMUP = 2
 FUSE_WINDOWS = 3
 
+# skew-aware hot-key routing measurement (core/hotkey_router.py): a
+# partitioned pattern under Zipf(1.2) keys run with @app:hotkeys vs
+# dense-only.  The dense engine serializes duplicate-key events into
+# collision rounds (one padded step dispatch per round — a heavy key at
+# ~18% of a 8k batch means ~1.5k sequential dispatches per cycle); the
+# router moves heavy keys onto ONE batched associative scan per cycle
+HK_KEYS = 4_096
+HK_BATCH = 8_192
+HK_STEPS = 8
+HK_WARMUP = 2
+HK_WINDOWS = 3
+
 # CPU-backend smoke fallback (device backend unreachable): reduced
 # sizes so the number exists in seconds, clearly labeled as NOT the
 # chip measurement
@@ -102,6 +114,8 @@ SMOKE_MUX_BATCH = 2_048
 SMOKE_MUX_STEPS = 4
 SMOKE_FUSE_BATCH = 2_048
 SMOKE_FUSE_STEPS = 5
+SMOKE_HK_BATCH = 1_024
+SMOKE_HK_STEPS = 3
 
 
 def pattern_query() -> str:
@@ -527,6 +541,101 @@ def bench_fused_pipeline(batch=FUSE_BATCH, steps=FUSE_STEPS,
     }
 
 
+def bench_hot_key(keys=HK_KEYS, batch=HK_BATCH, steps=HK_STEPS,
+                  warmup=HK_WARMUP, windows=HK_WINDOWS):
+    """Skew-aware hot-key routing: the same partitioned 2-node pattern
+    fed Zipf(1.2)-distributed keys, once under ``@app:hotkeys`` (heavy
+    keys promoted onto the batched associative-scan engine) and once
+    dense-only.  The skewed batch is the dense path's worst case —
+    duplicate-key events serialize into collision rounds, one padded
+    step dispatch each — while the router's scan path absorbs the whole
+    hot-key burst in ONE ``associative_scan`` per cycle.  Router
+    decision counters ride along so the report shows routing actually
+    engaged (promotions >= 1, routed_events > 0)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+    from siddhi_tpu.core.hotkey_router import HotKeyRouterRuntime
+
+    APP = ("@app:name('hkbench{tag}') @app:playback "
+           "@app:execution('tpu', instances='8') {hot}"
+           "define stream S (k long, u double, v double); "
+           "partition with (k of S) begin "
+           "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+           "select b.v as bv insert into Alerts; end;")
+    # promote at 10% of decayed traffic: the Zipf(1.2) head key carries
+    # ~18% of each batch, rank-2 ~8% — exactly one key promotes
+    HOT = "@app:hotkeys(k='8', promote='0.1', demote='0.04') "
+
+    rng = np.random.default_rng(23)
+
+    def mk(i):
+        ks = (rng.zipf(1.2, batch) - 1) % keys
+        u = rng.uniform(0.0, 20.0, batch)
+        v = rng.uniform(0.0, 20.0, batch)
+        ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+        return EventBatch("S", ["k", "u", "v"],
+                          {"k": ks.astype(np.int64), "u": u, "v": v}, ts)
+
+    bs = [mk(i) for i in range(warmup + steps)]
+
+    def run(hot):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(APP.format(
+                tag="H" if hot else "D", hot=HOT if hot else ""))
+            rows = [0]
+            rt.add_callback("Alerts", lambda evs: rows.__setitem__(
+                0, rows[0] + len(evs)))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for b in bs[:warmup]:
+                h.send_batch(b)
+            window_rates = []
+            for w in range(windows):
+                t_w = time.perf_counter()
+                for b in bs[warmup:]:
+                    # re-offset per window: timestamps stay monotone
+                    # when the same batches are replayed each window
+                    h.send_batch(EventBatch(
+                        b.stream_id, b.attribute_names, b.columns,
+                        b.timestamps + (w + 1) * 1_000_000, b.types))
+                for pr in rt.partitions.values():
+                    for qr in pr.dense_query_runtimes.values():
+                        qr.pattern_processor.drain()
+                window_rates.append(
+                    batch * steps / (time.perf_counter() - t_w))
+            counters = {}
+            if hot:
+                assert rt.lowering()["q"] == "hotkey", \
+                    "bench query failed to take the hotkey path"
+                for pr in rt.partitions.values():
+                    for qr in pr.dense_query_runtimes.values():
+                        pp = qr.pattern_processor
+                        assert isinstance(pp, HotKeyRouterRuntime)
+                        counters = pp.hot_metrics()
+            rt.shutdown()
+            return float(np.median(window_rates)), window_rates, \
+                counters, rows[0]
+        finally:
+            m.shutdown()
+
+    h_rate, h_windows, counters, h_rows = run(True)
+    d_rate, _d_windows, _, d_rows = run(False)
+    assert counters.get("hotkeyPromotions", 0) >= 1, \
+        f"no promotion under Zipf(1.2) skew: {counters}"
+    assert h_rows == d_rows, (
+        f"routed run emitted {h_rows} rows, dense-only {d_rows}")
+    out = {
+        "events_per_sec": h_rate,
+        "window_rates": [round(r, 1) for r in h_windows],
+        "dense_events_per_sec": d_rate,
+        "vs_dense": round(h_rate / d_rate, 3),
+        "matches": h_rows,
+    }
+    out.update(counters)
+    return out
+
+
 def bench_host_baseline():
     """Measured host-engine (ops/nfa.py) rate on the same partitioned
     pattern — the CPU reference side of the comparison."""
@@ -718,6 +827,16 @@ def main():
             out["cpu_smoke_junctionHops"] = fp["junctionHops"]
         except Exception as e:
             out["cpu_smoke_fused_pipeline_error"] = str(e)
+        try:
+            hk = bench_hot_key(keys=512, batch=SMOKE_HK_BATCH,
+                               steps=SMOKE_HK_STEPS, warmup=1, windows=2)
+            out["cpu_smoke_hot_key_events_per_sec"] = round(
+                hk["events_per_sec"], 1)
+            out["cpu_smoke_hot_key_vs_dense"] = hk["vs_dense"]
+            out["cpu_smoke_hotkeyPromotions"] = hk["hotkeyPromotions"]
+            out["cpu_smoke_hotkeyRoutedEvents"] = hk["hotkeyRoutedEvents"]
+        except Exception as e:
+            out["cpu_smoke_hot_key_error"] = str(e)
         print(json.dumps(out))
         return
     if not _probe_with_retry():
@@ -749,6 +868,13 @@ def main():
                 "cpu_smoke_fused_pipeline_events_per_sec"),
             "cpu_smoke_fused_vs_junction": smoke.get(
                 "cpu_smoke_fused_vs_junction"),
+            "hot_key_pattern_events_per_sec_per_chip": None,
+            "cpu_smoke_hot_key_events_per_sec": smoke.get(
+                "cpu_smoke_hot_key_events_per_sec"),
+            "cpu_smoke_hot_key_vs_dense": smoke.get(
+                "cpu_smoke_hot_key_vs_dense"),
+            "cpu_smoke_hotkeyPromotions": smoke.get(
+                "cpu_smoke_hotkeyPromotions"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
                 "kernel smoke + 8-virtual-device sharded-window smoke — "
@@ -760,6 +886,7 @@ def main():
     shwin = bench_sharded_window()
     mux = bench_multiplexed()
     fused = bench_fused_pipeline()
+    hotkey = bench_hot_key()
     host = bench_host_baseline()
     workload_rows = None
     if "--workloads" in sys.argv:
@@ -817,6 +944,13 @@ def main():
         "fused_pipeline_fusedHops": fused["fusedHops"],
         "fused_pipeline_junctionHops": fused["junctionHops"],
         "fused_pipeline_window_rates": fused["window_rates"],
+        "hot_key_pattern_events_per_sec_per_chip": round(
+            hotkey["events_per_sec"], 1),
+        "hot_key_vs_dense": hotkey["vs_dense"],
+        "hot_key_window_rates": hotkey["window_rates"],
+        "hot_key_hotkeyPromotions": hotkey["hotkeyPromotions"],
+        "hot_key_hotkeyDemotions": hotkey["hotkeyDemotions"],
+        "hot_key_hotkeyRoutedEvents": hotkey["hotkeyRoutedEvents"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

@@ -38,8 +38,18 @@ shapes ride the bank exactly:
   values are alone too hot for int32, the exact host path — before
   either lane could wrap.
 
-Other integer fields (bare counts without avg/stdDev, int min/max,
-last/set) keep the exact host numpy scatter ufuncs at native width.
+* INT "min"/"max" fields ride as single int32 rows at native width
+  (INT is exactly int32), with the int32 extrema as identities; the
+  flush merge reads them back as exact ints.  LONG min/max values can
+  exceed int32 and stay on the host path.
+
+* bare "count" fields (no avg/stdDev rewrite) ride exactly like the
+  avg/stdDev count denominators — float32 add rows guarded by
+  ``count_overflow_risk`` — so a count-only select no longer forces
+  the host reduction.
+
+Remaining integer shapes (LONG min/max, last/set) keep the exact host
+numpy scatter ufuncs at native width.
 
 Row layout: ``cap`` assignable rows + one dump row (index ``cap``) that
 absorbs padded lanes and out-of-order events, which take the host
@@ -55,6 +65,12 @@ import numpy as np
 from siddhi_tpu.query_api import AttrType
 
 _IDENTITY = {"sum": 0.0, "count": 0.0, "min": np.inf, "max": -np.inf}
+
+# int32 lane identities: 0 for the LONG-sum hi/lo pairs, the int32
+# extrema for INT min/max rows (padded lanes leave the dump row intact)
+_I32_IDENTITY = {"sum": 0, "count": 0,
+                 "min": np.iinfo(np.int32).max,
+                 "max": np.iinfo(np.int32).min}
 
 # float32 holds consecutive integers exactly up to 2**24: the largest
 # count any bank row may accumulate between flushes
@@ -97,6 +113,10 @@ class DeviceBucketBank:
                 self._field_lanes.append((len(self._lanes),
                                           len(self._lanes) + 1))
                 self._lanes += [("sum", "i32"), ("sum", "i32")]
+            elif f.op in ("min", "max") and f.type == AttrType.INT:
+                # INT extrema fit int32 natively — exact, no pair split
+                self._field_lanes.append((len(self._lanes),))
+                self._lanes.append((f.op, "i32"))
             else:
                 self._field_lanes.append((len(self._lanes),))
                 self._lanes.append((f.op, "f32"))
@@ -163,7 +183,8 @@ class DeviceBucketBank:
         import jax.numpy as jnp
 
         self._arrays = [
-            jnp.zeros(self.cap + 1, dtype=jnp.int32) if kind == "i32"
+            jnp.full(self.cap + 1, _I32_IDENTITY[op], dtype=jnp.int32)
+            if kind == "i32"
             else jnp.full(self.cap + 1, _IDENTITY[op], dtype=jnp.float32)
             for op, kind in self._lanes
         ]
@@ -230,6 +251,11 @@ class DeviceBucketBank:
                 vals += [jnp.asarray(hi), jnp.asarray(lo)]
                 self._long_hi_used[name] = (
                     self._long_hi_used.get(name, 0) + self._hi_bound(v, n))
+            elif self._lanes[lanes[0]][1] == "i32":
+                # single int32 lane (INT min/max): native-width exact
+                col = np.full(n_pad, _I32_IDENTITY[op], dtype=np.int32)
+                col[:n] = fvals[name].astype(np.int32)
+                vals.append(jnp.asarray(col))
             else:
                 col = np.full(n_pad, _IDENTITY[op], dtype=np.float32)
                 col[:n] = fvals[name].astype(np.float32)
@@ -261,6 +287,8 @@ class DeviceBucketBank:
                     values[name] = (
                         int(host[lanes[0]][row]) * (_LONG_LO_MAX + 1)
                         + int(host[lanes[1]][row]))
+                elif self._lanes[lanes[0]][1] == "i32":
+                    values[name] = int(host[lanes[0]][row])
                 else:
                     values[name] = float(host[lanes[0]][row])
             out[key] = values

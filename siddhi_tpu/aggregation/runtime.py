@@ -435,6 +435,7 @@ class AggregationRuntime:
                 if (f.op in ("sum", "min", "max")
                     and f.type in (AttrType.FLOAT, AttrType.DOUBLE))
                 or (f.op == "sum" and f.type == AttrType.LONG)
+                or (f.op in ("min", "max") and f.type == AttrType.INT)
             ]
             # avg(x) over a numeric argument rewrites to _SUM/_COUNT
             # and stdDev(x) to _SUM/_SUMSQ/_COUNT (the sumsq row is a
@@ -445,14 +446,12 @@ class AggregationRuntime:
             # the host reduction entirely.  Count rows are float32 on
             # the device — exact below 2**24, enforced by the overflow
             # barrier in _bank_ingest — and cast back to exact ints at
-            # flush merge.  Without an avg/stdDev, count keeps the
-            # exact host path.
-            if (rw.saw_avg or rw.saw_stddev) and any(
-                f.op == "sum" for f in bank_fields
-            ):
-                bank_fields += [
-                    f for f in self.base_fields if f.op == "count"
-                ]
+            # flush merge.  Bare counts (no avg/stdDev) ride the same
+            # float32 add rows under the same barrier, so count-only
+            # selects skip the host reduction too.
+            bank_fields += [
+                f for f in self.base_fields if f.op == "count"
+            ]
             if bank_fields:
                 from siddhi_tpu.aggregation.device_bank import (
                     DeviceBucketBank,

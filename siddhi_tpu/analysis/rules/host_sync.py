@@ -38,6 +38,8 @@ SCANNED = (
     "siddhi_tpu/parallel/mesh.py",
     "siddhi_tpu/ops/fused_graph.py",
     "siddhi_tpu/core/fused_graph.py",
+    "siddhi_tpu/ops/hotkey_scan.py",
+    "siddhi_tpu/core/hotkey_router.py",
 )
 
 MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",

@@ -128,6 +128,18 @@ class SiddhiAppContext:
         # Off by default; ineligible chains fall back to the junction
         # path with counted reasons.
         self.fuse = False
+        # @app:hotkeys(k='8', promote='0.25', demote='0.10'): skew-aware
+        # hot-key routing (core/hotkey_router.py) — partitioned dense
+        # pattern queries watch the junction's key histogram with a
+        # space-saving sketch and route keys whose decayed traffic share
+        # crosses `promote` onto the batched associative-scan engine
+        # (k slots); they return to the dense path below `demote`
+        # (hysteresis: demote < promote or thrash).  Off by default;
+        # ineligible queries fall back with counted reasons.
+        self.hotkeys = False
+        self.hotkey_k = 8
+        self.hotkey_promote = 0.25
+        self.hotkey_demote = 0.10
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -621,6 +621,10 @@ class DensePatternRuntime:
         )
         if keys is not None:
             mb.aux["partition_keys"] = [keys[int(i)] for i in ev_idx]
+        # original-batch positions of the completing events: the hot-key
+        # router splits each cycle into cold/hot sub-batches, and
+        # consumers that need the interleaved order re-sort on these
+        mb.aux["event_indices"] = ev_idx
         if now is not None:
             # the clock sampled when this batch was processed: deferred
             # drains replay time-based rate limiters exactly (the

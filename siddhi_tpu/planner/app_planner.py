@@ -194,6 +194,45 @@ class AppPlanner:
                     f"@app:fuse('{v}'): expected 'true' or 'false'")
             self.app_context.fuse = v == "true"
 
+        # @app:hotkeys(k='8', promote='0.25', demote='0.10'): skew-aware
+        # hot-key routing — partitioned dense patterns promote heavy
+        # partition keys onto the batched associative-scan engine
+        # (planner/hotkeys.py); ineligible queries stay dense with
+        # counted hotkeyFallbackReasons.
+        hk_ann = find_annotation(siddhi_app.annotations, "app:hotkeys")
+        if hk_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:hotkeys needs @app:execution('tpu')")
+            self.app_context.hotkeys = True
+            k = hk_ann.element("k") or hk_ann.element()
+            if k:
+                try:
+                    nk = int(k)
+                except ValueError:
+                    nk = -1
+                if nk < 1 or nk > 256:
+                    raise SiddhiAppCreationError(
+                        f"@app:hotkeys: k='{k}' must be an integer in "
+                        "1..256 (scan slots per query)")
+                self.app_context.hotkey_k = nk
+            pr = hk_ann.element("promote")
+            dm = hk_ann.element("demote")
+            try:
+                promote = float(pr) if pr else self.app_context.hotkey_promote
+                demote = float(dm) if dm else self.app_context.hotkey_demote
+            except ValueError:
+                raise SiddhiAppCreationError(
+                    f"@app:hotkeys: promote='{pr}'/demote='{dm}' must be "
+                    "fractions of total traffic")
+            if not (0.0 < promote <= 1.0) or not (0.0 <= demote < promote):
+                raise SiddhiAppCreationError(
+                    f"@app:hotkeys: need 0 <= demote < promote <= 1 "
+                    f"(got promote={promote}, demote={demote}) — the "
+                    "hysteresis band prevents promote/demote thrash")
+            self.app_context.hotkey_promote = promote
+            self.app_context.hotkey_demote = demote
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")

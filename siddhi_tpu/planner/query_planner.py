@@ -534,7 +534,9 @@ class QueryPlanner:
                 "need per-key limiters — host instances used")
 
         sel = query.selector
-        if sel.group_by or sel.having is not None or self._has_aggregators(sel):
+        aggregating = bool(sel.group_by) or sel.having is not None \
+            or self._has_aggregators(sel)
+        if aggregating:
             # aggregating-selector form: the dense engine emits the RAW
             # captured columns (keyed exactly like the host pattern
             # scope, e.g. "e1.amount") and the ordinary host
@@ -612,6 +614,18 @@ class QueryPlanner:
             # idle-key purges must also drop the shared selector's
             # per-key aggregation state (host: the instance dies whole)
             runtime.on_purge_keys = selector.drop_partition_keys
+        # @app:hotkeys: wrap eligible partitioned passthrough patterns
+        # in the skew router (heavy keys ride the associative scan,
+        # cold keys stay dense).  Mesh-sharded and aggregating forms
+        # stay dense: the router's state handoff assumes single-device
+        # rows and final-node-only selects.
+        if (self.app.app_context.hotkeys and partitioned
+                and key_fn is None and mesh is None and not aggregating):
+            from siddhi_tpu.planner.hotkeys import try_wrap_hotkey
+
+            wrapped = try_wrap_hotkey(self.app, st, runtime, name)
+            if wrapped is not None:
+                runtime = wrapped
         qr.pattern_processor = runtime
         if subscribe:
             for sk in engine.stream_keys:
@@ -634,7 +648,7 @@ class QueryPlanner:
             # scheduler arming)
             qr._dense_timer_task = runtime
             self.app.scheduler.register_task(runtime)
-        qr.lowered_to = "dense"
+        qr.lowered_to = getattr(runtime, "lowered_to", "dense")
         return qr
 
     # -- single stream ------------------------------------------------------

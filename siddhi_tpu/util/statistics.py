@@ -188,6 +188,13 @@ class StatisticsManager:
         # planner so the downgrade is never silent
         self.fused_fallbacks: Dict[str, int] = {}
         self.fused_fallback_reasons: Dict[str, str] = {}
+        # queries under @app:hotkeys that stayed on the plain dense
+        # path (outside the scan class): count + last reason per query;
+        # and the live routers that DID land, read each report for
+        # their promotion/demotion/routed-event decision counters
+        self.hotkey_fallbacks: Dict[str, int] = {}
+        self.hotkey_fallback_reasons: Dict[str, str] = {}
+        self.hotkey_routers: Dict[str, object] = {}
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -239,6 +246,19 @@ class StatisticsManager:
             self.fused_fallbacks.get(qname, 0) + 1)
         self.fused_fallback_reasons[qname] = reason
 
+    def record_hotkey_fallback(self, qname: str, reason: str):
+        """A query under @app:hotkeys is running plain dense routing;
+        counted per query with the last reason kept."""
+        self.hotkey_fallbacks[qname] = (
+            self.hotkey_fallbacks.get(qname, 0) + 1)
+        self.hotkey_fallback_reasons[qname] = reason
+
+    def register_hotkey_router(self, qname: str, router):
+        """A live HotKeyRouterRuntime; its ``hot_metrics()`` gauges
+        (promotions/demotions/routed events/active keys) join the
+        feed."""
+        self.hotkey_routers[qname] = router
+
     def record_multiplex_placement(self, qname: str, fingerprint: str,
                                    occupied: int):
         """A query seated in a shared multiplex group."""
@@ -287,6 +307,13 @@ class StatisticsManager:
             out[self._metric("Queries", qname, "fusedFallbacks")] = n
             out[self._metric("Queries", qname, "fusedFallbackReason")] = (
                 self.fused_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.hotkey_fallbacks.items()):
+            out[self._metric("Queries", qname, "hotkeyFallbacks")] = n
+            out[self._metric("Queries", qname, "hotkeyFallbackReason")] = (
+                self.hotkey_fallback_reasons.get(qname, ""))
+        for qname, router in list(self.hotkey_routers.items()):
+            for metric, v in router.hot_metrics().items():
+                out[self._metric("Queries", qname, metric)] = v
         return out
 
     def reset(self):

@@ -280,6 +280,121 @@ class TestStdDevDeviceBank:
             assert b[1] == pytest.approx(a[1], abs=5e-3, rel=1e-3), (a, b)
 
 
+class TestIntMinMaxDeviceBank:
+    """min/max over an INT argument ride the device bucket bank as
+    single int32 rows at native width (INT is exactly int32, identities
+    the int32 extrema) — exact, no pair split; a count in the same
+    select banks as a float32 add row, so this ingest shape performs no
+    host reduction at all."""
+
+    APP = (
+        "{mode}@app:playback "
+        "define stream S (sym string, v int, ts long); "
+        "define aggregation A from S select sym, min(v) as lo, "
+        "max(v) as hi, count() as n group by sym "
+        "aggregate by ts every sec...min;"
+    )
+
+    def _run(self, manager, mode, vals, probe=False):
+        import numpy as np
+
+        rt = manager.create_siddhi_app_runtime(self.APP.format(mode=mode))
+        rt.start()
+        agg = rt.aggregations["A"]
+        if probe:
+            assert agg._bank is not None
+            # every base field banks: INT extrema + the bare count
+            assert set(agg._bank.names) == {f.name for f in agg.base_fields}
+            assert any(kind == "i32" for _op, kind in agg._bank._lanes)
+        rng = np.random.default_rng(17)
+        n = len(vals)
+        ts = np.sort(BASE + rng.integers(0, 5_000, n)).astype(np.int64)
+        h = rt.get_input_handler("S")
+        for j in range(n):
+            h.send([f"s{int(rng.integers(0, 6))}", int(vals[j]), int(ts[j])])
+        if probe:
+            # the bank must actually absorb the batches on device
+            assert agg._bank.scatters > 0
+        out = rt.query(
+            f"from A within {BASE - 1000}, {BASE + 100_000} per 'seconds' "
+            "select sym, lo, hi, n;")
+        rt.shutdown()
+        return sorted([list(e.data) for e in out], key=lambda r: r[0])
+
+    def _diff(self, manager, vals):
+        host = self._run(manager, "", vals)
+        m2 = SiddhiManager()
+        try:
+            dev = self._run(m2, "@app:execution('tpu') ", vals, probe=True)
+        finally:
+            m2.shutdown()
+        assert len(host) == len(dev) > 0
+        # int32 rows and the count barrier are exact — no tolerance
+        assert host == dev, (host[:4], dev[:4])
+
+    def test_int_min_max_exact_on_bank_path(self, manager):
+        import numpy as np
+
+        rng = np.random.default_rng(19)
+        self._diff(manager, rng.integers(-100_000, 100_000, 500))
+
+    def test_int_extrema_at_type_bounds_exact(self, manager):
+        import numpy as np
+
+        # values spanning the full int32 range hit the identity edges
+        rng = np.random.default_rng(23)
+        vals = rng.integers(-(2**31), 2**31 - 1, 300)
+        vals[0], vals[1] = -(2**31), 2**31 - 1
+        self._diff(manager, vals)
+
+
+class TestCountOnlyDeviceBank:
+    """A count-only select (no avg/stdDev rewrite) banks its bare count
+    as a float32 add row under the 2**24 overflow barrier — previously
+    it forced the host reduction every batch."""
+
+    APP = (
+        "{mode}@app:playback "
+        "define stream S (sym string, v int, ts long); "
+        "define aggregation A from S select sym, count() as n "
+        "group by sym aggregate by ts every sec...min;"
+    )
+
+    def _run(self, manager, mode, probe=False):
+        import numpy as np
+
+        rt = manager.create_siddhi_app_runtime(self.APP.format(mode=mode))
+        rt.start()
+        agg = rt.aggregations["A"]
+        if probe:
+            assert agg._bank is not None
+            assert [f.op for f in agg._bank.fields] == ["count"]
+        rng = np.random.default_rng(29)
+        n = 400
+        ts = np.sort(BASE + rng.integers(0, 5_000, n)).astype(np.int64)
+        h = rt.get_input_handler("S")
+        for j in range(n):
+            h.send([f"s{int(rng.integers(0, 8))}",
+                    int(rng.integers(-100, 100)), int(ts[j])])
+        if probe:
+            assert agg._bank.scatters > 0
+        out = rt.query(
+            f"from A within {BASE - 1000}, {BASE + 100_000} per 'seconds' "
+            "select sym, n;")
+        rt.shutdown()
+        return sorted([list(e.data) for e in out], key=lambda r: r[0])
+
+    def test_count_only_banks_and_matches_host(self, manager):
+        host = self._run(manager, "")
+        m2 = SiddhiManager()
+        try:
+            dev = self._run(m2, "@app:execution('tpu') ", probe=True)
+        finally:
+            m2.shutdown()
+        assert len(host) == len(dev) > 0
+        assert host == dev, (host[:4], dev[:4])
+
+
 class TestLongSumDeviceBank:
     """sum(intcol) widens INT→LONG; in tpu mode LONG sums ride the
     device bucket bank as hi/lo int32 pair rows (hi += v >> 16,

@@ -182,6 +182,62 @@ def test_partitioned_fuzz_matches_host():
     assert norm(dense) == norm(host)
 
 
+def gen_skewed_stream(seed, n=360, hot_key=7, dt_max=60):
+    """Three skew phases: the hot key takes ~85% of traffic, then the
+    stream goes uniform (the router must demote and hand pending state
+    back), then the same key heats up again (re-promotion)."""
+    rng = np.random.default_rng(seed)
+    out, t = [], 1000
+    for i in range(n):
+        t += int(rng.integers(1, dt_max))
+        phase = (3 * i) // n
+        hot = phase != 1 and rng.random() < 0.85
+        k = hot_key if hot else int(rng.integers(0, 30))
+        out.append(([int(k), float(round(rng.uniform(0, 20), 1)),
+                     float(round(rng.uniform(0, 20), 1))], int(t)))
+    return out
+
+
+@pytest.mark.parametrize("seed", [51, 52, 53])
+def test_hotkey_skewed_fuzz_matches_host(seed):
+    """Skewed keys crossing the promote/demote thresholds mid-run under
+    @app:hotkeys: routing (dense rows <-> scan slots, exact state
+    handoff both ways) must never alter detections."""
+    from siddhi_tpu.core.hotkey_router import HotKeyRouterRuntime
+
+    app = ("partition with (k of S) begin "
+           "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+           "select b.v as bv insert into Alerts; "
+           "end;")
+    sends = gen_skewed_stream(seed)
+    host, _, _ = run(app, sends, mode_tpu=False)
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback @app:execution('tpu', instances='16') "
+            "@app:hotkeys(k='4', promote='0.3', demote='0.1') "
+            + DEFINE + app)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
+        router = None
+        for pr in rt.partitions.values():
+            for qr in pr.dense_query_runtimes.values():
+                router = qr.pattern_processor
+        assert isinstance(router, HotKeyRouterRuntime), "did not wrap"
+        hot = router.hot_metrics()
+        rt.shutdown()
+    finally:
+        m.shutdown()
+    # the phased skew must actually exercise both decision edges
+    assert hot["hotkeyPromotions"] >= 1, hot
+    assert hot["hotkeyDemotions"] >= 1, hot
+    assert norm(got) == norm(host)
+
+
 def test_sharded_fuzz_matches_host():
     app = ("partition with (k of S) begin "
            "@info(name='q') from every a=S[v > 8.0] -> b=S[v > a.v] "

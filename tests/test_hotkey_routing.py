@@ -1,0 +1,377 @@
+"""Skew-aware hot-key routing differential suite.
+
+``@app:hotkeys`` (planner/hotkeys.py) wraps eligible partitioned dense
+pattern queries in a ``HotKeyRouterRuntime`` (core/hotkey_router.py): a
+space-saving sketch watches the junction's key histogram per batch
+cycle, keys whose decayed traffic share crosses the promote threshold
+move onto the batched associative-scan engine (ops/hotkey_scan.py),
+and cool back to the dense path below the demote threshold — with
+EXACT pending-state handoff at each boundary.
+
+The contract under test is bit-identical detections versus the host
+engine across chain shapes, with the router's decision counters
+evidencing that promotion actually happened (a silent dense fallback
+cannot hollow the suite out) — including promotion/demotion
+mid-stream, under transient ingest/emit faults, crash + journal
+replay, and persist/restore — plus a counted, readable fallback
+reason for every ineligible shape.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import SimulatedCrashError
+from siddhi_tpu.core.hotkey_router import (
+    HotKeyRouterRuntime,
+    SpaceSavingSketch,
+)
+from siddhi_tpu.util.persistence import InMemoryPersistenceStore
+
+DEFINE = "define stream S (k long, u double, v double); "
+TPU = "@app:execution('tpu', instances='16') "
+HOTKEYS = "@app:hotkeys(k='4', promote='0.3', demote='0.1') "
+
+
+def wrap(q):
+    return f"partition with (k of S) begin {q} end;"
+
+
+# eligible class: every-headed linear chains, capture-free boolean
+# filters, selects over final-node attributes only, no within
+SHAPES = {
+    "pair": (
+        "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+        "select b.v as bv insert into Alerts;"),
+    "triple": (
+        "@info(name='q') from every a=S[v > 4.0] -> b=S[u > 6.0] "
+        "-> c=S[v > 10.0] "
+        "select c.u as cu, c.v as cv insert into Alerts;"),
+    "quad_two_filters": (
+        "@info(name='q') from every a=S[u > 3.0 and v > 3.0] "
+        "-> b=S[v > 6.0] -> c=S[u > 9.0] -> d=S[v > 12.0] "
+        "select d.u as du, d.v as dv insert into Alerts;"),
+}
+
+
+def gen(seed, phases, dt_max=40):
+    """Event stream in phases of (n, hot_key, p_hot): each phase sends
+    ``n`` events, each going to ``hot_key`` with probability ``p_hot``
+    and to a uniform key in 0..29 otherwise."""
+    rng = np.random.default_rng(seed)
+    out, t = [], 1000
+    for n, hot_key, p_hot in phases:
+        for _ in range(n):
+            t += int(rng.integers(1, dt_max))
+            k = (int(hot_key) if hot_key is not None
+                 and rng.random() < p_hot else int(rng.integers(0, 30)))
+            out.append(([k, round(float(rng.uniform(0, 20)), 1),
+                         round(float(rng.uniform(0, 20)), 1)], t))
+    return out
+
+
+def norm(rows):
+    """DOUBLE attrs ride float32 device lanes (documented precision
+    subset): one-decimal inputs are exact at 4dp."""
+    return [[round(v, 4) if isinstance(v, float) else v for v in r]
+            for r in rows]
+
+
+def run(app, sends, header, mgr=None):
+    own = mgr is None
+    if own:
+        mgr = SiddhiManager()
+    try:
+        rt = mgr.create_siddhi_app_runtime(header + DEFINE + app)
+        got = []
+        rt.add_callback("Alerts",
+                        lambda evs: got.extend(list(e.data) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(list(row), timestamp=ts)
+        router = None
+        for pr in rt.partitions.values():
+            for qr in getattr(pr, "dense_query_runtimes", {}).values():
+                router = getattr(qr, "pattern_processor", None)
+        low = rt.lowering()
+        hot = (router.hot_metrics()
+               if isinstance(router, HotKeyRouterRuntime) else {})
+        fi = rt.app_context.fault_injector
+        fstats = fi.stats.as_dict() if fi else {}
+        rt.shutdown()
+        return got, router, low, hot, fstats
+    finally:
+        if own:
+            mgr.shutdown()
+
+
+SKEWED = [(400, 7, 0.8)]  # one hot key at 80% of traffic
+
+
+class TestHotKeyDifferential:
+    """Routed detections == host detections, per chain shape, with the
+    promotion counters proving the scan path actually engaged."""
+
+    @pytest.mark.parametrize("shape", sorted(SHAPES))
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_shape_matches_host(self, shape, seed):
+        sends = gen(seed, SKEWED)
+        host, _, _, _, _ = run(wrap(SHAPES[shape]), sends, "@app:playback ")
+        got, router, low, hot, _ = run(
+            wrap(SHAPES[shape]), sends, "@app:playback " + TPU + HOTKEYS)
+        assert isinstance(router, HotKeyRouterRuntime), "did not wrap"
+        assert low["q"] == "hotkey"
+        assert hot["hotkeyPromotions"] >= 1, hot
+        assert hot["hotkeyRoutedEvents"] > 0, hot
+        assert norm(got) == norm(host), (
+            f"{shape}/{seed}: {len(got)} routed vs {len(host)} host rows")
+
+    @pytest.mark.parametrize("seed", [11, 12])
+    def test_promote_demote_midstream(self, seed):
+        """The hot key cools mid-run: its pending chains hand back to
+        the dense row exactly (detections still identical), and both
+        decision counters advance."""
+        phases = [(350, 7, 0.85), (350, None, 0.0)]
+        sends = gen(seed, phases)
+        app = wrap(SHAPES["pair"])
+        host, _, _, _, _ = run(app, sends, "@app:playback ")
+        got, router, _, hot, _ = run(
+            app, sends, "@app:playback " + TPU + HOTKEYS)
+        assert hot["hotkeyPromotions"] >= 1, hot
+        assert hot["hotkeyDemotions"] >= 1, hot
+        assert norm(got) == norm(host)
+
+    def test_rehot_after_demotion(self):
+        """hot -> cold -> hot again: the same key re-promotes."""
+        phases = [(300, 7, 0.85), (250, None, 0.0), (300, 7, 0.85)]
+        sends = gen(21, phases)
+        app = wrap(SHAPES["pair"])
+        host, _, _, _, _ = run(app, sends, "@app:playback ")
+        got, _, _, hot, _ = run(app, sends, "@app:playback " + TPU + HOTKEYS)
+        assert hot["hotkeyPromotions"] >= 2, hot
+        assert hot["hotkeyDemotions"] >= 1, hot
+        assert norm(got) == norm(host)
+
+    def test_multiple_hot_keys(self):
+        """Two heavy keys share the slot axis of one batched scan."""
+        rng = np.random.default_rng(31)
+        sends, t = [], 1000
+        for _ in range(500):
+            t += int(rng.integers(1, 40))
+            r = rng.random()
+            k = 7 if r < 0.4 else (13 if r < 0.8 else int(rng.integers(0, 30)))
+            sends.append(([k, round(float(rng.uniform(0, 20)), 1),
+                           round(float(rng.uniform(0, 20)), 1)], t))
+        app = wrap(SHAPES["triple"])
+        host, _, _, _, _ = run(app, sends, "@app:playback ")
+        got, router, _, hot, _ = run(
+            app, sends, "@app:playback " + TPU + HOTKEYS)
+        assert hot["hotkeyPromotions"] >= 2, hot
+        assert hot["hotkeyActiveKeys"] >= 2, hot
+        assert norm(got) == norm(host)
+
+
+class TestHotKeyFaults:
+    pytestmark = pytest.mark.faults
+
+    def test_transient_faults_bit_identical(self):
+        sends = gen(41, SKEWED)
+        app = wrap(SHAPES["pair"])
+        ref, _, _, _, _ = run(app, sends, "@app:playback " + TPU + HOTKEYS)
+        faults = ("@app:faults(transfer.retry.scale='0.001', "
+                  "ingest.put='transient:count=3', "
+                  "emit.drain='transient:count=2') ")
+        got, _, low, hot, fstats = run(
+            app, sends, "@app:playback " + TPU + HOTKEYS + faults)
+        assert low["q"] == "hotkey"
+        assert hot["hotkeyPromotions"] >= 1
+        assert fstats["faults_injected"] >= 5
+        assert fstats["transfer_retries"] >= 3
+        assert norm(got) == norm(ref)
+
+    def test_crash_and_journal_replay(self):
+        """Checkpoint, crash mid-run (after the hot key promoted),
+        restore + journal replay on a fresh runtime — identical to a
+        run that never crashed.  The snapshot demotes every hot key, so
+        the persisted tree is a plain dense snapshot; the rebuilt
+        router re-promotes deterministically from the replayed skew."""
+        sends = gen(51, SKEWED)
+        app = wrap(SHAPES["pair"])
+        ref, _, _, _, _ = run(app, sends, "@app:playback " + TPU + HOTKEYS)
+
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        try:
+            header = ("@app:name('hkc') @app:playback " + TPU + HOTKEYS
+                      + "@app:faults(journal='512') ")
+            rt = mgr.create_siddhi_app_runtime(header + DEFINE + app)
+            got = []
+            rt.add_callback(
+                "Alerts", lambda evs: got.extend(list(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for j, (row, ts) in enumerate(sends):
+                if j == 150:
+                    rt.persist()
+                if j == 250:
+                    rt.app_context.fault_injector.configure(
+                        "ingest", "crash", count=1)
+                    with pytest.raises(SimulatedCrashError):
+                        h.send(list(row), timestamp=ts)
+                    rt.shutdown()
+                    rt = mgr.create_siddhi_app_runtime(header + DEFINE + app)
+                    rt.add_callback(
+                        "Alerts",
+                        lambda evs: got.extend(list(e.data) for e in evs))
+                    rt.start()
+                    assert rt.restore_last_revision() is not None
+                    h = rt.get_input_handler("S")
+                    continue
+                h.send(list(row), timestamp=ts)
+            assert rt.lowering()["q"] == "hotkey"
+            rt.shutdown()
+        finally:
+            mgr.shutdown()
+        assert norm(got) == norm(ref)
+
+
+class TestHotKeyPersistence:
+    def test_persist_restore_forgets_post_persist_event(self):
+        """restore() rewinds a PROMOTED key's pending chains: the
+        checkpoint demotes them into the dense snapshot, a stray
+        post-persist event is rolled back, and the continued run
+        matches the plain dense runtime under the same sequence."""
+
+        def go(header):
+            mgr = SiddhiManager()
+            mgr.set_persistence_store(InMemoryPersistenceStore())
+            try:
+                rt = mgr.create_siddhi_app_runtime(
+                    header + DEFINE + wrap(SHAPES["pair"]))
+                got = []
+                rt.add_callback(
+                    "Alerts",
+                    lambda evs: got.extend(list(e.data) for e in evs))
+                rt.start()
+                h = rt.get_input_handler("S")
+                sends = gen(61, SKEWED)
+                for row, ts in sends[:250]:
+                    h.send(list(row), timestamp=ts)
+                rt.persist()
+                # stray event arms new chains on the hot key, then is
+                # rolled back whole
+                h.send([7, 15.0, 15.0], timestamp=sends[249][1] + 5)
+                rt.restore_last_revision()
+                for row, ts in sends[250:]:
+                    h.send(list(row), timestamp=ts)
+                rt.shutdown()
+                return got
+            finally:
+                mgr.shutdown()
+
+        hot = go("@app:playback " + TPU + HOTKEYS)
+        dense = go("@app:playback " + TPU)
+        assert len(hot) > 0 and norm(hot) == norm(dense)
+
+
+INELIGIBLE = {
+    "within": (
+        "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+        "within 3 sec select b.v as bv insert into Alerts;"),
+    "sequence": (
+        "@info(name='q') from every a=S[v > 8.0], b=S[v > 12.0] "
+        "select b.v as bv insert into Alerts;"),
+    "capture_filter": (
+        "@info(name='q') from every a=S[v > 8.0] -> b=S[v > a.v] "
+        "select b.v as bv insert into Alerts;"),
+    "non_final_select": (
+        "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+        "select a.v as av, b.v as bv insert into Alerts;"),
+    "count_node": (
+        "@info(name='q') from every a=S[v > 8.0]<2> -> b=S[v > 12.0] "
+        "select b.v as bv insert into Alerts;"),
+    "absent_deadline": (
+        "@info(name='q') from every a=S[v > 12.0] -> "
+        "not S[v > 15.0] for 500 millisec "
+        "select a.v as av insert into Alerts;"),
+}
+
+
+class TestHotKeyFallback:
+    """Every ineligible shape stays dense with a counted, readable
+    reason on the statistics feed — never silently."""
+
+    @pytest.mark.parametrize("shape", sorted(INELIGIBLE))
+    def test_ineligible_falls_back_counted(self, shape):
+        mgr = SiddhiManager()
+        try:
+            rt = mgr.create_siddhi_app_runtime(
+                "@app:playback " + TPU + HOTKEYS + DEFINE
+                + wrap(INELIGIBLE[shape]))
+            rt.start()
+            assert rt.lowering()["q"] == "dense"
+            st = rt.statistics()
+            fb = {k: v for k, v in st.items() if "hotkeyFallback" in k}
+            counts = [v for k, v in fb.items() if k.endswith("Fallbacks")]
+            reasons = [v for k, v in fb.items()
+                       if k.endswith("FallbackReason")]
+            assert counts == [1], st
+            assert reasons and reasons[0], st
+            rt.shutdown()
+        finally:
+            mgr.shutdown()
+
+    def test_hotkeys_annotation_needs_tpu(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError,
+                               match="hotkeys needs"):
+                mgr.create_siddhi_app_runtime(
+                    "@app:hotkeys(k='4') " + DEFINE
+                    + wrap(SHAPES["pair"]))
+        finally:
+            mgr.shutdown()
+
+    def test_hysteresis_band_validated(self):
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        try:
+            with pytest.raises(SiddhiAppCreationError, match="demote"):
+                mgr.create_siddhi_app_runtime(
+                    TPU + "@app:hotkeys(promote='0.2', demote='0.4') "
+                    + DEFINE + wrap(SHAPES["pair"]))
+        finally:
+            mgr.shutdown()
+
+
+class TestSpaceSavingSketch:
+    def test_capacity_bound_and_heavy_hitters(self):
+        sk = SpaceSavingSketch(cap=8, decay=1.0)
+        rng = np.random.default_rng(5)
+        for _ in range(50):
+            ks = np.where(rng.random(64) < 0.6, 7,
+                          rng.integers(100, 1000, size=64))
+            u, c = np.unique(ks, return_counts=True)
+            sk.update(u, c)
+        assert len(sk.counts) <= 8
+        # the true heavy hitter dominates despite constant eviction
+        assert sk.heavy(0.3) and sk.heavy(0.3)[0] == 7
+        assert sk.share(7) > 0.5
+
+    def test_decay_forgets_old_traffic(self):
+        sk = SpaceSavingSketch(cap=8, decay=0.5)
+        sk.update(np.asarray([7]), np.asarray([1000]))
+        assert sk.share(7) > 0.9
+        for _ in range(30):
+            sk.update(np.asarray([1, 2]), np.asarray([50, 50]))
+        assert sk.share(7) < 0.05
+
+    def test_deterministic_tie_break(self):
+        a, b = SpaceSavingSketch(16), SpaceSavingSketch(16)
+        for sk in (a, b):
+            sk.update(np.asarray([3, 1, 2]), np.asarray([10, 10, 10]))
+        assert a.heavy(0.1) == b.heavy(0.1)
