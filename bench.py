@@ -725,6 +725,74 @@ def bench_cpu_smoke():
     return SMOKE_BATCH * SMOKE_STEPS / (time.perf_counter() - t0)
 
 
+def bench_persist_stall(keys=512, batch=8_192, fill_batches=24, rounds=5,
+                        window=100_000):
+    """Caller-visible persist() stall, sync vs async (durability/).
+
+    Sync persist pickles + checksums + fsyncs the whole state tree
+    inside the call; async captures cheap references/copies under the
+    barrier and hands serialization + store I/O to the checkpoint
+    writer thread.  Reports the median blocked-wall-time of each mode
+    over ``rounds`` checkpoints of the same windowed-aggregation state
+    (the async writer is flushed BETWEEN rounds, outside the timer, so
+    both modes persist identical state)."""
+    import shutil
+    import statistics as _stats
+    import tempfile
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+    from siddhi_tpu.durability import DurableFileSystemPersistenceStore
+
+    app = f"""
+    @app:name('persistbench') @app:playback
+    define stream S (k long, v double);
+    @info(name='q')
+    from S#window.length({window})
+    select k, sum(v) as total, count() as n group by k insert into Out;
+    """
+    d = tempfile.mkdtemp(prefix="siddhi-persist-bench-")
+    m = SiddhiManager()
+    try:
+        m.set_persistence_store(
+            DurableFileSystemPersistenceStore(d, revisions_to_keep=2))
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(17)
+        for i in range(fill_batches):
+            k = ((np.arange(batch, dtype=np.int64) * 524287 + i * batch)
+                 % keys)
+            v = rng.uniform(0.0, 100.0, batch)
+            ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+            h.send_batch(EventBatch("S", ["k", "v"], {"k": k, "v": v}, ts))
+        stalls = {"sync": [], "async": []}
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            rt.persist(mode="sync")
+            stalls["sync"].append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            rev = rt.persist(mode="async")
+            stalls["async"].append((time.perf_counter() - t0) * 1e3)
+            # flush OUTSIDE the timer: the stall metric is the time the
+            # batch loop is blocked, not the end-to-end commit latency
+            status = rt.wait_for_persist(rev, timeout=60)
+            if status != "committed":
+                raise RuntimeError(f"async persist did not commit: {status}")
+        rt.shutdown()
+        sync_ms = _stats.median(stalls["sync"])
+        async_ms = _stats.median(stalls["async"])
+        return {
+            "sync_ms": sync_ms,
+            "async_ms": async_ms,
+            "stall_ratio": async_ms / sync_ms if sync_ms else None,
+            "events_in_state": batch * fill_batches,
+        }
+    finally:
+        m.shutdown()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _cpu_smoke_subprocess(timeout_s: int = 300):
     """Run the --cpu-smoke suite in a fresh process pinned to the CPU
     backend (this process may have poisoned backend state from the
@@ -837,6 +905,16 @@ def main():
             out["cpu_smoke_hotkeyRoutedEvents"] = hk["hotkeyRoutedEvents"]
         except Exception as e:
             out["cpu_smoke_hot_key_error"] = str(e)
+        try:
+            ps = bench_persist_stall(keys=256, batch=4_096, fill_batches=8,
+                                     rounds=3)
+            out["cpu_smoke_persist_stall_ms_sync"] = round(ps["sync_ms"], 2)
+            out["cpu_smoke_persist_stall_ms_async"] = round(
+                ps["async_ms"], 2)
+            out["cpu_smoke_persist_stall_ratio"] = round(
+                ps["stall_ratio"], 3)
+        except Exception as e:
+            out["cpu_smoke_persist_stall_error"] = str(e)
         print(json.dumps(out))
         return
     if not _probe_with_retry():
@@ -875,6 +953,14 @@ def main():
                 "cpu_smoke_hot_key_vs_dense"),
             "cpu_smoke_hotkeyPromotions": smoke.get(
                 "cpu_smoke_hotkeyPromotions"),
+            "persist_stall_ms_sync": None,
+            "persist_stall_ms_async": None,
+            "cpu_smoke_persist_stall_ms_sync": smoke.get(
+                "cpu_smoke_persist_stall_ms_sync"),
+            "cpu_smoke_persist_stall_ms_async": smoke.get(
+                "cpu_smoke_persist_stall_ms_async"),
+            "cpu_smoke_persist_stall_ratio": smoke.get(
+                "cpu_smoke_persist_stall_ratio"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
                 "kernel smoke + 8-virtual-device sharded-window smoke — "
@@ -888,6 +974,7 @@ def main():
     fused = bench_fused_pipeline()
     hotkey = bench_hot_key()
     host = bench_host_baseline()
+    persist = bench_persist_stall()
     workload_rows = None
     if "--workloads" in sys.argv:
         # secondary matrix: the reference perf-harness workloads
@@ -951,6 +1038,10 @@ def main():
         "hot_key_hotkeyPromotions": hotkey["hotkeyPromotions"],
         "hot_key_hotkeyDemotions": hotkey["hotkeyDemotions"],
         "hot_key_hotkeyRoutedEvents": hotkey["hotkeyRoutedEvents"],
+        "persist_stall_ms_sync": round(persist["sync_ms"], 2),
+        "persist_stall_ms_async": round(persist["async_ms"], 2),
+        "persist_stall_ratio": round(persist["stall_ratio"], 3),
+        "persist_events_in_state": persist["events_in_state"],
         "host_measured_events_per_sec": round(host_rate, 1),
         "host_events_measured": host["events_measured"],
         "host_n_keys": host["n_keys"],

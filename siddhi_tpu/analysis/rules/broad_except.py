@@ -22,7 +22,8 @@ from typing import Iterable
 from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
 
-SCANNED_DIRS = ("siddhi_tpu/core/", "siddhi_tpu/transport/")
+SCANNED_DIRS = ("siddhi_tpu/core/", "siddhi_tpu/transport/",
+                "siddhi_tpu/durability/")
 
 BROAD = {"Exception", "BaseException"}
 

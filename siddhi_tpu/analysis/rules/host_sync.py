@@ -40,6 +40,13 @@ SCANNED = (
     "siddhi_tpu/core/fused_graph.py",
     "siddhi_tpu/ops/hotkey_scan.py",
     "siddhi_tpu/core/hotkey_router.py",
+    # durability: frozen device-array references may only materialize
+    # through util.faults.host_copy (the injector-aware D2H choke point)
+    # on the checkpoint writer thread — never inline under the barrier
+    "siddhi_tpu/durability/capture.py",
+    "siddhi_tpu/durability/writer.py",
+    "siddhi_tpu/durability/store.py",
+    "siddhi_tpu/durability/spill.py",
 )
 
 MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",

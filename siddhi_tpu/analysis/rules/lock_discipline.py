@@ -3,8 +3,10 @@
 The engine spawns real threads: the async junction worker
 (``core/stream.py``), the scheduler's wall-clock timer
 (``util/scheduler.py``), the statistics reporter, the playback
-heartbeat, the service listener, and the transport reconnect chain
-(``threading.Timer`` in ``transport/retry.py``).  All of them share
+heartbeat, the periodic-persist daemon (``core/app_runtime.py``),
+the checkpoint writer (``durability/writer.py``), the service
+listener, and the transport reconnect chain (``threading.Timer`` in
+``transport/retry.py``).  All of them share
 mutable engine state with the main batch path; the convention is that
 shared state is touched under the engine lock (``process_lock`` or a
 component lock), but nothing enforced it — PRs 1–4 added emit/ingest

@@ -160,6 +160,8 @@ class SiddhiAppRuntime:
         self.running = True
         if self.app_context.playback and self.app_context.playback_idle_ms > 0:
             self._start_playback_heartbeat()
+        if self.app_context.persist_interval_ms > 0:
+            self._start_persist_daemon()
 
     def _start_playback_heartbeat(self):
         """@app:playback(idle.time, increment): when no events arrive for
@@ -185,12 +187,60 @@ class SiddhiAppRuntime:
         self._playback_thread = t
         t.start()
 
+    def _start_persist_daemon(self):
+        """@app:persist(interval, mode): periodic checkpoint daemon — a
+        persist() every interval, in the annotation's mode (async by
+        default, so the loop only stalls for the in-barrier capture)."""
+        import logging
+        import threading
+
+        log = logging.getLogger("siddhi_tpu")
+        interval_s = self.app_context.persist_interval_ms / 1000.0
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.persist()
+                except Exception as e:
+                    log.error("app '%s': periodic persist failed: %s",
+                              self.name, e)
+                    for lst in self.app_context.exception_listeners:
+                        try:
+                            lst(e)
+                        except Exception:
+                            log.exception("exception listener failed")
+                except BaseException as e:
+                    # simulated crash on the daemon thread: record and
+                    # stop ticking — the harness kills the app elsewhere
+                    log.error("app '%s': persist daemon stopped: %s",
+                              self.name, e)
+                    break
+
+        t = threading.Thread(target=loop, name=f"persist-{self.name}",
+                             daemon=True)
+        self._persist_stop = stop
+        self._persist_thread = t
+        t.start()
+
     def shutdown(self):
+        stop = getattr(self, "_persist_stop", None)
+        if stop is not None:
+            stop.set()
+            self._persist_thread.join(timeout=2)
+            self._persist_stop = None
         stop = getattr(self, "_playback_stop", None)
         if stop is not None:
             stop.set()
             self._playback_thread.join(timeout=2)
             self._playback_stop = None
+        # in-flight async checkpoint reaches a terminal state, then the
+        # writer thread exits — shutdown must not strand a half-written
+        # revision mid-commit (the store's atomic-manifest protocol makes
+        # even a stranded one recoverable, but exiting clean is free)
+        w = self._durability_writer(create=False)
+        if w is not None:
+            w.shutdown()
         sm = self.app_context.statistics_manager
         if sm is not None:
             sm.stop_reporting()
@@ -430,39 +480,162 @@ class SiddhiAppRuntime:
             )
         return store
 
-    def persist(self) -> str:
+    def _durability_stats(self):
+        from siddhi_tpu.durability.writer import DurabilityStats
+
+        st = getattr(self, "_durab_stats", None)
+        if st is None:
+            st = self._durab_stats = DurabilityStats()
+            sm = self.app_context.statistics_manager
+            if sm is not None:
+                # ungated like the fault counters: checkpoint health must
+                # be visible even at statistics level 'off'
+                sm.durability_tracker(self.name, st)
+        return st
+
+    def _durability_writer(self, create: bool = True):
+        from siddhi_tpu.durability.writer import AsyncCheckpointWriter
+
+        w = getattr(self, "_ckpt_writer", None)
+        if w is None and create:
+            w = self._ckpt_writer = AsyncCheckpointWriter(
+                self.name, stats=self._durability_stats(),
+                fault_injector=self.app_context.fault_injector,
+                listeners=self.app_context.exception_listeners)
+        return w
+
+    def _flush_persists(self, timeout: float = 30.0):
+        """Barrier: any in-flight async checkpoint reaches a terminal
+        state before host code reads or replaces persisted state."""
+        w = self._durability_writer(create=False)
+        if w is not None:
+            w.wait(timeout=timeout)
+
+    def wait_for_persist(self, revision: Optional[str] = None,
+                         timeout: Optional[float] = None) -> Optional[str]:
+        """Block until an async persist finishes.  Returns the terminal
+        status ('committed' / 'failed' / 'superseded' / 'crashed' /
+        'idle') or None on timeout.  No-op ('idle') when nothing was
+        ever submitted."""
+        w = self._durability_writer(create=False)
+        if w is None:
+            return "idle"
+        return w.wait(revision=revision, timeout=timeout)
+
+    def _persist_write(self, store, revision: str, capture):
+        """Serialize + store + commit one captured checkpoint.  Runs on
+        the checkpoint writer thread (async) or inline (sync)."""
+        fi = self.app_context.fault_injector
+        st = self._durability_stats()
+        if hasattr(store, "save_tree"):
+            blobs = capture.materialize_blobs()
+            store.save_tree(self.name, revision, blobs,
+                            checker=fi.check if fi is not None else None,
+                            version=capture.version)
+            st.blobs_written += len(blobs)
+            st.bytes_written += sum(len(b) for _, _, b in blobs)
+        else:
+            data = capture.tree_bytes()
+            store.save(self.name, revision, data)
+            st.bytes_written += len(data)
+        if fi is not None:
+            # crash point: revision durable, journal mark not committed
+            fi.check("persist.post_manifest")
+        jr = self.app_context.input_journal
+        if jr is not None:
+            jr.commit_revision(revision)
+
+    def persist(self, mode: Optional[str] = None) -> str:
         """Snapshot all state and save it under a new revision
         (reference: SiddhiAppRuntimeImpl.persist:677).  Returns the
-        revision id."""
-        from siddhi_tpu.util.snapshot import SnapshotService
+        revision id.
 
+        ``mode='sync'`` (historical default) writes inside the call;
+        ``mode='async'`` (or ``@app:persist(mode='async')``) stalls the
+        batch loop only for the in-barrier capture and hands
+        serialization + store write to the checkpoint writer thread
+        (durability/writer.py) with single-in-flight coalescing
+        backpressure.  Incremental stores force the sync path (their
+        digest chain cannot interleave with background writes) with a
+        counted ``persistFallbackReason``."""
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
+        from siddhi_tpu.util.snapshot import SnapshotService
 
         store = self._persistence_store()
         svc = self._snapshot_service()
+        if mode is None:
+            mode = self.app_context.persist_mode
+        if mode not in ("sync", "async"):
+            raise SiddhiAppRuntimeError(
+                f"app '{self.name}': persist mode {mode!r} must be "
+                "'sync' or 'async'")
+        sm = self.app_context.statistics_manager
+        st = self._durability_stats()
+        if mode == "async" and isinstance(store, IncrementalPersistenceStore):
+            if sm is not None:
+                sm.record_persist_fallback(self.name,
+                                           "incremental-store-sync-only")
+            mode = "sync"
         revision = SnapshotService.new_revision(self.name)
-        # quiesce external input around the snapshot
+        jr = self.app_context.input_journal
+        if mode == "sync" and isinstance(store, IncrementalPersistenceStore):
+            # historical incremental path, unchanged
+            for s in self.sources:
+                s.pause()
+            self.drain_device_emits()
+            try:
+                kind, data = svc.incremental_snapshot()
+                store.save(self.name, revision, kind, data)
+            finally:
+                for s in self.sources:
+                    s.resume()
+            st.persists_sync += 1
+            if jr is not None:
+                jr.mark_revision(revision)
+            return revision
+
+        def on_fallback(element, reason):
+            st.capture_fallback_elements += 1
+            if sm is not None:
+                sm.record_persist_fallback(f"{self.name}.{element}", reason)
+
+        # quiesce external input around the capture
         # (reference: SiddhiAppRuntimeImpl.persist:677-691 pauses sources)
         for s in self.sources:
             s.pause()
         # barrier: queued device emits must land in downstream state
-        # (selectors, windows, tables) before it is snapshotted
+        # (selectors, windows, tables) before it is captured
         self.drain_device_emits()
         try:
-            if isinstance(store, IncrementalPersistenceStore):
-                kind, data = svc.incremental_snapshot()
-                store.save(self.name, revision, kind, data)
-            else:
-                store.save(self.name, revision, svc.full_snapshot())
+            capture = svc.capture(on_fallback=on_fallback)
+            if jr is not None:
+                # watermark + ledger counts at the capture point; the
+                # prune happens at commit, AFTER the store write lands
+                jr.note_capture(revision)
         finally:
             for s in self.sources:
                 s.resume()
-        jr = self.app_context.input_journal
-        if jr is not None:
-            # pin the crash-recovery journal to this checkpoint: batches
-            # recorded so far are covered by the snapshot and pruned;
-            # restore_revision(revision) will replay everything after
-            jr.mark_revision(revision)
+        if mode == "async":
+            writer = self._durability_writer()
+            writer.submit(
+                revision,
+                lambda: self._persist_write(store, revision, capture),
+                on_abandon=jr.drop_mark if jr is not None else None)
+            return revision
+        fi = self.app_context.fault_injector
+        try:
+            if fi is not None:
+                fi.check("persist.write")
+            self._persist_write(store, revision, capture)
+        except Exception:
+            # failed sync persist: abandon the journal mark so a later
+            # commit cannot prune uncovered entries.  A simulated crash
+            # (BaseException) keeps the mark — the journal models a log
+            # that survives the process, marks included.
+            if jr is not None:
+                jr.drop_mark(revision)
+            raise
+        st.persists_sync += 1
         return revision
 
     def snapshot(self) -> bytes:
@@ -472,6 +645,7 @@ class SiddhiAppRuntime:
         return self._snapshot_service().full_snapshot()
 
     def restore(self, snapshot: bytes):
+        self._flush_persists()
         # barrier: pending emits flush into the PRE-restore state (the
         # synchronous path delivered them before restore was called)
         self.drain_device_emits()
@@ -510,7 +684,7 @@ class SiddhiAppRuntime:
                     "app is not running; start() it before restoring to "
                     "replay", self.name, len(entries))
             return
-        jr.begin_replay()
+        jr.begin_replay(revision)
         try:
             for stream_id, batch in entries:
                 self.input_manager.get_input_handler(stream_id).send_batch(
@@ -530,6 +704,7 @@ class SiddhiAppRuntime:
     def restore_revision(self, revision: str):
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
 
+        self._flush_persists()
         store = self._persistence_store()
         if isinstance(store, IncrementalPersistenceStore):
             chain = store.load_chain(self.name, until_revision=revision)
@@ -567,6 +742,7 @@ class SiddhiAppRuntime:
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
 
         log = logging.getLogger("siddhi_tpu")
+        self._flush_persists()
         store = self._persistence_store()
         if isinstance(store, IncrementalPersistenceStore):
             chain = store.load_chain(self.name)
@@ -598,6 +774,7 @@ class SiddhiAppRuntime:
             f"failed to restore (last error: {last_error})")
 
     def clear_all_revisions(self):
+        self._flush_persists()
         self._persistence_store().clear_all_revisions(self.name)
 
     # Java-style aliases

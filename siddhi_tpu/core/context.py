@@ -140,6 +140,13 @@ class SiddhiAppContext:
         self.hotkey_k = 8
         self.hotkey_promote = 0.25
         self.hotkey_demote = 0.10
+        # @app:persist(interval='30 sec', mode='async'): default persist()
+        # mode ('sync' keeps the historical stop-the-world behavior;
+        # 'async' captures under the barrier and writes on the checkpoint
+        # writer thread — durability/) and the optional periodic-persist
+        # daemon interval (0 = no daemon).
+        self.persist_mode = "sync"
+        self.persist_interval_ms = 0
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

@@ -1,0 +1,34 @@
+"""Crash-consistent asynchronous durability pipeline.
+
+No analog in the reference engine (its persistence is a synchronous
+stop-the-world snapshot + unchecked store write).  This package makes
+``persist()`` cheap enough to take continuously and crash-safe at every
+intermediate step:
+
+``capture.py``   in-barrier state capture: immutable device-array
+                 references + cheap host copies (freeze), with a counted
+                 per-element pickle fallback for unfreezable state.
+``writer.py``    background checkpoint writer: single-in-flight with
+                 coalescing backpressure, retry-with-backoff on store
+                 faults, crash containment.
+``store.py``     ``DurableFileSystemPersistenceStore``: per-element blob
+                 files + a checksummed manifest committed last via
+                 fsync + atomic rename; journal-segment storage.
+``spill.py``     journal overflow spill: cold input-journal segments
+                 move to the persistence store instead of being dropped.
+"""
+
+from siddhi_tpu.durability.capture import StateCapture, UnfreezableStateError, freeze
+from siddhi_tpu.durability.spill import JournalSpillSink
+from siddhi_tpu.durability.store import DurableFileSystemPersistenceStore
+from siddhi_tpu.durability.writer import AsyncCheckpointWriter, DurabilityStats
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "DurabilityStats",
+    "DurableFileSystemPersistenceStore",
+    "JournalSpillSink",
+    "StateCapture",
+    "UnfreezableStateError",
+    "freeze",
+]

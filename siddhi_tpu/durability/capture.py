@@ -1,0 +1,193 @@
+"""Non-blocking checkpoint capture: freeze state under the barrier.
+
+The synchronous persist path pickles the whole state tree while sources
+are paused — the batch loop stalls for the full serialize+write.  The
+async path instead calls :func:`freeze` per element under the barrier:
+
+* device arrays (jax) are kept **by reference** — they are immutable, so
+  the D2H fetch can happen later on the writer thread;
+* host containers (dicts/lists/EventBatch/numpy) are **shallow-cheap
+  copied** so post-barrier mutation cannot race the background pickle;
+* anything freeze does not understand makes that ELEMENT fall back to an
+  in-barrier ``pickle.dumps`` (``prepickled``), counted through
+  ``persistFallbackReason`` — degradation, never corruption.
+
+Materialization (D2H via ``util.faults.host_copy``, the sanctioned
+materializer — this module is in the host-sync-hazard scan set and must
+not call ``np.asarray``/``np.array`` itself) and per-element pickling
+happen in :meth:`StateCapture.materialize_blobs` on the writer thread.
+"""
+
+from __future__ import annotations
+
+import pickle
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.event import Event, EventBatch
+from siddhi_tpu.util.faults import host_copy
+
+
+class UnfreezableStateError(Exception):
+    """An element's state holds a type freeze cannot safely copy."""
+
+
+_SCALARS = (type(None), bool, int, float, complex, str, bytes)
+
+
+def _is_device_array(obj: Any) -> bool:
+    """Array-like that is NOT numpy: a jax device array (immutable, so a
+    reference is a valid capture — fetched to host later, off-barrier)."""
+    return (not isinstance(obj, (np.ndarray, np.generic))
+            and hasattr(obj, "shape") and hasattr(obj, "dtype"))
+
+
+def freeze(obj: Any) -> Any:
+    """Cheap race-free copy of one element's snapshot state.
+
+    Raises :class:`UnfreezableStateError` on any type whose aliasing
+    semantics are unknown — the caller then pre-pickles that element
+    under the barrier instead."""
+    if isinstance(obj, _SCALARS):
+        return obj
+    if isinstance(obj, np.generic):
+        return obj
+    if isinstance(obj, np.ndarray):
+        return obj.copy()
+    if _is_device_array(obj):
+        return obj  # immutable device value: capture by reference
+    if isinstance(obj, dict):
+        return {k: freeze(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [freeze(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(freeze(v) for v in obj)
+    if isinstance(obj, (set, frozenset)):
+        return set(obj) if isinstance(obj, set) else obj
+    if isinstance(obj, deque):
+        return deque((freeze(v) for v in obj), maxlen=obj.maxlen)
+    if isinstance(obj, EventBatch):
+        out = EventBatch(
+            obj.stream_id,
+            list(obj.attribute_names),
+            {k: freeze(v) for k, v in obj.columns.items()},
+            obj.timestamps.copy(),
+            obj.types.copy(),
+        )
+        out.aux = {k: freeze(v) for k, v in obj.aux.items()}
+        return out
+    if isinstance(obj, Event):
+        return Event(obj.timestamp, [freeze(v) for v in obj.data],
+                     obj.is_expired)
+    raise UnfreezableStateError(type(obj).__name__)
+
+
+def _materialize(obj: Any) -> Any:
+    """Fetch captured-by-reference device arrays to host.  Runs OFF the
+    barrier (writer thread); only called on ``freeze`` output, whose
+    containers are private copies."""
+    if _is_device_array(obj):
+        return host_copy(obj)
+    if isinstance(obj, dict):
+        return {k: _materialize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_materialize(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_materialize(v) for v in obj)
+    if isinstance(obj, deque):
+        return deque((_materialize(v) for v in obj), maxlen=obj.maxlen)
+    return obj
+
+
+class CapturedElement:
+    """One state-tree element: frozen state OR an in-barrier pickle."""
+
+    __slots__ = ("kind", "name", "state", "prepickled")
+
+    def __init__(self, kind: str, name: str, state: Any = None,
+                 prepickled: Optional[bytes] = None):
+        self.kind = kind
+        self.name = name
+        self.state = state
+        self.prepickled = prepickled
+
+
+class StateCapture:
+    """Everything ``persist()`` collects under the barrier.
+
+    ``elements`` preserve the snapshot tree's (kind, name) addressing so
+    the writer can emit per-element blobs (durable store) or reassemble
+    the monolithic tree-pickle (plain stores) — both restore through the
+    unchanged ``SnapshotService.restore`` path."""
+
+    __slots__ = ("app", "version", "elements", "fallbacks")
+
+    def __init__(self, app: str, version: int,
+                 elements: List[CapturedElement],
+                 fallbacks: List[Tuple[str, str]]):
+        self.app = app
+        self.version = version
+        self.elements = elements
+        # [(element key, reason)] for elements that took the in-barrier
+        # pickle fallback — surfaced as persistFallbackReason
+        self.fallbacks = fallbacks
+
+    def materialize_blobs(self) -> List[Tuple[str, str, bytes]]:
+        """[(kind, name, pickled bytes)] — D2H fetch + pickle, off-barrier."""
+        out: List[Tuple[str, str, bytes]] = []
+        for el in self.elements:
+            if el.prepickled is not None:
+                out.append((el.kind, el.name, el.prepickled))
+            else:
+                out.append((el.kind, el.name, pickle.dumps(
+                    _materialize(el.state),
+                    protocol=pickle.HIGHEST_PROTOCOL)))
+        return out
+
+    def tree_bytes(self) -> bytes:
+        """Monolithic tree pickle, bit-compatible with
+        ``SnapshotService.full_snapshot`` (for stores without a
+        per-element blob layout)."""
+        return pickle.dumps(self.tree(), protocol=pickle.HIGHEST_PROTOCOL)
+
+    def tree(self) -> Dict:
+        tree: Dict = {"version": self.version, "app": self.app,
+                      "queries": {}, "tables": {}, "named_windows": {},
+                      "partitions": {}, "aggregations": {}}
+        for el in self.elements:
+            if el.prepickled is not None:
+                tree[el.kind][el.name] = pickle.loads(el.prepickled)
+            else:
+                tree[el.kind][el.name] = _materialize(el.state)
+        return tree
+
+
+def capture_elements(app: str, version: int, tree: Dict,
+                     element_kinds: Tuple[str, ...],
+                     on_fallback: Optional[Callable[[str, str], None]] = None,
+                     ) -> StateCapture:
+    """Freeze a just-built state tree into a :class:`StateCapture`.
+
+    Caller holds the barrier (process lock, sources paused, emits
+    drained).  An element freeze cannot copy is pickled here, in-barrier
+    — the per-element sync degradation path — and reported through
+    ``on_fallback(element_key, reason)``."""
+    elements: List[CapturedElement] = []
+    fallbacks: List[Tuple[str, str]] = []
+    for kind in element_kinds:
+        for name, state in tree.get(kind, {}).items():
+            try:
+                elements.append(CapturedElement(kind, name,
+                                                state=freeze(state)))
+            except UnfreezableStateError as e:
+                reason = f"unfreezable:{e}"
+                fallbacks.append((f"{kind}:{name}", reason))
+                if on_fallback is not None:
+                    on_fallback(f"{kind}:{name}", reason)
+                elements.append(CapturedElement(
+                    kind, name,
+                    prepickled=pickle.dumps(
+                        state, protocol=pickle.HIGHEST_PROTOCOL)))
+    return StateCapture(app, version, elements, fallbacks)

@@ -276,6 +276,36 @@ class AppPlanner:
                         setattr(fi.stats, k, getattr(fi.stats, k) + v)
                 jr.stats = fi.stats
                 self.app_context.input_journal = jr
+                # journal overflow spills cold segments to the app's
+                # persistence store instead of dropping them — replay
+                # stitches spilled + in-memory segments (durability/)
+                from siddhi_tpu.durability.spill import JournalSpillSink
+
+                jr.spill_sink = JournalSpillSink(
+                    siddhi_context, self.name, self.app_context)
+
+        # @app:persist(interval='30 sec', mode='async'): default persist
+        # mode + optional periodic-checkpoint daemon (durability/)
+        persist_ann = find_annotation(siddhi_app.annotations, "app:persist")
+        if persist_ann is not None:
+            mode = (persist_ann.element("mode")
+                    or persist_ann.element() or "async").lower()
+            if mode not in ("sync", "async"):
+                raise SiddhiAppCreationError(
+                    f"@app:persist: mode {mode!r} must be 'sync' or 'async'")
+            self.app_context.persist_mode = mode
+            iv = persist_ann.element("interval")
+            if iv:
+                try:
+                    interval_ms = int(iv)
+                except ValueError:
+                    from siddhi_tpu.compiler.parser import parse_time_string
+
+                    interval_ms = parse_time_string(iv)
+                if interval_ms <= 0:
+                    raise SiddhiAppCreationError(
+                        f"@app:persist: interval {iv!r} must be > 0")
+                self.app_context.persist_interval_ms = interval_ms
 
         self.scheduler = Scheduler(self.app_context)
         self.app_context.scheduler = self.scheduler

@@ -7,6 +7,8 @@ server instead of MSF4J:
     POST /siddhi-artifact-deploy            body = SiddhiQL app string
     GET  /siddhi-artifact-undeploy/{name}
     GET  /siddhi-apps                       (list deployed app names)
+    GET  /siddhi-persist/{name}             (checkpoint; @app:persist mode)
+    GET  /siddhi-restore-last/{name}        (restore newest good revision)
 
 Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}``.
 """
@@ -65,6 +67,12 @@ class SiddhiService:
                     self._send(code, payload)
                 elif len(parts) == 3 and parts[1] == "siddhi-statistics":
                     code, payload = service.statistics(parts[2])
+                    self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-persist":
+                    code, payload = service.persist(parts[2])
+                    self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-restore-last":
+                    code, payload = service.restore_last(parts[2])
                     self._send(code, payload)
                 elif self.path.rstrip("/") == "/siddhi-apps":
                     self._send(200, {"status": "OK", "apps": service.app_names()})
@@ -161,6 +169,47 @@ class SiddhiService:
             }
         return 200, {"status": "OK", "metrics": runtime.statistics()}
 
+    def persist(self, name: str):
+        """Checkpoint a deployed app in its configured persist mode
+        (@app:persist, default sync).  Async mode returns as soon as the
+        capture lands — the revision commits on the checkpoint writer
+        thread; poll /siddhi-statistics for persistCommits."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        try:
+            revision = runtime.persist()
+        except Exception as e:  # noqa: BLE001 — surface persist errors to client
+            return 500, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", "revision": revision,
+                     "mode": runtime.app_context.persist_mode}
+
+    def restore_last(self, name: str):
+        """Restore the newest restorable revision of a deployed app
+        (corrupt/torn revisions are walked past) and replay journaled
+        post-checkpoint input."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        try:
+            revision = runtime.restore_last_revision()
+        except Exception as e:  # noqa: BLE001 — surface restore errors to client
+            return 500, {"status": "ERROR", "message": str(e)}
+        if revision is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"no persisted revision for app '{name}'",
+            }
+        return 200, {"status": "OK", "revision": revision}
+
     def app_names(self):
         with self._lock:
             return sorted(self._runtimes)
@@ -180,11 +229,13 @@ class SiddhiService:
         self._thread.start()
 
     def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+        # HTTPServer.shutdown() blocks until serve_forever() acknowledges;
+        # it deadlocks when the serving thread was never started.
         if self._thread is not None:
+            self._server.shutdown()
             self._thread.join(timeout=5)
             self._thread = None
+        self._server.server_close()
         with self._lock:
             runtimes, self._runtimes = dict(self._runtimes), {}
         for rt in runtimes.values():

@@ -40,6 +40,13 @@ Injection sites (strings, by convention ``layer.point``):
 ``timer.fire``        individual scheduled-task fires
 ``callback``          stream-junction callback dispatch
 ``state.poison``      device-state poisoning (``poison`` kind)
+``persist.write``     checkpoint store write (retryable; durability/)
+``persist.post_blob``     crash point: element blobs durable, no manifest
+``persist.pre_manifest``  crash point: before the manifest tmp write
+``persist.mid_manifest``  crash point: manifest tmp durable, rename pending
+``persist.post_manifest`` crash point: revision committed, journal mark not
+``journal.spill``     journal-segment store write (retryable)
+``journal.spill.mid`` crash point: segment durable, journal not yet trimmed
 ====================  ====================================================
 
 Fault kinds:
@@ -98,6 +105,12 @@ class FaultStats:
         "suppressed_events",
         "journal_dropped",
         "connect_retries_exhausted",
+        # journal spill tier (durability/spill.py): overflow segments
+        # persisted instead of dropped, and how replay used them
+        "journal_spills",
+        "spilled_batches",
+        "spill_retries",
+        "replayed_spilled_batches",
     )
 
     def __init__(self) -> None:
@@ -408,19 +421,46 @@ class InputJournal:
     re-emitted output events each callback/sink already received, so the
     observable sequence is bit-identical to an uninterrupted run.
 
-    The journal is bounded (``depth`` batches).  Overflow evicts the
-    oldest entry and poisons replay (``entries_after`` returns ``None``)
-    because a gapped replay would silently diverge.
+    The journal is bounded (``depth`` batches).  On overflow it first
+    tries to SPILL the coldest ``spill_chunk`` entries to the
+    persistence store through ``spill_sink``
+    (durability/spill.py JournalSpillSink, attached by the planner);
+    replay then stitches spilled + in-memory segments.  Without a
+    spill-capable store the old behavior stands: the oldest entry is
+    dropped and replay across the gap is refused (``entries_after``
+    returns ``None``) because a gapped replay would silently diverge.
+
+    Async persistence splits the old ``mark_revision`` into
+    ``note_capture`` (at capture time, under the barrier: records the
+    sequence watermark + output-ledger counts, prunes NOTHING) and
+    ``commit_revision`` (after the store committed the revision: prunes
+    entries and spilled segments at or below the watermark).  A crash
+    between the two leaves both the previous and the new revision
+    replayable; ``drop_mark`` abandons the mark of a failed/coalesced
+    persist.  ``mark_revision`` (= note + commit) remains the
+    synchronous-path entry point.
     """
 
-    def __init__(self, depth: int = DEFAULT_JOURNAL_DEPTH) -> None:
+    def __init__(self, depth: int = DEFAULT_JOURNAL_DEPTH,
+                 spill_chunk: Optional[int] = None) -> None:
         self.depth = int(depth)
+        # how many cold entries move per spill (amortizes store writes)
+        self.spill_chunk = int(spill_chunk) if spill_chunk else max(
+            1, self.depth // 2)
         self._lock = threading.RLock()
         self._entries: deque = deque()  # (seq, stream_id, batch)
         self._seq = 0
-        self._revision: Optional[str] = None
+        self._revision: Optional[str] = None  # newest COMMITTED revision
         self._rev_seq = -1
         self._gap = False
+        # seqs <= _gap_seq were dropped without spill (unrecoverable)
+        self._gap_seq = 0
+        # revision -> (seq watermark, output-ledger counts at capture)
+        self._marks: Dict[str, Tuple[int, Dict[Any, int]]] = {}
+        # spilled segment seq ranges [(seq0, seq1)], oldest first
+        self._segments: List[Tuple[int, int]] = []
+        # durability/spill.py JournalSpillSink (None = no spill tier)
+        self.spill_sink: Optional[Any] = None
         # Output ledger: per-endpoint delivered-event counts.
         self._counts: Dict[Any, int] = {}
         self._marked_counts: Dict[Any, int] = {}
@@ -438,44 +478,148 @@ class InputJournal:
                 return
             self._seq += 1
             self._entries.append((self._seq, stream_id, batch))
-            while len(self._entries) > self.depth:
+            if len(self._entries) > self.depth:
+                self._overflow_locked()
+
+    def _overflow_locked(self) -> None:
+        while len(self._entries) > self.depth:
+            sink = self.spill_sink
+            if sink is not None:
+                n = min(self.spill_chunk, len(self._entries))
+                chunk = [self._entries[i] for i in range(n)]
+                seq0, seq1 = chunk[0][0], chunk[-1][0]
+                ok = False
+                try:
+                    # a `crash` fault (BaseException) propagates out of
+                    # here by design — mid-spill kill of the matrix
+                    ok = sink.spill(seq0, seq1, chunk, stats=self.stats)
+                except Exception:
+                    log.exception("journal: spill sink failed; falling "
+                                  "back to dropping")
+                if ok:
+                    for _ in range(n):
+                        self._entries.popleft()
+                    self._segments.append((seq0, seq1))
+                    if self.stats is not None:
+                        self.stats.journal_spills += 1
+                        self.stats.spilled_batches += n
+                    continue
+            seq, _sid, _b = self._entries.popleft()
+            self._gap_seq = max(self._gap_seq, seq)
+            self._gap = True
+            if self.stats is not None:
+                self.stats.journal_dropped += 1
+
+    def note_capture(self, revision: str) -> None:
+        """Record the checkpoint watermark of ``revision`` at CAPTURE
+        time (under the barrier).  Prunes nothing — the revision is not
+        durable yet."""
+        with self._lock:
+            self._marks[revision] = (self._seq, dict(self._counts))
+
+    def drop_mark(self, revision: str) -> None:
+        """Abandon the mark of a failed or coalesced persist."""
+        with self._lock:
+            self._marks.pop(revision, None)
+
+    def commit_revision(self, revision: str) -> None:
+        """The store committed ``revision``: prune entries and spilled
+        segments its checkpoint covers.  No-op on an unknown/superseded
+        mark (a commit arriving after a newer one already pruned)."""
+        with self._lock:
+            mark = self._marks.get(revision)
+            if mark is None:
+                return
+            watermark, counts = mark
+            while self._entries and self._entries[0][0] <= watermark:
                 self._entries.popleft()
-                self._gap = True
-                if self.stats is not None:
-                    self.stats.journal_dropped += 1
+            prune_upto = 0
+            keep = []
+            for (s0, s1) in self._segments:
+                if s1 <= watermark:
+                    prune_upto = max(prune_upto, s1)
+                else:
+                    keep.append((s0, s1))
+            self._segments = keep
+            if prune_upto and self.spill_sink is not None:
+                try:
+                    self.spill_sink.prune(prune_upto)
+                except Exception:
+                    log.exception("journal: spilled-segment prune failed")
+            # marks with older watermarks are superseded by this commit
+            self._marks = {r: m for r, m in self._marks.items()
+                           if m[0] >= watermark}
+            self._revision = revision
+            self._rev_seq = watermark
+            self._marked_counts = counts
+            if self._gap_seq <= watermark:
+                self._gap = False
 
     def mark_revision(self, revision: str) -> None:
-        """Pin the journal to a just-persisted revision: everything
-        recorded so far is covered by the checkpoint and pruned."""
-        with self._lock:
-            self._revision = revision
-            self._rev_seq = self._seq
-            self._entries.clear()
-            self._gap = False
-            self._marked_counts = dict(self._counts)
+        """Synchronous-path pin: capture mark + immediate commit."""
+        self.note_capture(revision)
+        self.commit_revision(revision)
 
     def entries_after(self, revision: str) -> Optional[List[Tuple[str, Any]]]:
-        """Batches recorded after ``revision`` was marked, oldest first.
+        """Batches recorded after ``revision``'s capture, oldest first —
+        stitched from spilled segments + the in-memory tail, deduped by
+        sequence number (mid-spill crashes leave an overlap).
 
-        ``None`` when replay is impossible: unknown/unmarked revision or
-        a journal overflow gap since the mark."""
+        ``None`` when replay is impossible: unknown/unmarked revision,
+        an unspilled overflow gap past the watermark, or unreadable
+        spilled segments."""
         with self._lock:
-            if self._revision != revision or self._gap:
+            mark = self._marks.get(revision)
+            if mark is None:
                 return None
-            return [(sid, b) for (_seq, sid, b) in self._entries]
+            watermark = mark[0]
+            if self._gap_seq > watermark:
+                return None
+            if self._seq <= watermark:
+                return []
+            collected: Dict[int, Tuple[str, Any]] = {}
+            spilled_needed = [s for s in self._segments if s[1] > watermark]
+            if spilled_needed:
+                sink = self.spill_sink
+                loaded = sink.load_segments() if sink is not None else None
+                if loaded is None:
+                    return None
+                for _s0, s1, seg_entries in loaded:
+                    if s1 <= watermark:
+                        continue
+                    for seq, sid, b in seg_entries:
+                        if seq > watermark:
+                            collected[seq] = (sid, b)
+            mem_seqs = set()
+            for seq, sid, b in self._entries:
+                if seq > watermark:
+                    collected[seq] = (sid, b)
+                    mem_seqs.add(seq)
+            needed = range(watermark + 1, self._seq + 1)
+            if any(s not in collected for s in needed):
+                return None
+            if self.stats is not None:
+                self.stats.replayed_spilled_batches += sum(
+                    1 for s in needed if s not in mem_seqs)
+            return [collected[s] for s in needed]
 
     # -- replay + output dedup ---------------------------------------
 
-    def begin_replay(self) -> None:
+    def begin_replay(self, revision: Optional[str] = None) -> None:
         with self._lock:
+            base = self._marked_counts
+            if revision is not None:
+                mark = self._marks.get(revision)
+                if mark is not None:
+                    base = mark[1]
             self.replaying = True
             # Suppress exactly the delta each endpoint saw between the
             # checkpoint and the crash; counts restart from the mark.
             self._remaining = {
-                k: self._counts.get(k, 0) - self._marked_counts.get(k, 0)
+                k: self._counts.get(k, 0) - base.get(k, 0)
                 for k in self._counts
             }
-            self._counts = dict(self._marked_counts)
+            self._counts = dict(base)
 
     def end_replay(self) -> None:
         with self._lock:
@@ -521,6 +665,14 @@ class InputJournal:
             self._revision = None
             self._rev_seq = -1
             self._gap = False
+            self._gap_seq = 0
+            self._marks = {}
+            self._segments = []
+            if self.spill_sink is not None:
+                try:
+                    self.spill_sink.clear()
+                except Exception:
+                    log.exception("journal: spilled-segment clear failed")
             self._counts = {}
             self._marked_counts = {}
             self._remaining = {}

@@ -12,9 +12,102 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger("siddhi_tpu.persistence")
+
+
+def fsync_dir(path: str):
+    """fsync a directory so a just-renamed/created entry survives power
+    loss (best effort: some filesystems refuse directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError as e:  # pragma: no cover - fs-dependent
+        log.debug("persistence: directory fsync of %s failed: %s", path, e)
+    finally:
+        os.close(fd)
+
+
+class FileJournalSegmentMixin:
+    """Journal spill segments for filesystem-backed stores: one file per
+    segment under ``<base>/<app>/journal/<seq0>_<seq1>.seg`` (the dir
+    name carries no ``_``/revision prefix, so revision listings skip
+    it).  Requires ``self._app_dir`` and ``self._lock``."""
+
+    _JOURNAL_DIR = "journal"
+
+    def _journal_dir(self, app_name: str) -> str:
+        return os.path.join(self._app_dir(app_name), self._JOURNAL_DIR)
+
+    @staticmethod
+    def _seg_name(seq0: int, seq1: int) -> str:
+        return f"{seq0:012d}-{seq1:012d}.seg"
+
+    def save_journal_segment(self, app_name: str, seq0: int, seq1: int,
+                             payload: bytes):
+        with self._lock:
+            d = self._journal_dir(app_name)
+            os.makedirs(d, exist_ok=True)
+            name = self._seg_name(seq0, seq1)
+            tmp = os.path.join(d, name + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(d, name))
+            fsync_dir(d)
+
+    def _segments(self, app_name: str) -> List[Tuple[int, int, str]]:
+        d = self._journal_dir(app_name)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        out = []
+        for f in names:
+            if not f.endswith(".seg"):
+                continue
+            try:
+                seq0, seq1 = f[:-4].split("-", 1)
+                out.append((int(seq0), int(seq1), f))
+            except ValueError:
+                log.warning("persistence: skipping foreign journal "
+                            "segment %r in %s", f, d)
+        return sorted(out)
+
+    def load_journal_segments(
+            self, app_name: str) -> List[Tuple[int, int, bytes]]:
+        with self._lock:
+            out = []
+            d = self._journal_dir(app_name)
+            for seq0, seq1, fname in self._segments(app_name):
+                with open(os.path.join(d, fname), "rb") as f:
+                    out.append((seq0, seq1, f.read()))
+            return out
+
+    def prune_journal_segments(self, app_name: str, upto_seq: int):
+        """Remove segments fully covered by a committed checkpoint."""
+        with self._lock:
+            d = self._journal_dir(app_name)
+            for _seq0, seq1, fname in self._segments(app_name):
+                if seq1 <= upto_seq:
+                    try:
+                        os.remove(os.path.join(d, fname))
+                    except OSError:
+                        pass
+
+    def clear_journal(self, app_name: str):
+        with self._lock:
+            d = self._journal_dir(app_name)
+            for _seq0, _seq1, fname in self._segments(app_name):
+                try:
+                    os.remove(os.path.join(d, fname))
+                except OSError:
+                    pass
 
 
 class PersistenceStore:
@@ -41,16 +134,32 @@ class PersistenceStore:
 
 
 class InMemoryPersistenceStore(PersistenceStore):
-    """Keeps every revision in a process-local dict
-    (reference: InMemoryPersistenceStore.java)."""
+    """Keeps revisions in a process-local dict (reference:
+    InMemoryPersistenceStore.java).  Bounded: only the newest
+    ``revisions_to_keep`` survive, so periodic persistence cannot grow
+    the process without limit (parity with the filesystem store)."""
 
-    def __init__(self):
+    def __init__(self, revisions_to_keep: int = 10):
+        self.revisions_to_keep = revisions_to_keep
         self._store: Dict[str, Dict[str, bytes]] = {}
+        # journal spill segments: app -> {(seq0, seq1): payload}
+        self._journal: Dict[str, Dict[Tuple[int, int], bytes]] = {}
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _rev_key(revision: str):
+        """Order by the leading timestamp of ``<ts>_<app>`` revision ids
+        (SnapshotService.new_revision); foreign ids sort lexically."""
+        head = revision.split("_", 1)[0]
+        return (0, int(head), "") if head.isdigit() else (1, 0, revision)
 
     def save(self, app_name: str, revision: str, snapshot: bytes):
         with self._lock:
-            self._store.setdefault(app_name, {})[revision] = snapshot
+            revs = self._store.setdefault(app_name, {})
+            revs[revision] = snapshot
+            for old in sorted(revs, key=self._rev_key
+                              )[: max(0, len(revs) - self.revisions_to_keep)]:
+                del revs[old]
 
     def load(self, app_name: str, revision: str) -> Optional[bytes]:
         with self._lock:
@@ -61,19 +170,42 @@ class InMemoryPersistenceStore(PersistenceStore):
             revs = self._store.get(app_name)
             if not revs:
                 return None
-            return max(revs, key=lambda r: int(r.split("_", 1)[0]))
+            return max(revs, key=self._rev_key)
 
     def revisions(self, app_name: str) -> List[str]:
         with self._lock:
             revs = self._store.get(app_name, {})
-            return sorted(revs, key=lambda r: int(r.split("_", 1)[0]))
+            return sorted(revs, key=self._rev_key)
 
     def clear_all_revisions(self, app_name: str):
         with self._lock:
             self._store.pop(app_name, None)
 
+    # -- journal spill segments (durability/spill.py) -----------------
 
-class FileSystemPersistenceStore(PersistenceStore):
+    def save_journal_segment(self, app_name: str, seq0: int, seq1: int,
+                             payload: bytes):
+        with self._lock:
+            self._journal.setdefault(app_name, {})[(seq0, seq1)] = payload
+
+    def load_journal_segments(
+            self, app_name: str) -> List[Tuple[int, int, bytes]]:
+        with self._lock:
+            segs = self._journal.get(app_name, {})
+            return [(s0, s1, segs[(s0, s1)]) for s0, s1 in sorted(segs)]
+
+    def prune_journal_segments(self, app_name: str, upto_seq: int):
+        with self._lock:
+            segs = self._journal.get(app_name, {})
+            for key in [k for k in segs if k[1] <= upto_seq]:
+                del segs[key]
+
+    def clear_journal(self, app_name: str):
+        with self._lock:
+            self._journal.pop(app_name, None)
+
+
+class FileSystemPersistenceStore(FileJournalSegmentMixin, PersistenceStore):
     """One file per revision under ``<base>/<app>/<revision>``
     (reference: FileSystemPersistenceStore.java).  Keeps the newest
     ``revisions_to_keep`` files (reference default 3)."""
@@ -113,9 +245,15 @@ class FileSystemPersistenceStore(PersistenceStore):
             d = self._app_dir(app_name)
             os.makedirs(d, exist_ok=True)
             tmp = os.path.join(d, revision + ".tmp")
+            # fsync before the rename and fsync the dir after: without
+            # both, a power loss can leave a "committed" revision empty
+            # (rename durable, data not) or missing (rename not durable)
             with open(tmp, "wb") as f:
                 f.write(snapshot)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, os.path.join(d, revision))
+            fsync_dir(d)
             # evict oldest beyond the keep count
             revs = self._revisions(app_name)
             for old in revs[: max(0, len(revs) - self.revisions_to_keep)]:
@@ -228,9 +366,14 @@ class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
             d = self._app_dir(app_name)
             os.makedirs(d, exist_ok=True)
             tmp = os.path.join(d, f"{revision}.{kind}.tmp")
+            # same crash-consistency contract as the full store: data
+            # durable before the rename, rename durable via dir fsync
             with open(tmp, "wb") as f:
                 f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, os.path.join(d, f"{revision}.{kind}"))
+            fsync_dir(d)
             if kind == "base":
                 self._prune(app_name)
 

@@ -61,6 +61,22 @@ class SnapshotService:
         with self.app.app_context.process_lock:
             return pickle.dumps(self._state_tree(), protocol=pickle.HIGHEST_PROTOCOL)
 
+    def capture(self, on_fallback=None):
+        """Non-blocking capture for the async persist path
+        (durability/capture.py): under the lock, freeze each element —
+        immutable device-array references + cheap host copies — instead
+        of pickling the whole tree.  Elements freeze cannot copy are
+        pickled here (in-barrier) and reported via ``on_fallback``.
+        Returns a ``StateCapture``; serialization and the D2H fetch run
+        on the checkpoint writer thread."""
+        from siddhi_tpu.durability.capture import capture_elements
+
+        with self.app.app_context.process_lock:
+            tree = self._state_tree()
+            return capture_elements(self.app.name, SNAPSHOT_FORMAT_VERSION,
+                                    tree, self._ELEMENT_KINDS,
+                                    on_fallback=on_fallback)
+
     # -- incremental capture -------------------------------------------------
 
     _ELEMENT_KINDS = ("queries", "tables", "named_windows", "partitions", "aggregations")
@@ -168,6 +184,12 @@ class SnapshotService:
                 raise CannotRestoreSiddhiAppStateError(
                     f"app '{self.app.name}': state restore failed: {e}"
                 ) from e
+            finally:
+                # a restore invalidates the incremental digest cache: an
+                # 'inc' diffed against PRE-restore digests would corrupt
+                # the chain on replay — force the next snapshot to a base
+                self._digests = {}
+                self._incs_since_base = 0
 
     # -- revisions ----------------------------------------------------------
 

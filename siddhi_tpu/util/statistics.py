@@ -144,6 +144,20 @@ class FaultTracker:
         return self.fault_stats.as_dict()
 
 
+class DurabilityTracker:
+    """Checkpoint-pipeline counters (durability/writer.py
+    DurabilityStats): same thin-gauge pattern as FaultTracker — the
+    persist path increments its own counters, this view just reads
+    them."""
+
+    def __init__(self, name: str, durability_stats):
+        self.name = name
+        self.durability_stats = durability_stats
+
+    def values(self) -> Dict[str, int]:
+        return self.durability_stats.as_dict()
+
+
 class StatisticsManager:
     """Tracker registry + periodic console reporter
     (reference: util/statistics/metrics/SiddhiStatisticsManager.java:35)."""
@@ -164,6 +178,15 @@ class StatisticsManager:
         # registered ungated so recovery events stay visible even at
         # statistics level 'off'
         self.faults: Dict[str, FaultTracker] = {}
+        # checkpoint-pipeline gauges (async persist writer, durability/),
+        # registered ungated like the fault counters — a degraded
+        # durability pipeline must stay visible at statistics level 'off'
+        self.durability: Dict[str, DurabilityTracker] = {}
+        # persist-path degradations (unfreezable element → in-barrier
+        # pickle, incremental store forcing sync): count + last reason,
+        # keyed '<app>' or '<app>.<kind>:<element>', never silent
+        self.persist_fallbacks: Dict[str, int] = {}
+        self.persist_fallback_reasons: Dict[str, str] = {}
         # per-query engine placement ('host' | 'dense' | 'device'),
         # populated at app build — not a counter, but reported alongside
         # so execution('tpu') fallbacks are visible in the metrics feed
@@ -223,6 +246,18 @@ class StatisticsManager:
 
     def fault_tracker(self, name: str, fault_stats) -> FaultTracker:
         return self.faults.setdefault(name, FaultTracker(name, fault_stats))
+
+    def durability_tracker(self, name: str,
+                           durability_stats) -> DurabilityTracker:
+        return self.durability.setdefault(
+            name, DurabilityTracker(name, durability_stats))
+
+    def record_persist_fallback(self, name: str, reason: str):
+        """A persist degraded (element pickled in-barrier, async forced
+        sync); counted with the last reason kept."""
+        self.persist_fallbacks[name] = (
+            self.persist_fallbacks.get(name, 0) + 1)
+        self.persist_fallback_reasons[name] = reason
 
     def record_sharded_fallback(self, qname: str, reason: str):
         """A query that requested mesh sharding is running
@@ -291,6 +326,13 @@ class StatisticsManager:
         for ft in list(self.faults.values()):
             for metric, v in ft.values().items():
                 out[self._metric("Faults", ft.name, metric)] = v
+        for dt in list(self.durability.values()):
+            for metric, v in dt.values().items():
+                out[self._metric("Durability", dt.name, metric)] = v
+        for name, n in list(self.persist_fallbacks.items()):
+            out[self._metric("Durability", name, "persistFallbacks")] = n
+            out[self._metric("Durability", name, "persistFallbackReason")] = (
+                self.persist_fallback_reasons.get(name, ""))
         for qname, engine in list(self.lowering.items()):
             out[self._metric("Queries", qname, "loweredTo")] = engine
         for qname, n in list(self.sharded_fallbacks.items()):

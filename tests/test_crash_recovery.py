@@ -12,8 +12,12 @@ crashed.
 
 The differential runs across all three device engines (device-single,
 dense NFA, sharded) plus a sink endpoint, and covers the degraded paths:
-journal overflow (replay refused, loss surfaced), restore before
-start, and raw-bytes restore invalidating the ledger.
+journal overflow (spilled to the persistence store and replayed when the
+store supports segments — durability/spill.py — refused with a surfaced
+warning when it does not), restore before start, and raw-bytes restore
+invalidating the ledger.  The async persist pipeline gets the same
+differential in tests/test_durability.py; here one case pins that
+``persist(mode='async')`` recovery is bit-identical to the sync path.
 """
 
 import numpy as np
@@ -196,17 +200,131 @@ class TestSinkExactlyOnce:
             "sink published a duplicate or lost an event across recovery")
 
 
+class TestAsyncPersistRecovery:
+    def test_async_persist_recovers_bit_identical(self):
+        # same differential as the sync matrix, through persist('async'):
+        # the capture + background commit must recover exactly like the
+        # blocking write (the full crash-site matrix lives in
+        # tests/test_durability.py)
+        sends = series(30)
+        ref = reference_run("device_single", sends)
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(InMemoryPersistenceStore())
+            rt = m.create_siddhi_app_runtime(_header("device_single"))
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:10]:
+                h.send(list(row), timestamp=ts)
+            rev = rt.persist(mode="async")
+            assert rt.wait_for_persist(rev, timeout=30) == "committed"
+            for row, ts in sends[10:20]:
+                h.send(list(row), timestamp=ts)
+            rt.app_context.fault_injector.configure("ingest", "crash",
+                                                    count=1)
+            with pytest.raises(SimulatedCrashError):
+                h.send(list(sends[20][0]), timestamp=sends[20][1])
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(_header("device_single"))
+            rt2.add_callback("OutputStream",
+                             lambda evs: got.extend(tuple(e.data)
+                                                    for e in evs))
+            rt2.start()
+            assert rt2.restore_last_revision() == rev
+            h2 = rt2.get_input_handler("S")
+            for row, ts in sends[21:]:
+                h2.send(list(row), timestamp=ts)
+            rt2.shutdown()
+            assert got == ref
+        finally:
+            m.shutdown()
+
+
 class TestDegradedPaths:
-    def test_journal_overflow_refuses_replay_with_warning(self, caplog):
-        # a depth-4 journal overflows before the crash: replay would be
-        # gapped, so restore must refuse it (checkpoint-only recovery)
-        # and say so — silent divergence is the one forbidden outcome
+    def test_journal_overflow_spills_and_replays(self):
+        # a depth-4 journal overflows before the crash: the cold half
+        # spills to the persistence store (InMemory stores support
+        # journal segments) and recovery stitches spilled + in-memory
+        # entries back into a gapless bit-exact replay
+        sends = series(20)
+        ref = reference_run("device_single", sends)
+        m = SiddhiManager()
+        try:
+            m.set_persistence_store(InMemoryPersistenceStore())
+            app = ("@app:name('crashdiff') @app:playback "
+                   "@app:faults(journal='4') @app:execution('tpu') "
+                   + AGG_BODY)
+            rt = m.create_siddhi_app_runtime(app)
+            got = []
+            rt.add_callback("OutputStream",
+                            lambda evs: got.extend(tuple(e.data)
+                                                   for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for row, ts in sends[:4]:
+                h.send(list(row), timestamp=ts)
+            rt.persist()
+            for row, ts in sends[4:16]:  # 12 > depth 4 -> spill
+                h.send(list(row), timestamp=ts)
+            jr = rt.app_context.input_journal
+            assert jr.stats.journal_spills > 0
+            assert jr.stats.journal_dropped == 0
+            rt.shutdown()
+
+            rt2 = m.create_siddhi_app_runtime(app)
+            rt2.add_callback("OutputStream",
+                             lambda evs: got.extend(tuple(e.data)
+                                                    for e in evs))
+            rt2.start()
+            assert rt2.restore_last_revision() is not None
+            jr2 = rt2.app_context.input_journal
+            assert jr2.stats.replayed_spilled_batches > 0
+            h2 = rt2.get_input_handler("S")
+            for row, ts in sends[16:]:
+                h2.send(list(row), timestamp=ts)
+            rt2.shutdown()
+            assert got == ref, "spilled replay diverged"
+        finally:
+            m.shutdown()
+
+    def test_journal_overflow_without_segments_refuses_replay(self, caplog):
+        # with a store that cannot hold journal segments, overflow still
+        # degrades the old way: replay would be gapped, so restore must
+        # refuse it (checkpoint-only recovery) and say so — silent
+        # divergence is the one forbidden outcome
         import logging
+
+        from siddhi_tpu.util.persistence import PersistenceStore
+
+        class NoSegmentStore(PersistenceStore):
+            def __init__(self):
+                self._revs = {}
+
+            def save(self, app_name, revision, data):
+                self._revs.setdefault(app_name, {})[revision] = data
+
+            def load(self, app_name, revision):
+                return self._revs.get(app_name, {}).get(revision)
+
+            def get_last_revision(self, app_name):
+                revs = sorted(self._revs.get(app_name, {}))
+                return revs[-1] if revs else None
+
+            def revisions(self, app_name):
+                return sorted(self._revs.get(app_name, {}))
+
+            def clear_all_revisions(self, app_name):
+                self._revs.pop(app_name, None)
 
         sends = series(20)
         m = SiddhiManager()
         try:
-            m.set_persistence_store(InMemoryPersistenceStore())
+            m.set_persistence_store(NoSegmentStore())
             app = ("@app:name('ovf') @app:playback "
                    "@app:faults(journal='4') @app:execution('tpu') "
                    + AGG_BODY)
