@@ -451,6 +451,76 @@ def bench_multiplexed(tenants=MUX_TENANTS, keys=MUX_KEYS,
     return out
 
 
+FUSE_APP = ("@app:name('fusebench{tag}') @app:playback "
+            "@app:execution('tpu') {fuse}{trace}"
+            "define stream SIn (sym int, price float, vol int); "
+            "define stream Mid (sym int, price float, vol int); "
+            "define stream Win (sym int, total double); "
+            "@info(name='q1') from SIn[price > 4.0] "
+            "select sym, price, vol insert into Mid; "
+            "@info(name='q2') from Mid#window.length(64) "
+            "select sym, sum(price) as total insert into Win; "
+            "@info(name='q3') from every e1=Win[total > 1540.0] "
+            "-> e2=Win[total > e1.total] "
+            "select e1.sym as s1, e1.total as t1, e2.total as t2 "
+            "insert into Out;")
+
+
+def _run_fused_pipeline(fuse, batch, steps, warmup, windows, trace=""):
+    """One fused-pipeline bench run; ``trace`` is an ``@app:trace(...)``
+    annotation (or '') so the trace-overhead bench can reuse the exact
+    same app/workload with the recorder dialed up or off."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(FUSE_APP.format(
+            tag="F" if fuse else "J",
+            fuse="@app:fuse " if fuse else "", trace=trace))
+        rows = [0]
+        rt.add_callback("Out", lambda evs: rows.__setitem__(
+            0, rows[0] + len(evs)))
+        rt.start()
+        if fuse:
+            assert rt.lowering() == {
+                "q1": "fused", "q2": "fused", "q3": "fused"}, \
+                "bench chain failed to fuse"
+        h = rt.get_input_handler("SIn")
+        rng = np.random.default_rng(31)
+
+        def mk(i):
+            sym = ((np.arange(batch, dtype=np.int64) * 524287
+                    + i * batch) % 8)
+            price = rng.uniform(0.0, 30.0, batch).astype(np.float32)
+            vol = rng.integers(1, 100, batch)
+            ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+            return EventBatch(
+                "SIn", ["sym", "price", "vol"],
+                {"sym": sym, "price": price, "vol": vol}, ts)
+
+        bs = [mk(i) for i in range(warmup + steps)]
+        for b in bs[:warmup]:
+            h.send_batch(b)
+        window_rates = []
+        for _w in range(windows):
+            t_w = time.perf_counter()
+            for b in bs[warmup:]:
+                h.send_batch(b)
+            window_rates.append(
+                batch * steps / (time.perf_counter() - t_w))
+        qr = rt.query_runtimes["q3"]
+        inter = (rt.junctions["Mid"].dispatches
+                 + rt.junctions["Win"].dispatches)
+        stats = (qr.device_runtime.stats()
+                 if fuse else {"fused_hops": 0})
+        rt.shutdown()
+        return (float(np.median(window_rates)), window_rates,
+                stats, inter, rows[0])
+    finally:
+        m.shutdown()
+
+
 def bench_fused_pipeline(batch=FUSE_BATCH, steps=FUSE_STEPS,
                          warmup=FUSE_WARMUP, windows=FUSE_WINDOWS):
     """Device-resident stream-graph fusion: a 3-stage
@@ -462,73 +532,10 @@ def bench_fused_pipeline(batch=FUSE_BATCH, steps=FUSE_STEPS,
     junction dispatches the fused program kept device-resident — next
     to ``junctionHops``, the dispatches the unfused run actually
     performed on the intermediate streams."""
-    from siddhi_tpu import SiddhiManager
-    from siddhi_tpu.core.event import EventBatch
-
-    APP = ("@app:name('fusebench{tag}') @app:playback "
-           "@app:execution('tpu') {fuse}"
-           "define stream SIn (sym int, price float, vol int); "
-           "define stream Mid (sym int, price float, vol int); "
-           "define stream Win (sym int, total double); "
-           "@info(name='q1') from SIn[price > 4.0] "
-           "select sym, price, vol insert into Mid; "
-           "@info(name='q2') from Mid#window.length(64) "
-           "select sym, sum(price) as total insert into Win; "
-           "@info(name='q3') from every e1=Win[total > 1540.0] "
-           "-> e2=Win[total > e1.total] "
-           "select e1.sym as s1, e1.total as t1, e2.total as t2 "
-           "insert into Out;")
-
-    def run(fuse):
-        m = SiddhiManager()
-        try:
-            rt = m.create_siddhi_app_runtime(APP.format(
-                tag="F" if fuse else "J",
-                fuse="@app:fuse " if fuse else ""))
-            rows = [0]
-            rt.add_callback("Out", lambda evs: rows.__setitem__(
-                0, rows[0] + len(evs)))
-            rt.start()
-            if fuse:
-                assert rt.lowering() == {
-                    "q1": "fused", "q2": "fused", "q3": "fused"}, \
-                    "bench chain failed to fuse"
-            h = rt.get_input_handler("SIn")
-            rng = np.random.default_rng(31)
-
-            def mk(i):
-                sym = ((np.arange(batch, dtype=np.int64) * 524287
-                        + i * batch) % 8)
-                price = rng.uniform(0.0, 30.0, batch).astype(np.float32)
-                vol = rng.integers(1, 100, batch)
-                ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
-                return EventBatch(
-                    "SIn", ["sym", "price", "vol"],
-                    {"sym": sym, "price": price, "vol": vol}, ts)
-
-            bs = [mk(i) for i in range(warmup + steps)]
-            for b in bs[:warmup]:
-                h.send_batch(b)
-            window_rates = []
-            for _w in range(windows):
-                t_w = time.perf_counter()
-                for b in bs[warmup:]:
-                    h.send_batch(b)
-                window_rates.append(
-                    batch * steps / (time.perf_counter() - t_w))
-            qr = rt.query_runtimes["q3"]
-            inter = (rt.junctions["Mid"].dispatches
-                     + rt.junctions["Win"].dispatches)
-            stats = (qr.device_runtime.stats()
-                     if fuse else {"fused_hops": 0})
-            rt.shutdown()
-            return (float(np.median(window_rates)), window_rates,
-                    stats, inter, rows[0])
-        finally:
-            m.shutdown()
-
-    f_rate, f_windows, f_stats, f_inter, _ = run(True)
-    j_rate, _j_windows, _, j_inter, _ = run(False)
+    f_rate, f_windows, f_stats, f_inter, _ = _run_fused_pipeline(
+        True, batch, steps, warmup, windows)
+    j_rate, _j_windows, _, j_inter, _ = _run_fused_pipeline(
+        False, batch, steps, warmup, windows)
     assert f_inter == 0, "fused run dispatched an intermediate junction"
     return {
         "events_per_sec": f_rate,
@@ -538,6 +545,27 @@ def bench_fused_pipeline(batch=FUSE_BATCH, steps=FUSE_STEPS,
         "fusedHops": f_stats["fused_hops"],
         "junctionHops": j_inter,
         "step_invocations": f_stats["step_invocations"],
+    }
+
+
+def bench_trace_overhead(batch=FUSE_BATCH, steps=FUSE_STEPS,
+                         warmup=FUSE_WARMUP, windows=FUSE_WINDOWS):
+    """Cycle-tracer cost on the hot path: the fused-pipeline bench run
+    with the default-on sampled recorder (sample='1/64') vs
+    ``@app:trace(sample='off')``.  The acceptance bar for the
+    observability layer is ``trace_overhead_pct <= 5`` — the recorder
+    may cost at most 5% of untraced throughput at its default sample
+    rate."""
+    untraced, _, _, _, _ = _run_fused_pipeline(
+        True, batch, steps, warmup, windows,
+        trace="@app:trace(sample='off') ")
+    traced, _, _, _, _ = _run_fused_pipeline(
+        True, batch, steps, warmup, windows)
+    return {
+        "traced_events_per_sec": traced,
+        "untraced_events_per_sec": untraced,
+        "trace_overhead_pct": round(
+            (untraced - traced) / untraced * 100.0, 2) if untraced else 0.0,
     }
 
 
@@ -896,6 +924,13 @@ def main():
         except Exception as e:
             out["cpu_smoke_fused_pipeline_error"] = str(e)
         try:
+            to = bench_trace_overhead(
+                batch=SMOKE_FUSE_BATCH, steps=SMOKE_FUSE_STEPS,
+                warmup=1, windows=2)
+            out["cpu_smoke_trace_overhead_pct"] = to["trace_overhead_pct"]
+        except Exception as e:
+            out["cpu_smoke_trace_overhead_error"] = str(e)
+        try:
             hk = bench_hot_key(keys=512, batch=SMOKE_HK_BATCH,
                                steps=SMOKE_HK_STEPS, warmup=1, windows=2)
             out["cpu_smoke_hot_key_events_per_sec"] = round(
@@ -946,6 +981,8 @@ def main():
                 "cpu_smoke_fused_pipeline_events_per_sec"),
             "cpu_smoke_fused_vs_junction": smoke.get(
                 "cpu_smoke_fused_vs_junction"),
+            "cpu_smoke_trace_overhead_pct": smoke.get(
+                "cpu_smoke_trace_overhead_pct"),
             "hot_key_pattern_events_per_sec_per_chip": None,
             "cpu_smoke_hot_key_events_per_sec": smoke.get(
                 "cpu_smoke_hot_key_events_per_sec"),
@@ -972,6 +1009,7 @@ def main():
     shwin = bench_sharded_window()
     mux = bench_multiplexed()
     fused = bench_fused_pipeline()
+    trace_oh = bench_trace_overhead()
     hotkey = bench_hot_key()
     host = bench_host_baseline()
     persist = bench_persist_stall()
@@ -1031,6 +1069,9 @@ def main():
         "fused_pipeline_fusedHops": fused["fusedHops"],
         "fused_pipeline_junctionHops": fused["junctionHops"],
         "fused_pipeline_window_rates": fused["window_rates"],
+        "trace_overhead_pct": trace_oh["trace_overhead_pct"],
+        "traced_events_per_sec": round(
+            trace_oh["traced_events_per_sec"], 1),
         "hot_key_pattern_events_per_sec_per_chip": round(
             hotkey["events_per_sec"], 1),
         "hot_key_vs_dense": hotkey["vs_dense"],

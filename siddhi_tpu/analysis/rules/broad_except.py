@@ -23,7 +23,7 @@ from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
 
 SCANNED_DIRS = ("siddhi_tpu/core/", "siddhi_tpu/transport/",
-                "siddhi_tpu/durability/")
+                "siddhi_tpu/durability/", "siddhi_tpu/observability/")
 
 BROAD = {"Exception", "BaseException"}
 

@@ -47,6 +47,14 @@ SCANNED = (
     "siddhi_tpu/durability/writer.py",
     "siddhi_tpu/durability/store.py",
     "siddhi_tpu/durability/spill.py",
+    # observability: span hooks ride the ingest/step/emit hot path —
+    # they may timestamp and append to the ring, never materialize a
+    # device array (a tracer that fetches would reintroduce the stall
+    # it exists to measure)
+    "siddhi_tpu/observability/trace.py",
+    "siddhi_tpu/observability/recorder.py",
+    "siddhi_tpu/observability/histograms.py",
+    "siddhi_tpu/observability/prometheus.py",
 )
 
 MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",

@@ -501,7 +501,8 @@ class SiddhiAppRuntime:
             w = self._ckpt_writer = AsyncCheckpointWriter(
                 self.name, stats=self._durability_stats(),
                 fault_injector=self.app_context.fault_injector,
-                listeners=self.app_context.exception_listeners)
+                listeners=self.app_context.exception_listeners,
+                tracer=self.app_context.tracer)
         return w
 
     def _flush_persists(self, timeout: float = 30.0):
@@ -606,8 +607,15 @@ class SiddhiAppRuntime:
         # barrier: queued device emits must land in downstream state
         # (selectors, windows, tables) before it is captured
         self.drain_device_emits()
+        tracer = self.app_context.tracer
         try:
+            t_cap = tracer.clock() if tracer is not None else 0.0
             capture = svc.capture(on_fallback=on_fallback)
+            if tracer is not None:
+                # the in-barrier capture is THE persist-path stall the
+                # batch loop feels — span it like a pipeline stage
+                tracer.record_span("persist.capture", "persist",
+                                   t_cap, tracer.clock())
             if jr is not None:
                 # watermark + ledger counts at the capture point; the
                 # prune happens at commit, AFTER the store write lands
@@ -742,6 +750,12 @@ class SiddhiAppRuntime:
         from siddhi_tpu.util.persistence import IncrementalPersistenceStore
 
         log = logging.getLogger("siddhi_tpu")
+        # crash-restore post-mortem: freeze the pre-restore span ring
+        # BEFORE state is replaced — it is the last evidence of what the
+        # pipeline was doing when the previous incarnation died
+        tracer = self.app_context.tracer
+        if tracer is not None:
+            tracer.dump("crash-restore")
         self._flush_persists()
         store = self._persistence_store()
         if isinstance(store, IncrementalPersistenceStore):

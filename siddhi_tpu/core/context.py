@@ -158,6 +158,11 @@ class SiddhiAppContext:
         # @app:faults(...) fault-injection harness (util/faults.py).
         # None when chaos testing is off — every hook site no-ops.
         self.fault_injector = None
+        # Cycle-correlated span tracer + flight recorder
+        # (observability/trace.py), created unconditionally by the
+        # planner (default-on at 1-in-64 sampling; @app:trace tunes or
+        # disables it).  None only for hand-built contexts in tests.
+        self.tracer = None
         # Bounded input journal for restore-and-replay (util/faults.py
         # InputJournal); shared through siddhi_context.input_journals so
         # it outlives a crashed runtime.  None = journaling disabled.

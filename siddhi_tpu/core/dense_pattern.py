@@ -238,6 +238,10 @@ class DensePatternRuntime:
         self.mesh = mesh
         self.emit_stats = EmitStats()
         self._app_context = app_context  # exception-listener channel
+        # cycle-correlated span tracer (observability/trace.py), shared
+        # per app; dense spans carry the engine kind (shard when meshed)
+        self.tracer = getattr(app_context, "tracer", None)
+        self.engine_kind = "dense" if mesh is None else "shard"
         # @app:faults harness: wired onto the engine (the step hook
         # reads engine.faults) and the emit queue (drain retry +
         # isolation); None when chaos testing is off
@@ -537,6 +541,10 @@ class DensePatternRuntime:
         n = len(cur)
         if n == 0:
             return
+        # one sampled-or-None cycle token per junction batch: ingest
+        # span starts here, at receive time
+        tok = (self.tracer.begin_cycle(self.engine_kind, n)
+               if self.tracer is not None else None)
         eng = self.engine
         cols = {}
         for a in _numeric_attrs(eng, stream_key):
@@ -574,20 +582,26 @@ class DensePatternRuntime:
         now = (self._app_context.timestamp_generator.current_time()
                if self._app_context is not None else None)
 
-        def _finish(p=pending, t=ts, k=keys, n=now):
-            if p is None or p.resolve() == 0:
+        def _finish(p=pending, t=ts, k=keys, n=now, tk=tok):
+            c = 0 if p is None else p.resolve()
+            if tk is not None:
+                # match-count gate resolved: the jitted step finished
+                tk.step_done(c)
+            if c == 0:
                 self.emit_queue.skip()
                 return
             self.emit_queue.push(PendingEmit(
                 p.device_arrays(),
                 lambda host, pp=p, tt=t, kk=k, nn=n: self._emit_deferred(
-                    pp, tt, kk, host, now=nn)))
+                    pp, tt, kk, host, now=nn),
+                trace=tk))
 
         # the match-count fetch (resolve) is the blocking device sync;
         # staging it lets batch N+1's H2D puts + step dispatch go out
         # before batch N's count scalar is fetched
         self.ingest_stage.submit(
-            pending.probe() if pending is not None else None, _finish)
+            pending.probe() if pending is not None else None, _finish,
+            trace=tok)
 
     def drain(self):
         """Flush barrier: materialize and emit every queued match batch
@@ -603,6 +617,10 @@ class DensePatternRuntime:
         """Emit-queue fault channel: surface isolated drain/callback
         failures to the app's exception listeners (via the injector's
         listener list, wired to them by the planner)."""
+        # freeze the span ring: the post-mortem shows the cycles that
+        # led into the isolated failure
+        if self.tracer is not None:
+            self.tracer.dump(f"onerror-isolation:{type(e).__name__}")
         if self.faults is not None:
             self.faults.notify(e)
 

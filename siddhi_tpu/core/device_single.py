@@ -66,11 +66,15 @@ class DeviceQueryRuntime:
     def __init__(self, engine, out_stream_id: str,
                  emit: Callable[[EventBatch], None], emit_depth=1,
                  clock: Optional[Callable[[], int]] = None, faults=None,
-                 ingest_depth=1):  # int or 'auto'
+                 ingest_depth=1, tracer=None):  # int or 'auto'
         self.engine = engine
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.state = engine.init_state()
+        # cycle-correlated span tracer (observability/trace.py), wired by
+        # the planner; the engine kind labels this runtime's spans
+        self.tracer = tracer
+        self.engine_kind = getattr(engine, "engine_kind", "device")
         self.step_invocations = 0  # proof the jitted path ran (tests)
         self.emit_stats = EmitStats()
         # @app:faults(...) injector: arms the emit.drain/state.poison
@@ -99,6 +103,10 @@ class DeviceQueryRuntime:
         self.clock = clock
 
     def _on_fault(self, e: BaseException):
+        # a batch just died in isolation (@OnError route): freeze the
+        # span ring so the post-mortem shows the cycles leading up to it
+        if self.tracer is not None:
+            self.tracer.dump(f"onerror-isolation:{type(e).__name__}")
         if self.faults is not None:
             self.faults.notify(e)
 
@@ -150,6 +158,10 @@ class DeviceQueryRuntime:
         n = len(cur)
         if n == 0:
             return
+        # one sampled-or-None cycle token per junction batch: ingest
+        # span starts here, at receive time
+        tok = (self.tracer.begin_cycle(self.engine_kind, n)
+               if self.tracer is not None else None)
         eng = self.engine
         cols = {
             a: np.asarray(cur.columns[a])
@@ -162,25 +174,38 @@ class DeviceQueryRuntime:
         if self._poison_guard():
             # corrupted step: state was re-materialized from the last
             # clean copy; this batch's device outputs are quarantined
+            if tok is not None:
+                tok.aborted("step")
+            if self.tracer is not None:
+                self.tracer.dump("poison-quarantine")
             return
         # `now` is the clock the SYNCHRONOUS path would have read; the
         # finish step may run a batch later (ingest.depth > 1), so it is
         # captured here, at receive time
         now = self.clock() if self.clock is not None else None
 
-        def _finish(p=pending, t=now):
-            if p is None or p.resolve() == 0:
+        def _finish(p=pending, t=now, tk=tok):
+            if p is None:
+                c = 0
+            else:
+                c = p.resolve()
+            if tk is not None:
+                # count gate resolved: the jitted step finished
+                tk.step_done(c)
+            if c == 0:
                 self.emit_queue.skip()
                 return
             self.emit_queue.push(PendingEmit(
                 p.device_arrays(),
-                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt)))
+                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt),
+                trace=tk))
 
         # the count-gate fetch (resolve) is what blocks on the device;
         # staging it lets batch N+1's H2D put + step dispatch go out
         # before batch N's scalar is fetched
         self.ingest_stage.submit(
-            pending.probe() if pending is not None else None, _finish)
+            pending.probe() if pending is not None else None, _finish,
+            trace=tok)
 
     def drain(self):
         """Flush barrier: materialize and emit every queued batch (one

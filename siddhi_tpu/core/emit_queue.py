@@ -154,12 +154,15 @@ class PendingEmit:
     """One deferred junction batch: device refs + a materializer that
     turns the fetched host arrays into the exact synchronous emit."""
 
-    __slots__ = ("arrays", "materialize")
+    __slots__ = ("arrays", "materialize", "trace")
 
-    def __init__(self, arrays: Sequence, materialize: Callable):
-        # materialize(host_arrays) -> None (runs the emit callback)
+    def __init__(self, arrays: Sequence, materialize: Callable, trace=None):
+        # materialize(host_arrays) -> None (runs the emit callback);
+        # trace is the batch's sampled cycle token (observability/
+        # trace.py CycleToken, or None) — the drain stamps its emit span
         self.arrays = list(arrays)
         self.materialize = materialize
+        self.trace = trace
 
 
 class EmitDepthController:
@@ -323,6 +326,10 @@ class EmitQueue:
             had_device = any(_is_device_array(a) for a in arrays)
             t0 = (time.monotonic()
                   if self.controller is not None and had_device else None)
+            # emit-span clock for sampled cycle tokens: one coalesced
+            # fetch serves every entry in this round, so they share the
+            # fetch start and each stamps its own materialize end
+            t_fetch = time.perf_counter()
             try:
                 host = self._fetch(arrays)
             except Exception as err:
@@ -331,6 +338,9 @@ class EmitQueue:
                     fi.stats.drains_failed += 1
                 log.error("emit drain failed; dropping %d pending "
                           "batch(es): %s", len(entries), err)
+                for e in entries:
+                    if e.trace is not None:
+                        e.trace.aborted("emit")
                 if self.on_fault is not None:
                     self.on_fault(err)
                 continue
@@ -350,5 +360,10 @@ class EmitQueue:
                         fi.stats.callback_faults_isolated += 1
                     log.error("emit materialize failed; dropping one "
                               "pending batch: %s", err)
+                    if e.trace is not None:
+                        e.trace.aborted("emit")
                     if self.on_fault is not None:
                         self.on_fault(err)
+                    continue
+                if e.trace is not None:
+                    e.trace.emitted(t_fetch)

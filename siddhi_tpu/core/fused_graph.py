@@ -50,11 +50,15 @@ class FusedChainRuntime:
     def __init__(self, graph, out_stream_id: str,
                  emit: Callable[[EventBatch], None], emit_depth=1,
                  clock: Optional[Callable[[], int]] = None, faults=None,
-                 ingest_depth=1):  # int or 'auto'
+                 ingest_depth=1, tracer=None):  # int or 'auto'
         self.graph = graph
         self.out_stream_id = out_stream_id
         self.emit_cb = emit
         self.state = graph.init_state()
+        # cycle-correlated span tracer (observability/trace.py); one
+        # fused dispatch is one cycle, labeled with the 'fused' kind
+        self.tracer = tracer
+        self.engine_kind = "fused"
         self.step_invocations = 0  # fused program dispatches (tests)
         # hops kept device-resident: (stages - 1) junction dispatches
         # saved per fused dispatch (the bench's fusedHops counter)
@@ -77,6 +81,10 @@ class FusedChainRuntime:
         self.clock = clock
 
     def _on_fault(self, e: BaseException):
+        # freeze the span ring: the post-mortem shows the cycles that
+        # led into the isolated failure
+        if self.tracer is not None:
+            self.tracer.dump(f"onerror-isolation:{type(e).__name__}")
         if self.faults is not None:
             self.faults.notify(e)
 
@@ -115,6 +123,10 @@ class FusedChainRuntime:
         n = len(cur)
         if n == 0:
             return
+        # one sampled-or-None cycle token per junction batch: ingest
+        # span starts here, at receive time
+        tok = (self.tracer.begin_cycle(self.engine_kind, n)
+               if self.tracer is not None else None)
         head = self.graph.stages[0]
         cols = {
             a: cur.columns[a]
@@ -126,19 +138,29 @@ class FusedChainRuntime:
         self.step_invocations += 1
         self.fused_hops += self.hops_per_dispatch
         if self._poison_guard():
+            if tok is not None:
+                tok.aborted("step")
+            if self.tracer is not None:
+                self.tracer.dump("poison-quarantine")
             return
         now = self.clock() if self.clock is not None else None
 
-        def _finish(p=pending, t=now):
-            if p is None or p.resolve() == 0:
+        def _finish(p=pending, t=now, tk=tok):
+            c = 0 if p is None else p.resolve()
+            if tk is not None:
+                # count gate resolved: the fused step finished
+                tk.step_done(c)
+            if c == 0:
                 self.emit_queue.skip()
                 return
             self.emit_queue.push(PendingEmit(
                 p.device_arrays(),
-                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt)))
+                lambda host, pp=p, tt=t: self._emit_deferred(pp, host, tt),
+                trace=tk))
 
         self.ingest_stage.submit(
-            pending.probe() if pending is not None else None, _finish)
+            pending.probe() if pending is not None else None, _finish,
+            trace=tok)
 
     def drain(self):
         """Flush barrier (snapshot/restore, rate-limiter fires, pull

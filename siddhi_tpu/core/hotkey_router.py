@@ -356,6 +356,11 @@ class HotKeyRouterRuntime:
         cols = {a: c for a, c in cur.columns.items()
                 if a in scan.base._lane_dtype}
         ts = cur.timestamps
+        # hot-path batches get their own cycle tokens (engine kind
+        # 'hotkey'); the cold remainder traced under 'dense' already
+        tracer = dense.tracer
+        tok = (tracer.begin_cycle("hotkey", len(ts))
+               if tracer is not None else None)
         put, meta = scan.pack_cycle(slot_pos, cols, ts)
         put_dev = staged_put(put, faults=self.faults,
                              stats=dense.ingest_stats)
@@ -373,15 +378,20 @@ class HotKeyRouterRuntime:
         keys_ref = keys
 
         def _finish(nr=n_rows, emit=emit_dev, m=meta, oc=out_cols,
-                    t=ts, k=keys_ref, nw=now):
-            if int(nr) == 0:
+                    t=ts, k=keys_ref, nw=now, tk=tok):
+            c = int(nr)
+            if tk is not None:
+                # row-count gate resolved: the scan cycle finished
+                tk.step_done(c)
+            if c == 0:
                 dense.emit_queue.skip()
                 return
             dense.emit_queue.push(PendingEmit(
                 [emit],
-                lambda host: self._emit_hot(host, m, oc, t, k, nw)))
+                lambda host: self._emit_hot(host, m, oc, t, k, nw),
+                trace=tk))
 
-        dense.ingest_stage.submit(n_rows, _finish)
+        dense.ingest_stage.submit(n_rows, _finish, trace=tok)
 
     def _out_pairs(self):
         """(output name, final-node attribute) pairs — eligibility

@@ -165,19 +165,27 @@ class IngestStage:
         self.stats = stats or IngestStats()
         self.faults = faults
         self.on_fault = on_fault
-        self._entries: List[Tuple[object, Callable]] = []
+        self._entries: List[Tuple[object, Callable, object]] = []
 
     def __len__(self) -> int:
         return len(self._entries)
 
-    def submit(self, probe, finish: Callable):
-        """Stage one dispatched batch; finish entries past the window."""
+    def submit(self, probe, finish: Callable, trace=None):
+        """Stage one dispatched batch; finish entries past the window.
+
+        ``trace`` is the batch's sampled cycle token (observability/
+        trace.py CycleToken, or None): submit time is the boundary where
+        receive-time work — conversion, the H2D put, the jitted step
+        dispatch — is all queued, so the token's ingest span ends here
+        and its step span starts."""
         if self.controller is not None:
             self.controller.note_push()
             self.depth = self.controller.effective_depth
             self.stats.auto_depth = self.depth
         self.stats.staged_batches += 1
-        self._entries.append((probe, finish))
+        if trace is not None:
+            trace.dispatched()
+        self._entries.append((probe, finish, trace))
         self.stats.note_depth(len(self._entries))
         while len(self._entries) >= self.depth:
             self._finish_oldest(barrier=False)
@@ -191,7 +199,7 @@ class IngestStage:
             self._finish_oldest(barrier=True)
 
     def _finish_oldest(self, barrier: bool):
-        probe, finish = self._entries.pop(0)
+        probe, finish, trace = self._entries.pop(0)
         # overlap evidence: if the step's count scalar is already
         # resident when we get around to fetching it, the device did the
         # work while the host staged the next batch (overlap); if not,
@@ -219,6 +227,8 @@ class IngestStage:
         except Exception as err:
             log.error("ingest finish failed; dropping one staged "
                       "batch's emit: %s", err)
+            if trace is not None:
+                trace.aborted("step")
             if self.on_fault is not None:
                 self.on_fault(err)
             return

@@ -75,11 +75,15 @@ class AsyncCheckpointWriter:
 
     def __init__(self, app_name: str, stats: Optional[DurabilityStats] = None,
                  fault_injector=None,
-                 listeners: Optional[List[Any]] = None):
+                 listeners: Optional[List[Any]] = None, tracer=None):
         self.app_name = app_name
         self.stats = stats or DurabilityStats()
         self.fault_injector = fault_injector
         self.listeners = listeners if listeners is not None else []
+        # cycle tracer (observability/trace.py): the writer thread spans
+        # each store write so checkpoint I/O shows up in the flight
+        # recorder interleaved with the batch cycles it overlaps
+        self.tracer = tracer
         # condition over the writer lock: every mutable writer field
         # below is read/written only while holding it
         self._lock = threading.Condition(threading.Lock())
@@ -220,11 +224,18 @@ class AsyncCheckpointWriter:
         scale = (fi.transfer_retry_scale if fi is not None
                  else DEFAULT_TRANSFER_RETRY_SCALE)
         last: Optional[Exception] = None
+        tracer = self.tracer
         for attempt in range(max(1, attempts)):
             try:
                 if fi is not None:
                     fi.check("persist.write")
+                t_job = tracer.clock() if tracer is not None else 0.0
                 job()
+                if tracer is not None:
+                    # one span per successful store write — retries that
+                    # failed are visible as the counters, not as spans
+                    tracer.record_span("persist.write", "persist",
+                                       t_job, tracer.clock())
                 with self._lock:
                     self._results[revision] = "committed"
                     self.stats.persist_commits += 1

@@ -280,6 +280,11 @@ class DeviceQueryEngine:
         state, rows = eng.process(state, cols, ts)   # rows: emitted dicts
     """
 
+    #: span-label kind for the cycle tracer (observability/trace.py) —
+    #: the runtime reads it at construction, so a wrapper engine (the
+    #: sharded delegate) overrides what the trace calls its cycles
+    engine_kind = "device"
+
     def __init__(
         self,
         query: Query,

@@ -69,6 +69,10 @@ class ShardedDeviceQueryEngine:
     across the mesh (group axis, key axis, or batch axis — see the
     module docstring for the per-kind layout)."""
 
+    #: cycle-tracer span label: sharded dispatches trace as 'shard' so
+    #: mesh overlap is distinguishable from single-device cycles
+    engine_kind = "shard"
+
     def __init__(self, engine, mesh, axis_name: str = "p"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -250,6 +250,39 @@ class AppPlanner:
         self.app_context.root_metrics_level = level
         self.app_context.statistics_manager = StatisticsManager(self.name, interval_s)
 
+        # @app:trace(sample='1/64', cycles='64', dir='/path'): cycle-
+        # correlated span tracing + flight recorder (observability/).
+        # Default ON at 1-in-64 sampling — the recorder is the black box
+        # every fault dump reads, so it must not require opting in;
+        # sample='off' disables span recording (the tracer object stays
+        # and every hook short-circuits on the None token).
+        from siddhi_tpu.observability import Tracer
+
+        trace_ann = find_annotation(siddhi_app.annotations, "app:trace")
+        trace_sample = Tracer.DEFAULT_SAMPLE
+        trace_cycles = Tracer.DEFAULT_CYCLES
+        trace_dir = None
+        if trace_ann is not None:
+            sv = (trace_ann.element("sample") or trace_ann.element() or "")
+            if sv.strip():
+                trace_sample = self._parse_trace_sample(sv.strip())
+            cv = trace_ann.element("cycles")
+            if cv:
+                try:
+                    nc = int(cv)
+                except ValueError:
+                    nc = -1
+                if nc < 1 or nc > 4096:
+                    raise SiddhiAppCreationError(
+                        f"@app:trace: cycles='{cv}' must be an integer in "
+                        "1..4096 (flight-recorder depth in batch cycles)")
+                trace_cycles = nc
+            trace_dir = trace_ann.element("dir") or None
+        tracer = Tracer(self.name, sample=trace_sample,
+                        cycles=trace_cycles, dump_dir=trace_dir)
+        self.app_context.tracer = tracer
+        self.app_context.statistics_manager.register_tracer(tracer)
+
         # @app:faults(...): deterministic chaos harness + crash-recovery
         # journal.  The injector itself is cheap (every hook is a None
         # check when the annotation is absent); the journal is keyed by
@@ -263,6 +296,9 @@ class AppPlanner:
             journal_depth = fi.configure_from_options(
                 self._ann_options(faults_ann))
             fi.listeners = self.app_context.exception_listeners
+            # a simulated crash kill is exactly what the flight recorder
+            # exists for: the injector dumps the span ring on its way out
+            fi.tracer = tracer
             self.app_context.fault_injector = fi
             if journal_depth:
                 jr = siddhi_context.input_journals.get(self.name)
@@ -372,6 +408,28 @@ class AppPlanner:
     @staticmethod
     def _ann_options(ann) -> Dict[str, str]:
         return {k: v for k, v in ann.elements if k is not None and k.lower() != "type"}
+
+    @staticmethod
+    def _parse_trace_sample(value: str) -> int:
+        """@app:trace sample grammar: 'off' (no spans), '1' (every
+        cycle), '1/N' or bare 'N' (every Nth cycle)."""
+        v = value.lower()
+        if v in ("off", "false", "none"):
+            return 0
+        num, sep, den = v.partition("/")
+        try:
+            n = int(den) if sep else int(num)
+            if sep and int(num) != 1:
+                raise ValueError(num)
+        except ValueError:
+            raise SiddhiAppCreationError(
+                f"@app:trace: sample='{value}' must be 'off', '1', 'N' or "
+                "'1/N' (record every Nth batch cycle)")
+        if n < 1 or n > 1_000_000:
+            raise SiddhiAppCreationError(
+                f"@app:trace: sample='{value}' out of range — the sampling "
+                "stride must be in 1..1000000")
+        return n
 
     def _resolve_ref(self, ann) -> Dict[str, str]:
         """Options for @source/@sink/@store with ``ref=`` merged from the

@@ -477,7 +477,8 @@ def _wire_chain(app, qp, entries, run: List[int], hops: List[str],
         emit_depth=ctx.tpu_emit_depth,
         clock=ctx.timestamp_generator.current_time,
         faults=ctx.fault_injector,
-        ingest_depth=ctx.tpu_ingest_depth)
+        ingest_depth=ctx.tpu_ingest_depth,
+        tracer=ctx.tracer)
     qr.device_runtime = runtime
 
     head_q, _hn = entries[run[0]]

@@ -821,7 +821,8 @@ class QueryPlanner:
             emit_depth=self.app.app_context.tpu_emit_depth,
             clock=self.app.app_context.timestamp_generator.current_time,
             faults=self.app.app_context.fault_injector,
-            ingest_depth=self.app.app_context.tpu_ingest_depth)
+            ingest_depth=self.app.app_context.tpu_ingest_depth,
+            tracer=self.app.app_context.tracer)
         qr.device_runtime = runtime
         if subscribe:
             junction = self.app.junction_for_input(s)

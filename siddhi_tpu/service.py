@@ -9,8 +9,12 @@ server instead of MSF4J:
     GET  /siddhi-apps                       (list deployed app names)
     GET  /siddhi-persist/{name}             (checkpoint; @app:persist mode)
     GET  /siddhi-restore-last/{name}        (restore newest good revision)
+    GET  /siddhi-trace/{name}               (flight recorder; ?format=chrome)
+    GET  /metrics                           (Prometheus text exposition)
 
-Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}``.
+Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}`` except
+``/metrics`` (Prometheus text) and ``/siddhi-trace?format=chrome``
+(raw Chrome ``chrome://tracing`` JSON array).
 """
 
 from __future__ import annotations
@@ -19,8 +23,14 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from siddhi_tpu.core.manager import SiddhiManager
+from siddhi_tpu.observability.prometheus import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+    app_histogram_entries,
+    render_prometheus,
+)
 
 
 class SiddhiService:
@@ -39,8 +49,11 @@ class SiddhiService:
 
             def _send(self, code: int, payload: dict):
                 body = json.dumps(payload).encode()
+                self._send_raw(code, body, "application/json")
+
+            def _send_raw(self, code: int, body: bytes, content_type: str):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -55,7 +68,21 @@ class SiddhiService:
                 self._send(code, payload)
 
             def do_GET(self):
-                parts = self.path.rstrip("/").split("/")
+                url = urlsplit(self.path)
+                parts = url.path.rstrip("/").split("/")
+                if url.path.rstrip("/") == "/metrics":
+                    self._send_raw(200, service.metrics_text().encode(),
+                                   PROMETHEUS_CONTENT_TYPE)
+                    return
+                if len(parts) == 3 and parts[1] == "siddhi-trace":
+                    fmt = parse_qs(url.query).get("format", [""])[0]
+                    code, payload = service.trace(parts[2], fmt)
+                    if code == 200 and fmt == "chrome":
+                        self._send_raw(code, json.dumps(payload).encode(),
+                                       "application/json")
+                    else:
+                        self._send(code, payload)
+                    return
                 if len(parts) == 3 and parts[1] == "siddhi-artifact-undeploy":
                     code, payload = service.undeploy(parts[2])
                     self._send(code, payload)
@@ -209,6 +236,45 @@ class SiddhiService:
                 "message": f"no persisted revision for app '{name}'",
             }
         return 200, {"status": "OK", "revision": revision}
+
+    def trace(self, name: str, fmt: str = ""):
+        """Flight-recorder feed of a deployed app: the live span ring
+        plus the last crash dump (if any).  ``fmt='chrome'`` returns the
+        ring as a Chrome ``chrome://tracing`` event array instead."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        tracer = runtime.app_context.tracer
+        if tracer is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"tracing is off for app '{name}'",
+            }
+        if fmt == "chrome":
+            return 200, tracer.recorder.chrome_trace()
+        return 200, {
+            "status": "OK",
+            "app": name,
+            "sample": tracer.sample,
+            "trace": tracer.recorder.payload("live"),
+            "last_dump": tracer.recorder.last_dump,
+        }
+
+    def metrics_text(self) -> str:
+        """All deployed apps' metric feeds as one Prometheus
+        text-exposition page (scrape target: GET /metrics)."""
+        with self._lock:
+            runtimes = sorted(self._runtimes.items())
+        apps = []
+        for name, rt in runtimes:
+            sm = rt.app_context.statistics_manager
+            apps.append((name, rt.statistics(),
+                         app_histogram_entries(name, sm)))
+        return render_prometheus(apps)
 
     def app_names(self):
         with self._lock:

@@ -171,6 +171,10 @@ class FaultInjector:
         # Hardening knobs (read by EmitQueue / sharded ingest).
         self.transfer_retry_attempts = DEFAULT_TRANSFER_RETRY_ATTEMPTS
         self.transfer_retry_scale = DEFAULT_TRANSFER_RETRY_SCALE
+        # Flight recorder (observability/trace.py Tracer), wired by the
+        # planner: a simulated crash kill dumps the span ring on its way
+        # out — the exact post-mortem the black box exists for.
+        self.tracer = None
 
     # -- configuration ------------------------------------------------
 
@@ -293,6 +297,12 @@ class FaultInjector:
             return
         if spec.kind == "crash":
             log.warning("fault-injection: simulated crash at %s", site)
+            if self.tracer is not None:
+                try:
+                    self.tracer.dump(f"fault-injector-crash:{site}")
+                except Exception:  # noqa: BLE001 — the kill must win
+                    log.exception("fault-injection: flight-recorder dump "
+                                  "failed on simulated crash")
             raise SimulatedCrashError(f"injected crash at {site}")
         if spec.kind == "transient":
             e: Exception = TransferFaultError(

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from siddhi_tpu.observability.histograms import LatencyHistogram
+
 
 class Level:
     OFF = "off"
@@ -32,24 +34,75 @@ class Level:
 
 
 class ThroughputTracker:
-    """Events-seen counter with a rate over the elapsed window
-    (reference: util/statistics/ThroughputTracker)."""
+    """Events-seen counter with a windowed rate
+    (reference: util/statistics/ThroughputTracker).
 
-    def __init__(self, name: str):
+    ``events_per_second`` reports a recent-window rate: finished
+    windows fold into an EMA, so the figure tracks what the stream is
+    doing NOW.  The historical count-over-total-elapsed figure — which
+    decays toward zero on any long-lived app whose traffic is not
+    perfectly uniform — stays available as
+    ``lifetime_events_per_second``.  ``clock`` is injectable for
+    tests."""
+
+    #: window width folded into the rate EMA
+    WINDOW_S = 5.0
+    #: EMA weight of the newest finished window
+    ALPHA = 0.3
+
+    def __init__(self, name: str, clock=time.monotonic):
         self.name = name
         self.count = 0
-        self._start = time.monotonic()
+        self._clock = clock
+        self._start = clock()
+        self._win_start = self._start
+        self._win_count = 0
+        self._rate_ema: Optional[float] = None
+
+    def _fold(self, now: float):
+        """Close the current window into the EMA when it is old enough.
+        A long idle stretch folds as several windows' worth at once —
+        the EMA weight compounds with the elapsed window count, so the
+        reported rate decays toward zero the way a live dashboard
+        should instead of lingering on stale traffic."""
+        dt = now - self._win_start
+        if dt < self.WINDOW_S:
+            return
+        rate = self._win_count / dt
+        alpha = 1.0 - (1.0 - self.ALPHA) ** (dt / self.WINDOW_S)
+        self._rate_ema = (rate if self._rate_ema is None
+                          else self._rate_ema + alpha
+                          * (rate - self._rate_ema))
+        self._win_start = now
+        self._win_count = 0
 
     def add(self, n: int):
         self.count += n
+        self._win_count += n
+        self._fold(self._clock())
 
     def events_per_second(self) -> float:
-        dt = time.monotonic() - self._start
+        """Windowed rate; before the first window closes it equals the
+        lifetime rate (identical to the historical read-out for young
+        trackers)."""
+        now = self._clock()
+        self._fold(now)
+        if self._rate_ema is None:
+            dt = now - self._start
+            return self.count / dt if dt > 0 else 0.0
+        return self._rate_ema
+
+    def lifetime_events_per_second(self) -> float:
+        """Historical semantics: total count over total elapsed time."""
+        dt = self._clock() - self._start
         return self.count / dt if dt > 0 else 0.0
 
     def reset(self):
         self.count = 0
-        self._start = time.monotonic()
+        self._start = self._clock()
+        self._win_start = self._start
+        self._win_count = 0
+        self._rate_ema = None
 
 
 class LatencyTracker:
@@ -63,6 +116,9 @@ class LatencyTracker:
         self.events = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        # fixed-bucket distribution behind the p50/p95/p99 read-outs
+        # (observability/histograms.py; also scraped by /metrics)
+        self.hist = LatencyHistogram()
         self._t0 = None
 
     def mark_in(self, n_events: int):
@@ -77,6 +133,7 @@ class LatencyTracker:
         self.batches += 1
         self.total_s += dt
         self.max_s = max(self.max_s, dt)
+        self.hist.record_s(dt)
 
     def avg_ms(self) -> float:
         return (self.total_s / self.batches) * 1000.0 if self.batches else 0.0
@@ -84,11 +141,21 @@ class LatencyTracker:
     def max_ms(self) -> float:
         return self.max_s * 1000.0
 
+    def p50_ms(self) -> float:
+        return self.hist.p50_ms()
+
+    def p95_ms(self) -> float:
+        return self.hist.p95_ms()
+
+    def p99_ms(self) -> float:
+        return self.hist.p99_ms()
+
     def reset(self):
         self.batches = 0
         self.events = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.hist.reset()
 
 
 class BufferedEventsTracker:
@@ -218,6 +285,10 @@ class StatisticsManager:
         self.hotkey_fallbacks: Dict[str, int] = {}
         self.hotkey_fallback_reasons: Dict[str, str] = {}
         self.hotkey_routers: Dict[str, object] = {}
+        # batch-cycle tracer (observability/trace.py); registered ungated
+        # at app build — stage_stats() only reports stages that actually
+        # recorded spans, so host-only apps keep an empty feed
+        self.tracer = None
         self._reporter: Optional[threading.Thread] = None
         self._running = False
         # generation counter: a restarted reporter invalidates the old
@@ -300,6 +371,11 @@ class StatisticsManager:
         self.multiplex_placements[qname] = (
             f"{fingerprint[:12]}:{occupied}")
 
+    def register_tracer(self, tracer):
+        """The app's batch-cycle tracer; its per-stage span histograms
+        join the feed as ``Stages.<stage>.<metric>`` keys."""
+        self.tracer = tracer
+
     def stats(self) -> Dict[str, object]:
         """Metric name -> value.  Values are floats except the
         ``Queries.<name>.loweredTo`` /
@@ -314,6 +390,9 @@ class StatisticsManager:
         for l in list(self.latency.values()):
             out[self._metric("Queries", l.name, "latencyAvgMs")] = l.avg_ms()
             out[self._metric("Queries", l.name, "latencyMaxMs")] = l.max_ms()
+            out[self._metric("Queries", l.name, "latencyP50Ms")] = l.p50_ms()
+            out[self._metric("Queries", l.name, "latencyP95Ms")] = l.p95_ms()
+            out[self._metric("Queries", l.name, "latencyP99Ms")] = l.p99_ms()
             out[self._metric("Queries", l.name, "events")] = l.events
         for b in list(self.buffers.values()):
             out[self._metric("Streams", b.name, "bufferedEvents")] = b.buffered()
@@ -356,6 +435,10 @@ class StatisticsManager:
         for qname, router in list(self.hotkey_routers.items()):
             for metric, v in router.hot_metrics().items():
                 out[self._metric("Queries", qname, metric)] = v
+        if self.tracer is not None:
+            for stage, metrics in self.tracer.stage_stats().items():
+                for metric, v in metrics.items():
+                    out[self._metric("Stages", stage, metric)] = v
         return out
 
     def reset(self):

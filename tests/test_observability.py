@@ -1,0 +1,508 @@
+"""Observability layer: cycle tracing, flight recorder, histograms,
+Prometheus exposition, and the statistics-manager hardening that rides
+along.
+
+The differential acceptance test kills a device app mid-stream with the
+fault injector and asserts the flight-recorder dump holds complete,
+correctly ordered ingest -> step -> emit spans for the final cycles —
+the black-box post-mortem the recorder exists for.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppCreationError,
+    SimulatedCrashError,
+)
+from siddhi_tpu.observability import (
+    FlightRecorder,
+    LatencyHistogram,
+    Tracer,
+    render_prometheus,
+)
+from siddhi_tpu.observability.prometheus import CONTENT_TYPE
+from siddhi_tpu.service import SiddhiService
+from siddhi_tpu.util.statistics import (
+    LatencyTracker,
+    StatisticsManager,
+    ThroughputTracker,
+)
+
+PATTERN_BODY = (
+    "define stream S (k long, v double); "
+    "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+    "select b.v as bv insert into Out;")
+
+
+def device_app(name, trace="", faults=""):
+    return (f"@app:name('{name}') @app:playback @app:execution('tpu') "
+            f"{trace}{faults}" + PATTERN_BODY)
+
+
+def make_batch(i, n=32, seed=3):
+    rng = np.random.default_rng(seed + i)
+    return EventBatch(
+        "S", ["k", "v"],
+        {"k": np.arange(n, dtype=np.int64) % 4,
+         "v": rng.uniform(0.0, 20.0, n)},
+        np.full(n, 1_000 + i * 10, dtype=np.int64))
+
+
+# -- histograms ---------------------------------------------------------------
+
+
+def test_histogram_quantiles():
+    h = LatencyHistogram()
+    for _ in range(100):
+        h.record_ms(0.75)  # lands in the (0.5, 1.0] bucket
+    assert h.count == 100
+    assert h.sum_ms == pytest.approx(75.0)
+    assert h.max_ms == pytest.approx(0.75)
+    # every quantile interpolates inside the landing bucket
+    assert 0.5 < h.p50_ms() <= 1.0
+    assert 0.5 < h.p99_ms() <= 1.0
+    h.reset()
+    assert h.count == 0 and h.sum_ms == 0.0 and h.p50_ms() == 0.0
+
+
+def test_histogram_spread_and_overflow():
+    h = LatencyHistogram()
+    for v in (0.06, 0.06, 0.06, 200.0, 200.0, 9_999.0):
+        h.record_ms(v)
+    # p50 lands among the 0.06ms samples, p99 in the tail
+    assert h.p50_ms() <= 0.25
+    assert h.p95_ms() > 100.0
+    # overflow bucket (beyond the last bound) reports the observed max
+    assert h.quantile_ms(0.999) == pytest.approx(9_999.0)
+    bounds, counts, sum_ms, count = h.snapshot()
+    assert count == 6 and sum(counts) == 6
+    assert len(bounds) == len(LatencyHistogram.BOUNDS_MS)
+
+
+def test_histogram_record_s_converts():
+    h = LatencyHistogram()
+    h.record_s(0.002)
+    assert h.max_ms == pytest.approx(2.0)
+
+
+# -- throughput tracker: windowed rate fix ------------------------------------
+
+
+def test_throughput_windowed_rate_tracks_recent_traffic():
+    now = [0.0]
+    t = ThroughputTracker("S", clock=lambda: now[0])
+    # 1000 ev/s for the first window
+    for _ in range(5):
+        t.add(1000)
+        now[0] += 1.0
+    first = t.events_per_second()
+    assert first == pytest.approx(1000.0, rel=0.05)
+    # then 45s of silence: the windowed rate decays toward zero while
+    # the lifetime rate only divides by the longer elapsed time
+    now[0] += 45.0
+    assert t.events_per_second() < t.lifetime_events_per_second()
+    assert t.events_per_second() < first * 0.1
+    assert t.lifetime_events_per_second() == pytest.approx(
+        5000.0 / 50.0, rel=0.01)
+    assert t.count == 5000
+
+
+def test_throughput_young_tracker_matches_lifetime():
+    now = [0.0]
+    t = ThroughputTracker("S", clock=lambda: now[0])
+    t.add(100)
+    now[0] += 1.0  # window not yet closed
+    assert t.events_per_second() == pytest.approx(
+        t.lifetime_events_per_second())
+
+
+def test_throughput_reset():
+    now = [0.0]
+    t = ThroughputTracker("S", clock=lambda: now[0])
+    t.add(100)
+    now[0] += 10.0
+    t.events_per_second()
+    t.reset()
+    assert t.count == 0
+    assert t.events_per_second() == 0.0
+    assert t.lifetime_events_per_second() == 0.0
+
+
+# -- latency tracker percentiles ----------------------------------------------
+
+
+def test_latency_tracker_percentiles_ride_along():
+    lt = LatencyTracker("q")
+    for _ in range(10):
+        lt.mark_in(4)
+        lt.mark_out(4)
+    # existing keys keep their semantics
+    assert lt.batches == 10 and lt.events == 40
+    assert lt.avg_ms() >= 0.0 and lt.max_ms() >= lt.avg_ms()
+    # new percentile read-outs come from the histogram
+    assert lt.hist.count == 10
+    assert lt.p50_ms() >= 0.0
+    assert lt.p99_ms() >= lt.p50_ms()
+    lt.reset()
+    assert lt.hist.count == 0 and lt.p50_ms() == 0.0
+
+
+def test_statistics_feed_has_percentile_keys():
+    sm = StatisticsManager("app")
+    lt = sm.latency_tracker("q")
+    lt.mark_in(2)
+    lt.mark_out(2)
+    st = sm.stats()
+    base = "io.siddhi.SiddhiApps.app.Siddhi.Queries.q."
+    for metric in ("latencyAvgMs", "latencyMaxMs", "latencyP50Ms",
+                   "latencyP95Ms", "latencyP99Ms", "events"):
+        assert base + metric in st
+
+
+# -- tracer sampling ----------------------------------------------------------
+
+
+def test_tracer_sampling_strides():
+    t = Tracer("app", sample=4)
+    toks = [t.begin_cycle("device", 1) for _ in range(8)]
+    sampled = [tok for tok in toks if tok is not None]
+    # ids 1..8: only 4 and 8 hit the 1-in-4 stride
+    assert [tok.cycle for tok in sampled] == [4, 8]
+    assert Tracer("app", sample=0).begin_cycle("device", 1) is None
+    every = Tracer("app", sample=1)
+    assert all(every.begin_cycle("device", 1) is not None
+               for _ in range(5))
+
+
+def test_tracer_stage_stats_only_reports_recorded_stages():
+    t = Tracer("app", sample=1)
+    assert t.stage_stats() == {}
+    tok = t.begin_cycle("device", 8)
+    tok.dispatched()
+    assert sorted(t.stage_stats()) == ["ingest"]
+    assert t.stage_stats()["ingest"]["spans"] == 1
+
+
+def test_trace_annotation_parse_errors():
+    m = SiddhiManager()
+    try:
+        for ann in ("@app:trace(sample='2/3') ",
+                    "@app:trace(sample='bogus') ",
+                    "@app:trace(sample='0') ",
+                    "@app:trace(cycles='0') ",
+                    "@app:trace(cycles='99999') "):
+            with pytest.raises(SiddhiAppCreationError):
+                m.create_siddhi_app_runtime(
+                    device_app("badtrace", trace=ann), register=False)
+    finally:
+        m.shutdown()
+
+
+def test_trace_annotation_configures_tracer(tmp_path):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(device_app(
+            "anntrace",
+            trace=f"@app:trace(sample='1/8', cycles='16', "
+                  f"dir='{tmp_path}') "), register=False)
+        tr = rt.app_context.tracer
+        assert tr.sample == 8
+        assert tr.recorder.cycles == 16
+        assert tr.recorder.dump_dir == str(tmp_path)
+        assert rt.app_context.statistics_manager.tracer is tr
+        rt.shutdown()
+        # default-on: no annotation still builds a sampled tracer
+        rt2 = m.create_siddhi_app_runtime(
+            device_app("anntrace2"), register=False)
+        assert rt2.app_context.tracer.sample == Tracer.DEFAULT_SAMPLE
+        rt2.shutdown()
+    finally:
+        m.shutdown()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_recorder_ring_evicts_to_newest_cycles():
+    r = FlightRecorder("app", cycles=2)  # ring depth 2*4 spans
+    for c in range(1, 6):
+        for stage in ("ingest", "step", "emit"):
+            r.record((c, stage, "device", 0.0, 1.0, 1))
+    groups = r.cycle_groups()
+    # oldest cycles evicted, newest complete
+    assert list(groups)[-1] == 5
+    assert [s[1] for s in groups[5]] == ["ingest", "step", "emit"]
+    assert len(r.spans()) == r.ring.maxlen
+
+
+def test_recorder_dump_writes_json(tmp_path):
+    r = FlightRecorder("app", cycles=4, dump_dir=str(tmp_path))
+    r.record((1, "ingest", "device", 0.0, 1.0, 8))
+    payload = r.dump("unit-test")
+    assert r.last_dump is payload
+    assert payload["reason"] == "unit-test"
+    files = list(tmp_path.glob("app-*-unit-test.json"))
+    assert len(files) == 1
+    on_disk = json.loads(files[0].read_text())
+    assert on_disk["spans"][0]["stage"] == "ingest"
+    assert on_disk["spans"][0]["n_events"] == 8
+
+
+def test_recorder_dump_file_cap(tmp_path):
+    r = FlightRecorder("app", cycles=4, dump_dir=str(tmp_path))
+    for i in range(FlightRecorder.MAX_DUMP_FILES + 5):
+        r.dump(f"r{i}")
+    assert len(list(tmp_path.glob("*.json"))) == FlightRecorder.MAX_DUMP_FILES
+    # in-memory dump keeps updating past the file cap
+    assert r.last_dump["reason"] == f"r{FlightRecorder.MAX_DUMP_FILES + 4}"
+
+
+def test_chrome_trace_export():
+    t = Tracer("app", sample=1)
+    tok = t.begin_cycle("device", 8)
+    tok.dispatched()
+    tok.step_done(3)
+    tok.emitted(t.clock())
+    ch = t.recorder.chrome_trace()
+    events = ch["traceEvents"]
+    assert [e["ph"] for e in events] == ["X", "X", "X"]
+    assert all(e["dur"] >= 0.0 and e["ts"] > 0.0 for e in events)
+    # stages map to distinct tids (stacked tracks)
+    assert len({e["tid"] for e in events}) == 3
+    assert events[0]["args"]["cycle"] == 1
+    assert ch["otherData"]["app"] == "app"
+
+
+# -- differential: fault-injector kill dumps ordered cycles -------------------
+
+
+def test_crash_dump_has_complete_ordered_final_cycles(tmp_path):
+    """Kill the app mid-stream; the flight recorder must hold complete
+    ingest -> step -> emit span triples for the final cycles, correctly
+    ordered within and across cycles."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(device_app(
+            "crashbox",
+            trace=f"@app:trace(sample='1', cycles='8', dir='{tmp_path}') ",
+            faults="@app:faults(step.dense='crash:after=6') "))
+        rt.start()
+        h = rt.get_input_handler("S")
+        with pytest.raises(SimulatedCrashError):
+            for i in range(20):
+                h.send_batch(make_batch(i))
+        dump = rt.app_context.tracer.recorder.last_dump
+        assert dump is not None
+        assert dump["reason"].startswith("fault-injector-crash:")
+        spans = dump["spans"]
+        assert spans, "crash dump must carry the span ring"
+        by_cycle = {}
+        for s in spans:
+            by_cycle.setdefault(s["cycle"], []).append(s)
+        cycles = list(by_cycle)
+        assert cycles == sorted(cycles), "cycles must appear in order"
+        # every cycle except the one the crash interrupted is a
+        # complete, ordered ingest -> step -> emit triple
+        for cid in cycles[:-1]:
+            group = by_cycle[cid]
+            assert [s["stage"] for s in group] == ["ingest", "step",
+                                                   "emit"], cid
+            starts = [s["t_start"] for s in group]
+            assert starts == sorted(starts), cid
+            assert all(s["t_end"] >= s["t_start"] for s in group)
+            assert group[0]["n_events"] == 32
+        # the dump also survived to disk
+        files = list(tmp_path.glob("crashbox-*.json"))
+        assert files and json.loads(files[0].read_text())["spans"]
+    finally:
+        m.shutdown()
+
+
+# -- prometheus exposition ----------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"            # metric name
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|\+Inf|NaN))$")
+
+
+def assert_valid_exposition(body):
+    """Minimal text-format 0.0.4 validator: every line is a well-formed
+    comment or sample, each family's # TYPE appears exactly once before
+    its samples, histogram series are cumulative and consistent."""
+    typed = {}
+    seen_families = set()
+    hist_buckets = {}
+    hist_counts = {}
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[1] == "TYPE", line
+            family, kind = parts[2], parts[3]
+            assert family not in typed, f"duplicate TYPE for {family}"
+            assert kind in ("gauge", "counter", "histogram"), line
+            typed[family] = kind
+            continue
+        mm = _SAMPLE.match(line)
+        assert mm, f"malformed sample line: {line!r}"
+        name, labels, value = mm.group(1), mm.group(2) or "", mm.group(3)
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        base = name if name in typed else family
+        assert base in typed, f"sample {name} precedes its # TYPE"
+        seen_families.add(base)
+        if typed[base] == "histogram":
+            if name.endswith("_bucket"):
+                series = re.sub(r',?le="[^"]*"', "", labels)
+                le = re.search(r'le="([^"]*)"', labels).group(1)
+                hist_buckets.setdefault((base, series), []).append(
+                    (le, float(value)))
+            elif name.endswith("_count"):
+                series = labels
+                hist_counts[(base, series)] = float(value)
+    for key, buckets in hist_buckets.items():
+        counts = [c for _le, c in buckets]
+        assert counts == sorted(counts), f"non-cumulative buckets: {key}"
+        assert buckets[-1][0] == "+Inf", f"missing +Inf bucket: {key}"
+        assert hist_counts.get(key) == buckets[-1][1], key
+    return seen_families
+
+
+def test_render_prometheus_shapes():
+    h = LatencyHistogram()
+    h.record_ms(0.7)
+    stats = {
+        "io.siddhi.SiddhiApps.a.Siddhi.Streams.S.throughput": 12.5,
+        "io.siddhi.SiddhiApps.a.Siddhi.Queries.q.loweredTo": "dense",
+        "weird.key": 1,
+    }
+    body = render_prometheus(
+        [("a", stats, [("siddhi_query_latency_ms", {"app": "a",
+                                                    "name": "q"}, h)])])
+    fams = assert_valid_exposition(body)
+    assert "siddhi_streams_throughput" in fams
+    assert "siddhi_queries_lowered_to_info" in fams  # string -> _info gauge
+    assert "siddhi_metric" in fams                   # catch-all
+    assert "siddhi_query_latency_ms" in fams
+    assert 'value="dense"' in body
+
+
+def test_render_prometheus_empty():
+    assert render_prometheus([]) == "\n"
+
+
+def test_service_metrics_and_trace_endpoints():
+    svc = SiddhiService()
+    svc.start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        # no apps yet: /metrics still serves a valid (empty) page
+        resp = urllib.request.urlopen(f"{base}/metrics")
+        assert resp.headers["Content-Type"] == CONTENT_TYPE
+        req = urllib.request.Request(
+            f"{base}/siddhi-artifact-deploy",
+            data=device_app("svcapp",
+                            trace="@app:trace(sample='1') ").encode(),
+            method="POST")
+        assert json.load(urllib.request.urlopen(req))["status"] == "OK"
+        rt = svc.get_runtime("svcapp")
+        h = rt.get_input_handler("S")
+        for i in range(4):
+            h.send_batch(make_batch(i))
+        rt.drain_device_emits()
+
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        fams = assert_valid_exposition(body)
+        assert "siddhi_stage_duration_ms" in fams
+        assert 'app="svcapp"' in body
+
+        tr = json.load(urllib.request.urlopen(
+            f"{base}/siddhi-trace/svcapp"))
+        assert tr["status"] == "OK" and tr["sample"] == 1
+        stages = [s["stage"] for s in tr["trace"]["spans"]]
+        assert {"ingest", "step", "emit"} <= set(stages)
+
+        ch = json.load(urllib.request.urlopen(
+            f"{base}/siddhi-trace/svcapp?format=chrome"))
+        assert ch["traceEvents"] and ch["traceEvents"][0]["ph"] == "X"
+    finally:
+        svc.stop()
+
+
+def test_service_404_paths():
+    svc = SiddhiService()
+    svc.start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        for path in ("/siddhi-trace/nope", "/siddhi-statistics/nope",
+                     "/siddhi-pattern-state/nope", "/nonsense"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + path)
+            assert ei.value.code == 404, path
+    finally:
+        svc.stop()
+
+
+# -- statistics manager reporting loop ----------------------------------------
+
+
+def _stats_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("stats-")]
+
+
+def test_reporting_loop_start_stop_idempotent():
+    sm = StatisticsManager("looper", interval_s=0.05)
+    before = len(_stats_threads())
+    sm.start_reporting()
+    sm.start_reporting()  # second start is a no-op
+    assert len(_stats_threads()) == before + 1
+    reporter = sm._reporter
+    sm.stop_reporting()
+    sm.stop_reporting()  # second stop is a no-op
+    reporter.join(timeout=2.0)
+    assert not reporter.is_alive(), "reporter thread must exit on stop"
+    # restart spins up a fresh generation, old thread stays dead
+    sm.start_reporting()
+    assert sm._reporter is not reporter
+    sm.stop_reporting()
+    sm._reporter.join(timeout=2.0)
+    assert len(_stats_threads()) == before
+
+
+def test_reporting_loop_survives_stats_error():
+    sm = StatisticsManager("angry", interval_s=0.01)
+    sm.throughput["boom"] = None  # stats() raises AttributeError
+    sm.start_reporting()
+    try:
+        time.sleep(0.1)
+        assert sm._reporter.is_alive(), "reporter must survive bad stats"
+    finally:
+        sm.stop_reporting()
+        sm._reporter.join(timeout=2.0)
+
+
+def test_statistics_manager_reset_clears_trackers():
+    sm = StatisticsManager("resetme")
+    tt = sm.throughput_tracker("S")
+    lt = sm.latency_tracker("q")
+    tt.add(100)
+    lt.mark_in(4)
+    lt.mark_out(4)
+    sm.reset()
+    assert tt.count == 0
+    assert lt.batches == 0 and lt.hist.count == 0
+    # reset is idempotent and leaves the feed serviceable
+    sm.reset()
+    assert isinstance(sm.stats(), dict)
