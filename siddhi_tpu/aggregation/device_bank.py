@@ -40,16 +40,33 @@ shapes ride the bank exactly:
 
 * INT "min"/"max" fields ride as single int32 rows at native width
   (INT is exactly int32), with the int32 extrema as identities; the
-  flush merge reads them back as exact ints.  LONG min/max values can
-  exceed int32 and stay on the host path.
+  flush merge reads them back as exact ints.
+
+* LONG "min"/"max" fields ride as a LEXICOGRAPHIC hi/lo int32 pair:
+  hi is the signed high word (``v >> 32``) and lo the bias-signed low
+  word (``(v & 0xFFFFFFFF) - 2**31`` — signed int32 compare of the
+  biased value equals unsigned compare of the raw low bits), so
+  comparing (hi, lo) pairs lexicographically is the exact signed
+  64-bit compare.  The scatter updates the pair in two passes — hi
+  extrema first, then lo extrema among events whose hi TIES the new
+  per-row hi — and the flush merge recombines
+  ``hi * 2**32 + (lo + 2**31)`` exactly.  Extrema never accumulate,
+  so no overflow guard is needed (``long_overflow_risk`` watches only
+  the sum pairs).
 
 * bare "count" fields (no avg/stdDev rewrite) ride exactly like the
   avg/stdDev count denominators — float32 add rows guarded by
   ``count_overflow_risk`` — so a count-only select no longer forces
   the host reduction.
 
-Remaining integer shapes (LONG min/max, last/set) keep the exact host
-numpy scatter ufuncs at native width.
+Remaining integer shapes (last/set) keep the exact host numpy scatter
+ufuncs at native width.
+
+``use_kernel`` swaps the jitted ``.at[rows].add/min/max`` scatter for
+the Pallas segmented-reduce kernel (siddhi_tpu/kernels/bank_scatter.py)
+— same per-row results on int and extrema lanes bit-exactly (order-free
+ops); f32 SUM lanes may associate differently than the scatter's
+collision rounds, within the same documented f32 contract.
 
 Row layout: ``cap`` assignable rows + one dump row (index ``cap``) that
 absorbs padded lanes and out-of-order events, which take the host
@@ -95,17 +112,21 @@ class DeviceBucketBank:
     (bucket_start, group_key) -> row index shared by every lane.
     """
 
-    def __init__(self, fields, cap: int = 4096):
+    def __init__(self, fields, cap: int = 4096, use_kernel: bool = False):
         self.fields = list(fields)
         self.names: List[str] = [f.name for f in self.fields]
         self.ops: Tuple[str, ...] = tuple(f.op for f in self.fields)
         self.cap = int(cap)
+        # @app:kernels('bank'): Pallas segmented-reduce scatter instead
+        # of .at[rows].add/min/max (module docstring)
+        self.use_kernel = bool(use_kernel)
         self.rows: Dict[Tuple[int, Tuple], int] = {}
         self._free: List[int] = list(range(self.cap))
         self._arrays = None  # per-lane jnp [cap+1]; lazy (jax import)
         self._scatter = None
         # lane plan: each field owns one float32 row, except LONG sums
-        # which own an exact hi/lo int32 pair (module docstring)
+        # and LONG extrema which own an exact hi/lo int32 pair
+        # (module docstring)
         self._lanes: List[Tuple[str, str]] = []  # (op, "f32"|"i32")
         self._field_lanes: List[Tuple[int, ...]] = []
         for f in self.fields:
@@ -113,6 +134,12 @@ class DeviceBucketBank:
                 self._field_lanes.append((len(self._lanes),
                                           len(self._lanes) + 1))
                 self._lanes += [("sum", "i32"), ("sum", "i32")]
+            elif f.op in ("min", "max") and f.type == AttrType.LONG:
+                # LONG extrema: lexicographic hi/lo int32 pair — exact
+                # signed compare at full 64-bit width (module docstring)
+                self._field_lanes.append((len(self._lanes),
+                                          len(self._lanes) + 1))
+                self._lanes += [(f.op, "i32"), (f.op, "i32")]
             elif f.op in ("min", "max") and f.type == AttrType.INT:
                 # INT extrema fit int32 natively — exact, no pair split
                 self._field_lanes.append((len(self._lanes),))
@@ -120,9 +147,11 @@ class DeviceBucketBank:
             else:
                 self._field_lanes.append((len(self._lanes),))
                 self._lanes.append((f.op, "f32"))
+        # LONG-sum pairs only: extrema pairs never accumulate, so they
+        # need no overflow guard and no recombine-by-65536
         self.long_names: List[str] = [
             f.name for f, ln in zip(self.fields, self._field_lanes)
-            if len(ln) == 2
+            if len(ln) == 2 and f.op == "sum"
         ]
         # flush-barrier evidence for tests/bench: ingest batches absorbed
         # on device vs host materializations
@@ -192,18 +221,82 @@ class DeviceBucketBank:
     def _scatter_fn(self):
         if self._scatter is None:
             import jax
+            import jax.numpy as jnp
 
             lanes = tuple(self._lanes)
+            cap1 = self.cap + 1
+            # hi-lane index -> op for LONG extrema pairs: their two
+            # lanes update together lexicographically, unlike the
+            # LONG-sum pairs whose lanes stay independent adds
+            pair_ops: Dict[int, str] = {}
+            for fi, fl in enumerate(self._field_lanes):
+                if len(fl) == 2 and self.ops[fi] in ("min", "max"):
+                    pair_ops[fl[0]] = self.ops[fi]
+
+            if self.use_kernel:
+                from siddhi_tpu.kernels import bank_scatter, probe
+
+                r_pad = bank_scatter.pad_rows(cap1)
+                interp = probe.interpret_mode()
+
+                def reduce_delta(rows, v, op, ident):
+                    d = bank_scatter.segmented_reduce(
+                        rows, v, r_pad, op, ident, interp)
+                    return d[:cap1]
+
+            else:
+                reduce_delta = None
+
+            def upd(a, rows, v, op, kind):
+                if reduce_delta is not None:
+                    ident = (_I32_IDENTITY[op] if kind == "i32"
+                             else _IDENTITY[op])
+                    d = reduce_delta(rows, v, op, ident)
+                    if op in ("sum", "count"):
+                        return a + d
+                    return jnp.minimum(a, d) if op == "min" else (
+                        jnp.maximum(a, d))
+                if op in ("sum", "count"):
+                    return a.at[rows].add(v)
+                return a.at[rows].min(v) if op == "min" else (
+                    a.at[rows].max(v))
+
+            def pair_update(a_hi, a_lo, rows, vh, vl, op):
+                # lexicographic (hi, lo) extrema: hi decides; lo
+                # competes only where its hi TIES the row's new hi
+                # winner.  min/max over ints is order-free, so the
+                # kernel and scatter paths are bit-identical.
+                ident = _I32_IDENTITY[op]
+                comb = jnp.minimum if op == "min" else jnp.maximum
+                if reduce_delta is not None:
+                    new_hi = comb(a_hi, reduce_delta(rows, vh, op, ident))
+                elif op == "min":
+                    new_hi = a_hi.at[rows].min(vh)
+                else:
+                    new_hi = a_hi.at[rows].max(vh)
+                cand = jnp.where(vh == new_hi[rows], vl, ident)
+                base = jnp.where(a_hi == new_hi, a_lo, ident)
+                if reduce_delta is not None:
+                    new_lo = comb(base, reduce_delta(rows, cand, op, ident))
+                elif op == "min":
+                    new_lo = base.at[rows].min(cand)
+                else:
+                    new_lo = base.at[rows].max(cand)
+                return new_hi, new_lo
 
             def fn(arrays, rows, vals):
-                out = []
-                for (op, _kind), a, v in zip(lanes, arrays, vals):
-                    if op in ("sum", "count"):
-                        out.append(a.at[rows].add(v))
-                    elif op == "min":
-                        out.append(a.at[rows].min(v))
-                    else:
-                        out.append(a.at[rows].max(v))
+                out = list(arrays)
+                li = 0
+                while li < len(lanes):
+                    if li in pair_ops:
+                        out[li], out[li + 1] = pair_update(
+                            arrays[li], arrays[li + 1], rows,
+                            vals[li], vals[li + 1], pair_ops[li])
+                        li += 2
+                        continue
+                    op, kind = lanes[li]
+                    out[li] = upd(arrays[li], rows, vals[li], op, kind)
+                    li += 1
                 return out
 
             self._scatter = jax.jit(fn)
@@ -240,7 +333,7 @@ class DeviceBucketBank:
         vals = []
         for fi, (name, op) in enumerate(zip(self.names, self.ops)):
             lanes = self._field_lanes[fi]
-            if len(lanes) == 2:
+            if len(lanes) == 2 and op == "sum":
                 # LONG sum: exact signed hi/lo split (padded lanes add
                 # the identity 0 to the dump row)
                 v = np.asarray(fvals[name]).astype(np.int64)
@@ -251,6 +344,16 @@ class DeviceBucketBank:
                 vals += [jnp.asarray(hi), jnp.asarray(lo)]
                 self._long_hi_used[name] = (
                     self._long_hi_used.get(name, 0) + self._hi_bound(v, n))
+            elif len(lanes) == 2:
+                # LONG extrema: lexicographic split — signed high word,
+                # bias-signed low word (signed int32 compare of the
+                # biased lo == unsigned compare of the raw low bits)
+                v = np.asarray(fvals[name]).astype(np.int64)
+                hi = np.full(n_pad, _I32_IDENTITY[op], dtype=np.int32)
+                lo = np.full(n_pad, _I32_IDENTITY[op], dtype=np.int32)
+                hi[:n] = (v >> 32).astype(np.int32)
+                lo[:n] = ((v & 0xFFFFFFFF) - (1 << 31)).astype(np.int32)
+                vals += [jnp.asarray(hi), jnp.asarray(lo)]
             elif self._lanes[lanes[0]][1] == "i32":
                 # single int32 lane (INT min/max): native-width exact
                 col = np.full(n_pad, _I32_IDENTITY[op], dtype=np.int32)
@@ -282,11 +385,16 @@ class DeviceBucketBank:
             values: Dict[str, float] = {}
             for fi, name in enumerate(self.names):
                 lanes = self._field_lanes[fi]
-                if len(lanes) == 2:
-                    # exact int recombination of the hi/lo pair
+                if len(lanes) == 2 and self.ops[fi] == "sum":
+                    # exact int recombination of the sum hi/lo pair
                     values[name] = (
                         int(host[lanes[0]][row]) * (_LONG_LO_MAX + 1)
                         + int(host[lanes[1]][row]))
+                elif len(lanes) == 2:
+                    # lexicographic extrema pair: undo the bias split
+                    values[name] = (
+                        int(host[lanes[0]][row]) * (1 << 32)
+                        + (int(host[lanes[1]][row]) + (1 << 31)))
                 elif self._lanes[lanes[0]][1] == "i32":
                     values[name] = int(host[lanes[0]][row])
                 else:

@@ -424,10 +424,10 @@ class AggregationRuntime:
         # device-resident ingest (tpu mode): float sum/min/max base
         # fields of running finest buckets accumulate in device rows,
         # LONG sums (``sum(intcol)`` widens INT→LONG) in exact hi/lo
-        # int32 pair rows, and both materialize to the host store only
-        # at flush barriers (aggregation/device_bank.py); remaining
-        # integer/last/set fields keep the exact host path at native
-        # width
+        # int32 pair rows, LONG extrema in exact lexicographic hi/lo
+        # pairs, and all materialize to the host store only at flush
+        # barriers (aggregation/device_bank.py); remaining last/set
+        # fields keep the exact host path at native width
         self._bank = None
         if self._device_segments:
             bank_fields = [
@@ -435,7 +435,8 @@ class AggregationRuntime:
                 if (f.op in ("sum", "min", "max")
                     and f.type in (AttrType.FLOAT, AttrType.DOUBLE))
                 or (f.op == "sum" and f.type == AttrType.LONG)
-                or (f.op in ("min", "max") and f.type == AttrType.INT)
+                or (f.op in ("min", "max")
+                    and f.type in (AttrType.INT, AttrType.LONG))
             ]
             # avg(x) over a numeric argument rewrites to _SUM/_COUNT
             # and stdDev(x) to _SUM/_SUMSQ/_COUNT (the sumsq row is a
@@ -457,7 +458,20 @@ class AggregationRuntime:
                     DeviceBucketBank,
                 )
 
-                self._bank = DeviceBucketBank(bank_fields)
+                # @app:kernels('bank'): Pallas segmented-reduce scatter
+                # when the capability probe + smoke lowering pass;
+                # otherwise a counted fallback to the XLA scatter
+                ctx = app_planner.app_context
+                use_kernel = False
+                if getattr(ctx, "kernels", False) and (
+                        "bank" in getattr(ctx, "kernel_kinds", ())):
+                    from siddhi_tpu.planner.kernels import (
+                        try_enable_bank_kernel,
+                    )
+
+                    use_kernel = try_enable_bank_kernel(ctx, self.name)
+                self._bank = DeviceBucketBank(
+                    bank_fields, use_kernel=use_kernel)
 
         self.output_definition = StreamDefinition(
             id=self.name, attributes=[Attribute(AGG_START_TS, AttrType.LONG)] + out_attrs
@@ -728,10 +742,17 @@ class AggregationRuntime:
                 acc = np.zeros(U, dtype=v.dtype)
                 np.add.at(acc, ids[mask], v[mask])
             elif op == "min":
-                acc = np.full(U, np.inf, dtype=v.dtype)
+                # integer dtypes cannot hold inf — use the exact dtype
+                # extrema as identities (mirrors _reduce_segments)
+                ident = (np.iinfo(v.dtype).max
+                         if np.issubdtype(v.dtype, np.integer) else np.inf)
+                acc = np.full(U, ident, dtype=v.dtype)
                 np.minimum.at(acc, ids[mask], v[mask])
             else:
-                acc = np.full(U, -np.inf, dtype=v.dtype)
+                ident = (np.iinfo(v.dtype).min
+                         if np.issubdtype(v.dtype, np.integer)
+                         else -np.inf)
+                acc = np.full(U, ident, dtype=v.dtype)
                 np.maximum.at(acc, ids[mask], v[mask])
             out[name] = [x.item() for x in acc]
         return out

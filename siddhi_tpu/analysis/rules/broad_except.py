@@ -23,7 +23,8 @@ from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
 
 SCANNED_DIRS = ("siddhi_tpu/core/", "siddhi_tpu/transport/",
-                "siddhi_tpu/durability/", "siddhi_tpu/observability/")
+                "siddhi_tpu/durability/", "siddhi_tpu/observability/",
+                "siddhi_tpu/kernels/")
 
 BROAD = {"Exception", "BaseException"}
 

@@ -140,6 +140,14 @@ class SiddhiAppContext:
         self.hotkey_k = 8
         self.hotkey_promote = 0.25
         self.hotkey_demote = 0.10
+        # @app:kernels('nfa,bank,scan'): swap the hot inner step of
+        # eligible runtimes for hand-written Pallas kernels
+        # (siddhi_tpu/kernels/), each pinned bit-identical to the XLA
+        # formulation it replaces (planner/kernels.py).  Off by
+        # default; ineligible/unlowertable cases fall back with counted
+        # kernelFallbackReasons.
+        self.kernels = False
+        self.kernel_kinds = ("nfa", "bank", "scan")
         # @app:persist(interval='30 sec', mode='async'): default persist()
         # mode ('sync' keeps the historical stop-the-world behavior;
         # 'async' captures under the barrier and writes on the checkpoint

@@ -1,0 +1,20 @@
+"""Hand-written Pallas kernels for the hot step (``@app:kernels``).
+
+Three kernels replace the XLA-compiled hot loops, each pinned
+bit-identical to the path it replaces and gated behind the planner the
+same way the shard/multiplex/fuse/hotkey paths are:
+
+- ``dense_step``  — bit-packed dense-NFA step: 32 batch rows' boolean
+  node activity per int32 lane (``plane_pack`` holds the layout and
+  the host converters that round-trip ``DensePatternEngine`` state).
+- ``bank_scatter`` — collision-free segmented reduce for the
+  aggregation device bank, replacing the serializing scatter-add.
+- ``scan_chain``  — one fused kernel for the hotkey scan's max-plus
+  matrix chain + counting chain, replacing the two-pass
+  ``associative_scan``.
+
+Kernels compile via ``jax.experimental.pallas`` on TPU and run under
+``interpret=True`` everywhere else; ``probe.kernels_available()`` is
+the capability gate and every unavailable/ineligible engine falls back
+to the XLA path with a counted ``kernelFallbackReason``.
+"""

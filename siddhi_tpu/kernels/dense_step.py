@@ -1,0 +1,324 @@
+"""Bit-packed Pallas step for the simple every-chain dense NFA class.
+
+The eligible class (gated in ``planner/kernels.py``) is the capture-free
+every-start chain: all nodes are plain stream states (``min==max==1``),
+no sequences, no group-every, no absent deadlines, no register slots, no
+mesh.  Inside that class the XLA step's carry shrinks to two arrays —
+node activity and the within anchor — and node activity packs 32 batch
+rows per int32 word: bit ``b`` of word ``w`` is batch row ``w*32 + b``
+(the collision rounds upstream guarantee each partition appears once
+per dispatch, so a batch row IS a partition for the step's purposes).
+``counts``/``regs`` are provably constant in this class and pass
+through the state dict untouched, so snapshot/restore, sharding, and
+the multiplex seat tiling keep seeing the existing layout.
+
+The kernel mirrors the XLA step operation for operation — within
+expiry, the reversed node sweep, the rank-matched placement
+(``_rank_place``) and the overflow count — on packed planes, so
+detections, anchors, and overflow counters are bit-identical (pure
+boolean/int32 arithmetic; there is no float in the whole step).
+Candidate filters are lane-uniform in this class and are evaluated on
+the XLA side into one packed eligibility word row per node; output
+columns are pure per-event selects and are assembled outside the
+kernel from the emit mask, exactly as ``_emit_rows`` writes them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from siddhi_tpu.planner.expr import N_KEY, TS_KEY
+from siddhi_tpu.query_api import AttrType
+
+_INT_TYPES = (AttrType.INT, AttrType.LONG)
+
+# single-block ceiling: batches up to this size run as one grid point;
+# larger batches tile in 1024-row blocks (32 words) along the grid
+MAX_SINGLE_BLOCK = 1024
+
+
+def _batch_blocks(B: int) -> Tuple[int, int, int]:
+    """(padded batch, total words, words per block) for a batch of B."""
+    Bp = ((B + 31) // 32) * 32
+    if Bp <= MAX_SINGLE_BLOCK:
+        return Bp, Bp // 32, Bp // 32
+    Bp = ((Bp + MAX_SINGLE_BLOCK - 1) // MAX_SINGLE_BLOCK) * MAX_SINGLE_BLOCK
+    return Bp, Bp // 32, MAX_SINGLE_BLOCK // 32
+
+
+def build_packed_nfa(engine, stream_key: str, jit: bool = True):
+    """Kernel-backed replacement for ``DensePatternEngine.make_step``.
+
+    Same signature and same returns as the XLA step; only callable for
+    engines that passed ``check_dense_kernel_eligible``.
+    """
+    jax, jnp = engine.jax, engine.jnp
+    from jax.experimental import pallas as pl
+
+    from siddhi_tpu.kernels import probe
+    from siddhi_tpu.kernels.plane_pack import pack_bits, unpack_bits
+
+    S, I = engine.S, engine.I
+    nodes = engine.nodes
+    node_filters = engine.node_filters
+    within = engine.within_ms
+    out_spec = engine.out_spec
+    out_int = engine.out_int
+    O = max(len(out_spec), 1)
+    n_iout = sum(out_int)
+    scratch_row = engine.n_partitions
+    interpret = probe.interpret_mode()
+    on_stream = [n.specs[0].stream_key == stream_key for n in nodes]
+    int_out_idx: Dict[int, int] = {}
+    for _oi, _isint in enumerate(out_int):
+        if _isint:
+            int_out_idx[_oi] = len(int_out_idx)
+
+    _calls: Dict[Tuple[int, int], object] = {}
+
+    def _pallas_call(W: int, WB: int):
+        call = _calls.get((W, WB))
+        if call is not None:
+            return call
+        BB = WB * 32
+        i32 = jnp.int32
+
+        def kernel(ok_ref, a_ref, first_ref, ts_ref,
+                   a_out, first_out, emit_out, anch_out, ovf_out):
+            ok = ok_ref[...]          # [S, WB] packed (valid pre-ANDed)
+            A = a_ref[...]            # [S*I, WB] packed activity
+            FT = first_ref[...]       # [S*I, BB] anchors
+            ts = ts_ref[...]          # [1, BB]
+            a = {s: A[s * I:(s + 1) * I, :] for s in range(S)}
+            first = {s: FT[s * I:(s + 1) * I, :] for s in range(S)}
+
+            if within is not None:
+                for s in range(S):
+                    fs = first[s]
+                    expired = (fs > 0) & ((ts - fs) > within)
+                    a[s] = a[s] & ~pack_bits(jax, jnp, expired)
+                    first[s] = jnp.where(expired, 0, fs)
+
+            # the standing virgin: instance lane 0 of node 0, every row
+            row_i = jax.lax.broadcasted_iota(i32, (I, WB), 0)
+            lane0_pk = jnp.where(row_i == 0, i32(-1), i32(0))
+
+            emit_pk = jnp.zeros((I, WB), i32)
+            anch = jnp.zeros((I, BB), i32)
+            ovf = jnp.zeros((1, BB), i32)
+            for s in reversed(range(S)):
+                if not on_stream[s]:
+                    continue
+                pend = a[s] | lane0_pk if s == 0 else a[s]
+                fire_pk = pend & ok[s:s + 1, :]
+                fire = unpack_bits(jax, jnp, fire_pk)  # [I, BB]
+                if s == 0:
+                    # fresh arming each event: anchor is THIS event
+                    first[0] = jnp.where(fire, ts, first[0])
+                else:
+                    first[s] = jnp.where(fire & (first[s] == 0), ts,
+                                         first[s])
+                    a[s] = a[s] & ~fire_pk
+                anchor = jnp.where(first[s] > 0, first[s], ts)  # [I, BB]
+                if s == S - 1:
+                    emit_pk = emit_pk | fire_pk
+                    anch = jnp.where(fire, anchor, anch)
+                    continue
+                # rank-matched placement into node s+1 (_rank_place with
+                # counts == 0: free lanes are just the inactive ones)
+                free = unpack_bits(jax, jnp, ~a[s + 1])  # [I, BB]
+                fire_i = fire.astype(i32)
+                free_i = free.astype(i32)
+                src_rank = jnp.cumsum(fire_i, axis=0) - 1
+                free_rank = jnp.cumsum(free_i, axis=0) - 1
+                n_free = jnp.sum(free_i, axis=0, keepdims=True)  # [1, BB]
+                placed = fire & (src_rank < n_free)
+                ovf = ovf + jnp.sum((fire & ~placed).astype(i32), axis=0,
+                                    keepdims=True)
+                assign = (placed[:, None, :] & free[None, :, :]
+                          & (src_rank[:, None, :] == free_rank[None, :, :]))
+                got = jnp.any(assign, axis=0)  # [I, BB] target lanes
+                moved = jnp.sum(jnp.where(assign, anchor[:, None, :], 0),
+                                axis=0)
+                a[s + 1] = a[s + 1] | pack_bits(jax, jnp, got)
+                first[s + 1] = jnp.where(got, moved, first[s + 1])
+
+            a_out[...] = jnp.concatenate([a[s] for s in range(S)], axis=0)
+            first_out[...] = jnp.concatenate(
+                [first[s] for s in range(S)], axis=0)
+            emit_out[...] = emit_pk
+            anch_out[...] = anch
+            ovf_out[...] = ovf
+
+        Bp = W * 32
+        call = pl.pallas_call(
+            kernel,
+            grid=(W // WB,),
+            in_specs=[
+                pl.BlockSpec((S, WB), lambda i: (0, i)),
+                pl.BlockSpec((S * I, WB), lambda i: (0, i)),
+                pl.BlockSpec((S * I, BB), lambda i: (0, i)),
+                pl.BlockSpec((1, BB), lambda i: (0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((S * I, WB), lambda i: (0, i)),
+                pl.BlockSpec((S * I, BB), lambda i: (0, i)),
+                pl.BlockSpec((I, WB), lambda i: (0, i)),
+                pl.BlockSpec((I, BB), lambda i: (0, i)),
+                pl.BlockSpec((1, BB), lambda i: (0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((S * I, W), jnp.int32),
+                jax.ShapeDtypeStruct((S * I, Bp), jnp.int32),
+                jax.ShapeDtypeStruct((I, W), jnp.int32),
+                jax.ShapeDtypeStruct((I, Bp), jnp.int32),
+                jax.ShapeDtypeStruct((1, Bp), jnp.int32),
+            ],
+            interpret=interpret,
+        )
+        _calls[(W, WB)] = call
+        return call
+
+    def env_for(s, cols, ts):
+        env = {}
+        spec = nodes[s].specs[0]
+        for attr in spec.stream_def.attributes:
+            if attr.type in _INT_TYPES:
+                hk, lk = f"{attr.name}|hi", f"{attr.name}|lo"
+                if hk in cols:
+                    env[f"__cand.{attr.name}|hi"] = cols[hk][:, None]
+                    env[f"__cand.{attr.name}|lo"] = cols[lk][:, None]
+            elif attr.name in cols:
+                env["__cand." + attr.name] = cols[attr.name][:, None]
+        env[TS_KEY] = ts[:, None]
+        env[N_KEY] = ts.shape[0]
+        return env
+
+    def step(state, part_idx, cols, ts, valid):
+        B = part_idx.shape[0]
+        Bp, W, WB = _batch_blocks(B)
+        pad = Bp - B
+
+        # lane-uniform candidate filters, evaluated XLA-side: one packed
+        # eligibility row per node, pre-ANDed with the valid mask
+        ok_rows = []
+        for s in range(S):
+            if not on_stream[s]:
+                ok_rows.append(jnp.zeros((B,), dtype=bool))
+                continue
+            f = node_filters[s][0]
+            if f is None:
+                ok_rows.append(valid)
+            else:
+                okb = jnp.broadcast_to(
+                    jnp.asarray(f.fn(env_for(s, cols, ts))).astype(bool),
+                    (B, 1))[:, 0]
+                ok_rows.append(okb & valid)
+        ok_mat = jnp.stack(ok_rows, axis=0)  # [S, B]
+
+        a = state["active"][part_idx]        # [B, S, I]
+        first = state["first_ts"][part_idx]  # [B, S, I]
+        if pad:
+            a = jnp.pad(a, ((0, pad), (0, 0), (0, 0)))
+            first = jnp.pad(first, ((0, pad), (0, 0), (0, 0)))
+            ok_mat = jnp.pad(ok_mat, ((0, 0), (0, pad)))
+            ts_p = jnp.pad(ts, (0, pad))
+        else:
+            ts_p = ts
+
+        a_pk = pack_bits(jax, jnp,
+                         a.transpose(1, 2, 0).reshape(S * I, Bp))
+        first_t = first.transpose(1, 2, 0).reshape(S * I, Bp)
+        ok_pk = pack_bits(jax, jnp, ok_mat)
+
+        a_o, first_o, emit_o, anch_o, ovf_o = _pallas_call(W, WB)(
+            ok_pk, a_pk, first_t, ts_p.reshape(1, Bp))
+
+        a_new = unpack_bits(jax, jnp, a_o).reshape(S, I, Bp)
+        a_new = a_new.transpose(2, 0, 1)[:B]
+        first_new = first_o.reshape(S, I, Bp).transpose(2, 0, 1)[:B]
+        emit_b0 = unpack_bits(jax, jnp, emit_o).transpose(1, 0)[:B]  # [B, I]
+        anch_b0 = anch_o.transpose(1, 0)[:B]
+        ovf_delta = ovf_o[0, :B]
+
+        emit = jnp.concatenate(
+            [emit_b0, jnp.zeros((B, I), dtype=bool)], axis=1)
+        emit_anchor = jnp.concatenate(
+            [anch_b0, jnp.zeros((B, I), dtype=jnp.int32)], axis=1)
+
+        # output columns: pure candidate selects, assembled from the
+        # emit mask exactly as the XLA _emit_rows writes them (bank 0
+        # only — the eligible class has no via-path)
+        out_vals = jnp.zeros((B, 2 * I, O), dtype=jnp.float32)
+        out_ivals = jnp.zeros((B, 2 * I, 2 * n_iout), dtype=jnp.int32)
+        sl = slice(0, I)
+        for oi, (_name, src) in enumerate(out_spec):
+            ii = int_out_idx.get(oi)
+            if ii is not None:
+                hk, lk = f"{src[1]}|hi", f"{src[1]}|lo"
+                if hk not in cols:
+                    continue
+                out_ivals = out_ivals.at[:, sl, 2 * ii].set(
+                    jnp.where(emit_b0, cols[hk][:, None],
+                              out_ivals[:, sl, 2 * ii]))
+                out_ivals = out_ivals.at[:, sl, 2 * ii + 1].set(
+                    jnp.where(emit_b0, cols[lk][:, None],
+                              out_ivals[:, sl, 2 * ii + 1]))
+                continue
+            val = cols.get(src[1])
+            if val is None:
+                continue
+            out_vals = out_vals.at[:, sl, oi].set(
+                jnp.where(emit_b0, val.astype(jnp.float32)[:, None],
+                          out_vals[:, sl, oi]))
+
+        new_ovf = state["overflow"][part_idx] + ovf_delta
+
+        v1 = valid[:, None, None]
+        new_state = {
+            "active": state["active"].at[part_idx].set(
+                jnp.where(v1, a_new, state["active"][part_idx])),
+            "first_ts": state["first_ts"].at[part_idx].set(
+                jnp.where(v1, first_new, state["first_ts"][part_idx])),
+            # constant in the eligible class: pass through value-identical
+            # (a same-value scatter keeps donation layouts unchanged)
+            "counts": state["counts"].at[part_idx].set(
+                state["counts"][part_idx]),
+            "regs": state["regs"].at[part_idx].set(
+                state["regs"][part_idx]),
+            "overflow": state["overflow"].at[part_idx].set(
+                jnp.where(valid, new_ovf, state["overflow"][part_idx])),
+        }
+        n_emit = jnp.sum((emit & valid[:, None]).astype(jnp.int32))
+        return (new_state, emit, {"f": out_vals, "i": out_ivals},
+                emit_anchor, n_emit)
+
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
+
+
+def smoke_lower(engine):
+    """Lower the kernel step for every source stream at a tiny batch;
+    raise on any failure (Mosaic rejection, shape bug, ...).
+
+    Goes through ``engine.make_step`` (the engine's ``use_kernel`` flag
+    must already be set) so the traced function lands in the engine's
+    step cache and is reused at runtime.
+    """
+    import numpy as np
+
+    jax = engine.jax
+    host = engine.init_state_host()
+    state_shapes = {
+        k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in host.items()
+    }
+    B = 32
+    i32 = jax.ShapeDtypeStruct((B,), np.int32)
+    b1 = jax.ShapeDtypeStruct((B,), np.bool_)
+    for sk in engine.stream_keys:
+        cols = {
+            k: jax.ShapeDtypeStruct(
+                (B,), np.int32 if "|" in k else np.float32)
+            for k in engine.device_col_keys(sk)
+        }
+        step = engine.make_step(sk)
+        step.lower(state_shapes, i32, cols, i32, b1)

@@ -1,0 +1,100 @@
+"""Bit-plane layout for the packed dense-NFA step.
+
+One int32 word carries the boolean node activity of 32 batch rows:
+row ``w*32 + b`` lives at bit ``b`` of word ``w``.  The ``[S, I]``
+plane shape of the engine state is untouched — only the batch /
+partition axis packs — so snapshot/restore, mesh sharding, and the
+multiplex seat tiling keep seeing the existing dict layout, and the
+host converters here round-trip ``DensePatternEngine`` state exactly.
+
+Two flavours live side by side:
+
+- ``pack_active_host``/``unpack_active_host`` — numpy, axis 0 packs
+  (``[P, S, I] bool`` ↔ ``[ceil(P/32), S, I] int32``); used for
+  snapshot compaction and the packed round-trip tests.
+- ``pack_bits``/``unpack_bits`` — traced jax, last axis packs; used on
+  both sides of the ``dense_step`` kernel boundary (they only use
+  ``broadcasted_iota`` so they lower inside Mosaic too).
+
+Both flavours use the same bit order, so a word is a word regardless
+of which axis it was packed along.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+PLANE_BITS = 32
+
+
+def packed_words(n_rows: int) -> int:
+    """Words needed to hold ``n_rows`` packed rows."""
+    return (n_rows + PLANE_BITS - 1) // PLANE_BITS
+
+
+def pack_active_host(active: np.ndarray) -> np.ndarray:
+    """``[P, S, I] bool`` → ``[ceil(P/32), S, I] int32`` bit planes."""
+    P, S, I = active.shape
+    W = packed_words(P)
+    padded = np.zeros((W * PLANE_BITS, S, I), dtype=np.uint32)
+    padded[:P] = active.astype(np.uint32)
+    planes = np.zeros((W, S, I), dtype=np.uint32)
+    for b in range(PLANE_BITS):
+        planes |= padded[b::PLANE_BITS] << np.uint32(b)
+    return planes.view(np.int32)
+
+
+def unpack_active_host(planes: np.ndarray, n_rows: int) -> np.ndarray:
+    """``[W, S, I] int32`` bit planes → ``[n_rows, S, I] bool``."""
+    planes = np.ascontiguousarray(planes, dtype=np.int32)
+    W, S, I = planes.shape
+    u = planes.view(np.uint32)
+    out = np.zeros((W * PLANE_BITS, S, I), dtype=bool)
+    for b in range(PLANE_BITS):
+        out[b::PLANE_BITS] = ((u >> np.uint32(b)) & np.uint32(1)).astype(bool)
+    return out[:n_rows]
+
+
+def pack_state(state: dict) -> dict:
+    """Engine state dict (host numpy) → packed snapshot dict.
+
+    ``active`` is replaced by its bit planes plus the original row
+    count; every other array passes through untouched.
+    """
+    out = {k: v for k, v in state.items() if k != "active"}
+    out["active_planes"] = pack_active_host(state["active"])
+    out["active_rows"] = int(state["active"].shape[0])
+    return out
+
+
+def unpack_state(packed: dict) -> dict:
+    """Inverse of ``pack_state`` — restores the engine dict layout."""
+    out = {
+        k: v
+        for k, v in packed.items()
+        if k not in ("active_planes", "active_rows")
+    }
+    out["active"] = unpack_active_host(
+        packed["active_planes"], packed["active_rows"]
+    )
+    return out
+
+
+def pack_bits(jax, jnp, bits):
+    """Traced: ``[..., 32*W] bool`` → ``[..., W] int32`` (last axis)."""
+    shape = bits.shape
+    W = shape[-1] // PLANE_BITS
+    b = bits.reshape(shape[:-1] + (W, PLANE_BITS)).astype(jnp.uint32)
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, b.shape, b.ndim - 1)
+    return jnp.sum(b << shifts, axis=-1).astype(jnp.int32)
+
+
+def unpack_bits(jax, jnp, words):
+    """Traced: ``[..., W] int32`` → ``[..., 32*W] bool`` (last axis)."""
+    u = words.astype(jnp.uint32)[..., None]
+    shifts = jax.lax.broadcasted_iota(
+        jnp.uint32, u.shape[:-1] + (PLANE_BITS,), u.ndim - 1
+    )
+    bits = (u >> shifts) & jnp.uint32(1)
+    flat = words.shape[:-1] + (words.shape[-1] * PLANE_BITS,)
+    return bits.reshape(flat).astype(bool)

@@ -1,0 +1,145 @@
+"""One fused kernel for the hotkey scan's max-plus + counting chains.
+
+The XLA path materializes per-event transition matrices ``M [H,n,S,S]``
+(max-plus) and ``T [H,n,S,S]`` (counting) and runs two passes of
+``jax.lax.associative_scan`` over the event axis.  This kernel walks
+the events of each hot-key slot once, carrying the ``[1, S]`` value and
+count vectors directly — no matrices, no second pass — with the filter
+matrix streamed in slot-major so each event's row is one static-shape
+dynamic-slice load.
+
+Bit-identity contract vs the XLA path (pinned by the differential
+tests):
+
+- emissions (which events fire, and their counts) are bit-identical:
+  counts are exact integer-valued f32 (< 2^24 by the engine's own
+  bound) and liveness is a discrete fact both paths agree on;
+- live lane values are bit-identical: a live chain's value is the
+  armed timestamp plus exactly-representable ``+ 0.0`` hops in both
+  formulations, and ``NEG + x == NEG`` exactly for every in-range
+  timestamp (f32 absorption at 1e30);
+- dead lanes (``<= NEG/2``) may differ bitwise between the tree and
+  sequential evaluations — they are unobservable by the engine's own
+  contract (every read is thresholded at ``NEG/2``), and the explicit
+  ``NEG`` floor below keeps them inside the same dead band the XLA
+  ``max`` (which always includes ``NEG + v[0] == NEG``) guarantees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+_cache: Dict[Tuple, object] = {}
+
+
+def _build(H, n, S, neg, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+    # python float, not np.float32: a strongly-typed scalar closed over
+    # by the fori_loop body becomes a jaxpr *const* (Pallas rejects
+    # captured constants); a weak python float stays a literal and
+    # promotes to f32 against the f32 carries
+    NEG = float(neg)
+
+    def kernel(F_ref, ts_ref, v_ref, c_ref, vout_ref, cout_ref, emit_ref):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+        lane0 = lane == 0
+        lane1 = lane == 1
+
+        def body(e, carry):
+            v, c = carry  # [1, S] each
+            frow = pl.load(F_ref, (pl.dslice(e, 1), slice(None)))  # [1, S+1]
+            tse = pl.load(ts_ref, (slice(None), pl.dslice(e, 1)))  # [1, 1]
+            f = frow > 0.5
+            fi = f[:, 0:S]  # lane i: filter F_i   (lane 0 unused)
+            fip1 = f[:, 1 : S + 1]  # lane i: filter F_{i+1}
+
+            # emission is decided on the PRE-update vectors, exactly as
+            # the XLA path reads before_v/before_c
+            live_last = v[:, S - 1 : S] > NEG / 2
+            em = jnp.where(
+                f[:, S : S + 1] & live_last, c[:, S - 1 : S], 0.0
+            )
+            pl.store(emit_ref, (slice(0, 1), pl.dslice(e, 1)), em)
+
+            zero1 = jnp.zeros((1, 1), f32)
+            one1 = jnp.ones((1, 1), f32)
+            v_sh = jnp.concatenate([zero1, v[:, : S - 1]], axis=1)
+            c_sh = jnp.concatenate([one1, c[:, : S - 1]], axis=1)
+
+            # lane i advance-in term: F_i ? (i==1 ? ts : v[i-1]) : NEG+v[i-1]
+            t1_true = jnp.where(lane1, jnp.broadcast_to(tse, (1, S)), v_sh)
+            term1 = jnp.where(fi, t1_true, NEG + v_sh)
+            # lane i keep term: F_{i+1} ? NEG+v[i] : v[i]
+            term2 = jnp.where(fip1, NEG + v, v)
+            nv = jnp.maximum(jnp.maximum(term1, term2), NEG)
+            nv = jnp.where(lane0, 0.0, nv)
+
+            nc = jnp.where(fi, c_sh, 0.0) + jnp.where(fip1, 0.0, c)
+            nc = jnp.where(lane0, 1.0, nc)
+            return nv, nc
+
+        v0 = v_ref[...]
+        c0 = c_ref[...]
+        v_fin, c_fin = jax.lax.fori_loop(0, n, body, (v0, c0))
+        vout_ref[...] = v_fin
+        cout_ref[...] = c_fin
+
+    return pl.pallas_call(
+        kernel,
+        grid=(H,),
+        in_specs=[
+            pl.BlockSpec((n, S + 1), lambda h: (h, 0)),
+            pl.BlockSpec((1, n), lambda h: (h, 0)),
+            pl.BlockSpec((1, S), lambda h: (h, 0)),
+            pl.BlockSpec((1, S), lambda h: (h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, S), lambda h: (h, 0)),
+            pl.BlockSpec((1, S), lambda h: (h, 0)),
+            pl.BlockSpec((1, n), lambda h: (h, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, S), f32),
+            jax.ShapeDtypeStruct((H, S), f32),
+            jax.ShapeDtypeStruct((H, n), f32),
+        ],
+        interpret=interpret,
+    )
+
+
+def fused_scan(jax, jnp, F, ts_rel, v, c, neg):
+    """Run the fused chain: ``F [H,n,S+1] f32``, ``ts_rel/v/c`` as the
+    XLA path holds them → ``(v' [H,S], c' [H,S], emit [H,n])``."""
+    from siddhi_tpu.kernels import probe
+
+    H, n, Sp1 = F.shape
+    S = Sp1 - 1
+    key = (int(H), int(n), int(S), float(neg), probe.interpret_mode())
+    call = _cache.get(key)
+    if call is None:
+        call = _build(*key)
+        _cache[key] = call
+    Ff = F.reshape(H * n, Sp1)
+    return call(Ff, ts_rel, v, c)
+
+
+def smoke_lower(S, H, neg):
+    """Lower one tiny fused scan end to end; raise on failure."""
+    import jax
+    import numpy as np
+
+    from siddhi_tpu.kernels import probe
+
+    n = 16
+    call = _build(int(H), n, int(S), float(neg), probe.interpret_mode())
+    f32 = np.float32
+    jax.jit(call).lower(
+        jax.ShapeDtypeStruct((H * n, S + 1), f32),
+        jax.ShapeDtypeStruct((H, n), f32),
+        jax.ShapeDtypeStruct((H, S), f32),
+        jax.ShapeDtypeStruct((H, S), f32),
+    )

@@ -475,6 +475,11 @@ class DensePatternEngine:
                         writes.append(slot)
             self.node_writes.append(writes)
         self._step_cache: Dict[str, Callable] = {}
+        # @app:kernels: swap the jitted XLA step for the bit-packed
+        # Pallas plane kernel (siddhi_tpu/kernels/dense_step.py).  Set
+        # by planner/kernels.py after its eligibility gate; flipping it
+        # requires clearing _step_cache.
+        self.use_kernel = False
 
     # -- compilation --------------------------------------------------------
 
@@ -626,6 +631,12 @@ class DensePatternEngine:
         cache_key = (stream_key, jit)
         if cache_key in self._step_cache:
             return self._step_cache[cache_key]
+        if self.use_kernel:
+            from siddhi_tpu.kernels.dense_step import build_packed_nfa
+
+            fn = build_packed_nfa(self, stream_key, jit)
+            self._step_cache[cache_key] = fn
+            return fn
         jnp = self.jnp
         S = self.S
         I = self.I

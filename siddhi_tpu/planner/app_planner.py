@@ -233,6 +233,32 @@ class AppPlanner:
             self.app_context.hotkey_promote = promote
             self.app_context.hotkey_demote = demote
 
+        # @app:kernels / @app:kernels('nfa,bank,scan'): hand-written
+        # Pallas kernels for the hot step of eligible runtimes
+        # (planner/kernels.py); ineligible cases stay on the XLA
+        # formulation with counted kernelFallbackReasons.
+        kn_ann = find_annotation(siddhi_app.annotations, "app:kernels")
+        if kn_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:kernels needs @app:execution('tpu')")
+            v = (kn_ann.element() or "true").strip().lower()
+            if v == "false":
+                pass  # explicit off: annotation present but disabled
+            elif v == "true":
+                self.app_context.kernels = True
+            else:
+                kinds = tuple(
+                    k.strip() for k in v.split(",") if k.strip())
+                bad = [k for k in kinds if k not in ("nfa", "bank", "scan")]
+                if bad or not kinds:
+                    raise SiddhiAppCreationError(
+                        f"@app:kernels: unknown kernel kind(s) "
+                        f"{bad or [v]} — valid kinds are 'nfa', 'bank', "
+                        "'scan'")
+                self.app_context.kernels = True
+                self.app_context.kernel_kinds = kinds
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")

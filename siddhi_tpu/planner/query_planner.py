@@ -626,6 +626,14 @@ class QueryPlanner:
             wrapped = try_wrap_hotkey(self.app, st, runtime, name)
             if wrapped is not None:
                 runtime = wrapped
+        # @app:kernels: swap the hot inner step for Pallas kernels where
+        # the runtime is eligible; counted fallback otherwise.  After the
+        # hotkey wrap so the router's dense and scan halves gate
+        # independently.
+        if self.app.app_context.kernels:
+            from siddhi_tpu.planner.kernels import try_enable_query_kernels
+
+            try_enable_query_kernels(self.app, runtime, name)
         qr.pattern_processor = runtime
         if subscribe:
             for sk in engine.stream_keys:

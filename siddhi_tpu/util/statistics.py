@@ -285,6 +285,12 @@ class StatisticsManager:
         self.hotkey_fallbacks: Dict[str, int] = {}
         self.hotkey_fallback_reasons: Dict[str, str] = {}
         self.hotkey_routers: Dict[str, object] = {}
+        # queries under @app:kernels whose Pallas kernel(s) could not
+        # be enabled (probe failure, ineligible shape, lowering
+        # rejection): count + last reason per query — the downgrade to
+        # the plain XLA formulation is never silent
+        self.kernel_fallbacks: Dict[str, int] = {}
+        self.kernel_fallback_reasons: Dict[str, str] = {}
         # batch-cycle tracer (observability/trace.py); registered ungated
         # at app build — stage_stats() only reports stages that actually
         # recorded spans, so host-only apps keep an empty feed
@@ -358,6 +364,14 @@ class StatisticsManager:
         self.hotkey_fallbacks[qname] = (
             self.hotkey_fallbacks.get(qname, 0) + 1)
         self.hotkey_fallback_reasons[qname] = reason
+
+    def record_kernel_fallback(self, qname: str, reason: str):
+        """A query (or aggregation) under @app:kernels is running the
+        plain XLA formulation for at least one kernel kind; counted per
+        query with the last reason kept."""
+        self.kernel_fallbacks[qname] = (
+            self.kernel_fallbacks.get(qname, 0) + 1)
+        self.kernel_fallback_reasons[qname] = reason
 
     def register_hotkey_router(self, qname: str, router):
         """A live HotKeyRouterRuntime; its ``hot_metrics()`` gauges
@@ -435,6 +449,10 @@ class StatisticsManager:
         for qname, router in list(self.hotkey_routers.items()):
             for metric, v in router.hot_metrics().items():
                 out[self._metric("Queries", qname, metric)] = v
+        for qname, n in list(self.kernel_fallbacks.items()):
+            out[self._metric("Queries", qname, "kernelFallbacks")] = n
+            out[self._metric("Queries", qname, "kernelFallbackReason")] = (
+                self.kernel_fallback_reasons.get(qname, ""))
         if self.tracer is not None:
             for stage, metrics in self.tracer.stage_stats().items():
                 for metric, v in metrics.items():

@@ -19,6 +19,15 @@ os.environ["JAX_NUM_CPU_DEVICES"] = "8"
 
 import jax  # noqa: E402
 
+# Pallas registers its TPU lowering rules at import time, which needs
+# "tpu" to still be a KNOWN platform — import it before the factory
+# scrub below forgets tpu.  This registers rules only; no backend
+# initializes here, so the hang-defense the scrub provides is intact.
+try:
+    import jax.experimental.pallas  # noqa: E402,F401
+except Exception:
+    pass  # no pallas in this jax build: kernel tests fall back gracefully
+
 # Plugin backends (the tunneled device) can initialize during backends()
 # even under JAX_PLATFORMS=cpu via get_backend hooks; a downed remote
 # endpoint makes that init hang forever.  Tests are CPU-only by

@@ -238,6 +238,53 @@ def test_hotkey_skewed_fuzz_matches_host(seed):
     assert norm(got) == norm(host)
 
 
+KERNEL_APP = (
+    "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+    "within 3 sec select b.v as bv insert into Alerts;")
+
+
+@pytest.mark.parametrize("packed", [False, True])
+@pytest.mark.parametrize("seed", [
+    61,
+    pytest.param(62, marks=pytest.mark.slow),
+    pytest.param(63, marks=pytest.mark.slow),
+])
+def test_kernel_step_matches_xla_fuzz(seed, packed):
+    """@app:kernels swaps the dense step for the packed-plane Pallas
+    kernel (interpret mode on CPU) — emitted rows must be BIT-identical
+    to the plain XLA dense path, no norm().  The packed variant also
+    round-trips the live engine state through the bit-plane converters
+    mid-assertion, pinning pack/unpack against real state."""
+    sends = gen_stream(seed, n=80)
+    xla, _, _ = run(KERNEL_APP, sends, mode_tpu=True)
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback @app:execution('tpu', instances='16') "
+            "@app:kernels " + DEFINE + KERNEL_APP)
+        got = []
+        rt.add_callback("Alerts", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row, ts in sends:
+            h.send(row, timestamp=ts)
+        qr = next(iter(rt.query_runtimes.values()))
+        assert qr.lowered_to == "kernel", qr.lowered_to
+        if packed:
+            from siddhi_tpu.kernels import plane_pack
+
+            state = {k: np.asarray(v)
+                     for k, v in qr.pattern_processor.state.items()}
+            back = plane_pack.unpack_state(plane_pack.pack_state(state))
+            assert set(back) == set(state)
+            for k in state:
+                assert np.array_equal(back[k], state[k]), k
+        rt.shutdown()
+    finally:
+        m.shutdown()
+    assert got == xla  # bit-identical: same lanes, same dtypes
+
+
 def test_sharded_fuzz_matches_host():
     app = ("partition with (k of S) begin "
            "@info(name='q') from every a=S[v > 8.0] -> b=S[v > a.v] "
