@@ -6,19 +6,24 @@ the type system cannot see: device→host transfers only through the
 count-gated drain (``core/emit_queue.py``), H2D puts only through
 ``staged_put`` (``core/ingest_stage.py``), no fault swallowed without a
 log line or counter, no host clock / logging / materialization inside a
-jitted step, no compile-cache churn on the per-batch path, and no
-cross-thread attribute write outside the engine lock.
+jitted step, no compile-cache churn on the per-batch path, no
+cross-thread attribute write outside the engine lock, every planner
+fallback both logged and counted, and every thread daemon-or-joined.
 
 This package enforces them as one reusable pass — the compile-time
 analog of the paper's query-validation phase:
 
 - ``index``      — single-parse-per-module ``ModuleIndex`` with
-                   qualified-name scope resolution shared by every rule
+                   qualified-name scope resolution shared by every rule,
+                   memoized on ``(path, mtime, size)``
+- ``project``    — whole-program ``ProjectIndex``: import maps, C3 MRO
+                   over project-local classes, conservative call graph
 - ``framework``  — ``Rule`` base class + registry, ``Finding``,
                    allowlists with required justifications, stale-entry
                    expiry
-- ``rules/``     — one module per rule (six registered today)
-- ``reporting``  — text and JSON reporters, ``--baseline`` support
+- ``rules/``     — one module per rule (eight registered today)
+- ``reporting``  — text / JSON / SARIF 2.1.0 reporters, ``--baseline``
+                   support
 - ``__main__``   — ``python -m siddhi_tpu.analysis`` CLI (also exposed
                    as the ``siddhi-tpu-analysis`` console script)
 

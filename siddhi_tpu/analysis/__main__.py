@@ -6,7 +6,8 @@ no stale allowlist entries), 1 otherwise, 2 on usage errors.
 Examples::
 
     python -m siddhi_tpu.analysis                  # whole package, text
-    python -m siddhi_tpu.analysis --json
+    python -m siddhi_tpu.analysis --format json
+    python -m siddhi_tpu.analysis --format sarif   # SARIF 2.1.0 for CI
     python -m siddhi_tpu.analysis --list-rules
     python -m siddhi_tpu.analysis --rules jit-purity,retrace-hazard
     python -m siddhi_tpu.analysis --baseline analysis_baseline.json
@@ -41,7 +42,11 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
     parser.add_argument(
-        "--json", action="store_true", help="JSON report on stdout")
+        "--format", choices=("text", "json", "sarif"), default=None,
+        help="report format on stdout (default: text)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="alias for --format json (kept for compatibility)")
     parser.add_argument(
         "--baseline", default=None, metavar="FILE",
         help="JSON file of acknowledged finding identities to subtract")
@@ -91,10 +96,13 @@ def main(argv=None) -> int:
             reporting.apply_baseline(findings, baseline)
         baselined_count = len(baselined)
 
-    if args.json:
+    fmt = args.format or ("json" if args.json else "text")
+    if fmt == "json":
         print(reporting.render_json(
             findings, rules, suppressed, baselined_count,
             stale_baseline, modules=len(indexes)))
+    elif fmt == "sarif":
+        print(reporting.render_sarif(findings, rules))
     else:
         print(reporting.render_text(
             findings, rules, len(suppressed), baselined_count,

@@ -106,6 +106,11 @@ class Rule:
 
     name: str = ""
     description: str = ""
+    #: the whole-program ``ProjectIndex`` for the current run, set by
+    #: ``run_rules`` before ``begin()``.  ``None`` = single-module
+    #: lexical mode (rules degrade to their pre-cross-module behavior;
+    #: fixture tests pin both resolutions this way).
+    project = None
 
     def begin(self):
         """Hook: called once per run before any module is visited —
@@ -153,15 +158,26 @@ def get_rule(name: str) -> Rule:
 def run_rules(indexes: Sequence[ModuleIndex],
               rules: Optional[Sequence[Rule]] = None,
               allowlists: Optional[Dict[str, Allowlist]] = None,
+              project=None,
               ) -> Dict[str, List[Finding]]:
     """Run rules over pre-parsed modules.
 
+    A ``ProjectIndex`` over ``indexes`` is built (or taken from
+    ``project``) and handed to every rule as ``rule.project`` — the
+    cross-module resolution layer for imports, class hierarchies, and
+    the call graph.
+
     Returns ``{"findings": unsuppressed (stale entries included),
     "suppressed": allowlisted}`` — the caller applies any baseline."""
+    from .project import ProjectIndex
+
     rules = list(rules) if rules is not None else all_rules()
+    if project is None:
+        project = ProjectIndex(indexes)
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     for rule in rules:
+        rule.project = project
         rule.begin()
         raw: List[Finding] = []
         for index in indexes:
@@ -172,5 +188,8 @@ def run_rules(indexes: Sequence[ModuleIndex],
         findings.extend(kept)
         findings.extend(stale)
         suppressed.extend(supp)
+        # registry rules are singletons: drop the project reference so
+        # a later direct rule.check() (fixture tests) runs lexically
+        rule.project = None
     findings.sort(key=lambda f: (f.rel, f.line, f.rule))
     return {"findings": findings, "suppressed": suppressed}

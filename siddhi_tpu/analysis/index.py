@@ -51,7 +51,12 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 class ModuleIndex:
     """One parsed module plus the lookups every rule shares."""
 
+    #: process-wide count of actual ``ast.parse`` runs — tests assert
+    #: the (path, mtime, size) cache keeps this at one per file
+    parse_count: int = 0
+
     def __init__(self, path: Path, rel: str, source: Optional[str] = None):
+        ModuleIndex.parse_count += 1
         self.path = Path(path)
         self.rel = rel  # repo-relative posix path used in findings
         self.source = self.path.read_text() if source is None else source
@@ -142,15 +147,24 @@ class ModuleIndex:
         return False
 
 
+#: (absolute path, rel) -> (mtime_ns, size, ModuleIndex) — one parse
+#: per file per analyzer run: the CLI, the clean-package test, and any
+#: rule-subset re-run inside one process share parsed indexes as long
+#: as the file on disk is byte-identical (mtime+size key).
+_PARSE_CACHE: dict = {}
+
+
 def index_package(root: Path, rel_base: Optional[Path] = None,
-                  exclude: Tuple[str, ...] = ("analysis",)
-                  ) -> List[ModuleIndex]:
+                  exclude: Tuple[str, ...] = ("analysis",),
+                  cache: bool = True) -> List[ModuleIndex]:
     """Parse every ``*.py`` under ``root`` once, sorted by path.
 
     ``exclude`` names top-level subpackages to skip, repo-relative to
     ``root`` — the analysis package itself is excluded by default (its
     fixture strings and banned-call tables would trip the very rules
-    they implement)."""
+    they implement).  Parses are memoized on (path, mtime, size) so
+    repeated runs in one process re-use one ``ModuleIndex`` per file;
+    ``cache=False`` forces a fresh parse."""
     root = Path(root)
     rel_base = Path(rel_base) if rel_base is not None else root.parent
     out: List[ModuleIndex] = []
@@ -159,5 +173,14 @@ def index_package(root: Path, rel_base: Optional[Path] = None,
         if parts and parts[0] in exclude:
             continue
         rel = path.relative_to(rel_base).as_posix()
-        out.append(ModuleIndex(path, rel))
+        st = path.stat()
+        key = (str(path), rel)
+        hit = _PARSE_CACHE.get(key)
+        if cache and hit is not None and \
+                hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+            out.append(hit[2])
+            continue
+        mi = ModuleIndex(path, rel)
+        _PARSE_CACHE[key] = (st.st_mtime_ns, st.st_size, mi)
+        out.append(mi)
     return out

@@ -1,4 +1,4 @@
-"""Text / JSON reporters and baseline handling for the analysis CLI.
+"""Text / JSON / SARIF reporters and baseline handling for the CLI.
 
 A baseline is a JSON file (``analysis_baseline.json``) listing finding
 identities (``"<rule>:<key>"``) that are acknowledged-but-unfixed; the
@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Dict, List, Sequence
+from typing import List, Sequence
 
 from .framework import Finding, Rule
 
@@ -72,6 +72,10 @@ def render_json(findings: Sequence[Finding], rules: Sequence[Rule],
                 stale_baseline: Sequence[str] = (),
                 modules: int = 0) -> str:
     return json.dumps({
+        # header stamps for trend tracking: CI diffs these two numbers
+        # across runs without parsing the body
+        "rule_count": len(rules),
+        "finding_count": len(findings),
         "rules": [{"name": r.name, "description": r.description}
                   for r in rules],
         "modules": modules,
@@ -79,4 +83,51 @@ def render_json(findings: Sequence[Finding], rules: Sequence[Rule],
         "allowlisted": len(suppressed),
         "baselined": baselined_count,
         "stale_baseline": list(stale_baseline),
+    }, indent=2)
+
+
+#: SARIF 2.1.0 — the minimal profile editors/CI ingest: one run, the
+#: rule catalog on tool.driver, one result per finding with a physical
+#: location and a stable partialFingerprint (the allowlist key, which
+#: is deliberately line-number-free).
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(findings: Sequence[Finding], rules: Sequence[Rule]) -> str:
+    rule_index = {r.name: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            # stale-allowlist findings are synthesized by the framework
+            # and have no registered rule entry to index
+            **({"ruleIndex": rule_index[f.rule]}
+               if f.rule in rule_index else {}),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.rel},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {"analysisKey/v1": f"{f.rule}:{f.key}"},
+        })
+    return json.dumps({
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "siddhi-tpu-analysis",
+                    "rules": [{
+                        "id": r.name,
+                        "shortDescription": {"text": r.description},
+                    } for r in rules],
+                },
+            },
+            "results": results,
+        }],
     }, indent=2)

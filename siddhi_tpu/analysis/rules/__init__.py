@@ -10,17 +10,27 @@ Catalog (one module per rule):
   without a log/counter (ex ``tests/test_except_guard.py``)
 - ``lock_discipline`` — ``lock-discipline``: attributes shared between a
   thread-entry function and the main batch path stay under the lock
+  (MRO-aware: mixin threads and inherited methods resolve)
 - ``jit_purity``      — ``jit-purity``: no host clock / logging / fault
-  hooks / tracer materialization inside jitted callables
+  hooks / tracer materialization inside jitted callables, helpers in
+  other modules included
 - ``retrace``         — ``retrace-hazard``: no un-memoized
-  ``jax.jit``/``shard_map`` on per-batch functions
+  ``jax.jit``/``shard_map`` on per-batch functions, including builders
+  called across modules
+- ``fallback_discipline`` — ``fallback-discipline``: every
+  ``except SiddhiAppCreationError`` fallback gate reaches both a
+  ``log.warning`` and a counted ``record_*_fallback`` stats write
+- ``thread_lifecycle`` — ``thread-lifecycle``: every Thread/Timer is
+  daemon or joined/cancelled on an owner-class shutdown path
 """
 
 from . import (  # noqa: F401
     broad_except,
+    fallback_discipline,
     host_sync,
     ingest_put,
     jit_purity,
     lock_discipline,
     retrace,
+    thread_lifecycle,
 )

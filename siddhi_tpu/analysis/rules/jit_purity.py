@@ -12,9 +12,8 @@ the step boundary.
 
 The rule finds ``jax.jit(...)`` / ``shard_map(...)`` call sites (incl.
 ``self.jax.jit`` receivers and ``get_shard_map()(...)``), resolves the
-callable argument to a function definition in the same module (local
-``def step(...)`` / ``lambda``), and reports banned constructs anywhere
-in the resolved body:
+callable argument to a function definition, and reports banned
+constructs anywhere in the resolved body:
 
 - host clocks: ``time.time`` / ``time.monotonic`` / ``time.perf_counter``
   / ``datetime.now``
@@ -27,15 +26,24 @@ in the resolved body:
   ``jax.device_get``, and bare ``float()`` / ``int()`` / ``bool()`` on
   a non-literal argument
 
-Callables the rule cannot resolve statically (attributes, imports from
-other modules) are skipped — the differential suites cover those paths
-dynamically.
+Resolution is lexical (same module, enclosing scopes outward) when the
+rule runs without a ``ProjectIndex``; with one — the normal
+whole-program run — the callable argument additionally resolves through
+the import map (``from .steps import scan_step``), through
+``self.``/``cls.`` method dispatch along the MRO, and into other
+modules, and the scan follows project-resolved **helper calls**
+transitively: everything the jitted callable calls is traced with it,
+so a ``time.time()`` two hops away in another file is the same bug as
+one written inline.  Findings on a helper are attributed to the
+helper's own file and scope.  Callables/edges the project cannot
+resolve statically (arbitrary object attributes, container lookups)
+are skipped — conservative, never guessed.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set, Tuple
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
 
 from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
@@ -71,7 +79,8 @@ def jit_call_sites(index: ModuleIndex) -> List[Tuple[ast.Call, ast.AST]]:
 def resolve_callable(index: ModuleIndex, site: ast.Call,
                      arg: ast.AST) -> Optional[ast.AST]:
     """The function definition a jit argument refers to, searching the
-    enclosing scopes outward; None when not statically resolvable."""
+    enclosing scopes outward; None when not statically resolvable
+    within the module (the project layer picks those up)."""
     if isinstance(arg, ast.Lambda):
         return arg
     if isinstance(arg, ast.Call):
@@ -92,6 +101,22 @@ def resolve_callable(index: ModuleIndex, site: ast.Call,
         if not parts:
             return None
         parts.pop()
+
+
+def resolve_callable_project(project, index: ModuleIndex, site: ast.Call,
+                             arg: ast.AST
+                             ) -> Optional[Tuple[ModuleIndex, ast.AST]]:
+    """Cross-module fallback when lexical resolution fails: plain names
+    through the import map, ``self.``/``cls.`` methods through the MRO,
+    dotted receivers into their defining module."""
+    if isinstance(arg, ast.Call):
+        if arg.args:
+            return resolve_callable_project(project, index, site, arg.args[0])
+        return None
+    hit = project._resolve_value(index, site, arg)
+    if hit is None:
+        return None
+    return (hit[0], hit[1])
 
 
 def impure_constructs(index: ModuleIndex, fn: ast.AST
@@ -141,25 +166,72 @@ class JitPurityRule(Rule):
         "host clock / logging / fault hook / stats counter / tracer "
         "materialization inside a callable passed to jax.jit or shard_map")
 
+    #: transitive helper-following cap per jitted root
+    MAX_HELPER_DEFS = 50
+
+    def begin(self):
+        # (rel, scope, line) already reported — one helper reached from
+        # jit sites in several modules is one finding
+        self._reported: Set[Tuple[str, str, int]] = set()
+
     def check(self, index: ModuleIndex) -> Iterable[Finding]:
-        reported: Set[Tuple[str, int]] = set()
+        reported = getattr(self, "_reported", None)
+        if reported is None:
+            reported = self._reported = set()
         for site, arg in jit_call_sites(index):
             fn = resolve_callable(index, site, arg)
+            fn_idx = index
+            if fn is None and self.project is not None:
+                hit = resolve_callable_project(self.project, index, site, arg)
+                if hit is not None:
+                    fn_idx, fn = hit
             if fn is None:
                 continue
-            fn_qual = index.def_qualname(fn)
-            for line, what in impure_constructs(index, fn):
-                if (fn_qual, line) in reported:
-                    continue  # same fn jitted at several sites
-                reported.add((fn_qual, line))
-                yield Finding(
-                    rule=self.name,
-                    rel=index.rel,
-                    line=line,
-                    scope=fn_qual,
-                    message=(
-                        f"{what} inside a jitted callable — effects run "
-                        "at trace time only (or break tracing); hoist "
-                        "to the host side of the step boundary, or "
-                        "allowlist with a justification"),
-                )
+            for d_idx, d_fn in self._traced_defs(fn_idx, fn):
+                d_qual = d_idx.def_qualname(d_fn)
+                for line, what in impure_constructs(d_idx, d_fn):
+                    key = (d_idx.rel, d_qual, line)
+                    if key in reported:
+                        continue  # same fn jitted/reached repeatedly
+                    reported.add(key)
+                    inline = d_idx is fn_idx and d_fn is fn
+                    yield Finding(
+                        rule=self.name,
+                        rel=d_idx.rel,
+                        line=line,
+                        scope=d_qual,
+                        message=(
+                            f"{what} inside a jitted callable"
+                            + ("" if inline else
+                               " (helper reached from a jitted callable)")
+                            + " — effects run at trace time only (or "
+                            "break tracing); hoist to the host side of "
+                            "the step boundary, or allowlist with a "
+                            "justification"),
+                    )
+
+    def _traced_defs(self, fn_idx: ModuleIndex, fn: ast.AST
+                     ) -> Iterator[Tuple[ModuleIndex, ast.AST]]:
+        """The jitted callable plus — in project mode — every
+        project-resolved helper its body (transitively) calls: they are
+        all traced together."""
+        yield (fn_idx, fn)
+        if self.project is None:
+            return
+        visited: Set[Tuple[int, int]] = {(id(fn_idx), id(fn))}
+        work: List[Tuple[ModuleIndex, ast.AST]] = [(fn_idx, fn)]
+        while work and len(visited) <= self.MAX_HELPER_DEFS:
+            cur_idx, cur_fn = work.pop()
+            for node in ast.walk(cur_fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self.project.resolve_call(cur_idx, node)
+                if hit is None:
+                    continue
+                t_idx, t_fn, _fq = hit
+                key = (id(t_idx), id(t_fn))
+                if key in visited:
+                    continue
+                visited.add(key)
+                work.append((t_idx, t_fn))
+                yield (t_idx, t_fn)

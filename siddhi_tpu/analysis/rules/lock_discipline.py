@@ -28,6 +28,16 @@ Per class, the rule:
    main-path method where any write site is not lexically under a
    lock-``with``.
 
+With a ``ProjectIndex`` (the normal whole-program run), every step
+resolves through the class's **MRO**: a ``threading.Timer`` armed in a
+mixin (``ConnectRetryMixin``) is a thread entry of every class that
+inherits it, ``self.<method>`` targets and closure calls dispatch to
+the defining module, and write sites carry the file that owns them.  A
+conflict whose participating sites are identical across several classes
+(mixin-internal state seen through each subclass) is reported once, on
+the base-most class.  Without a project (fixture mode) the rule is the
+original single-module lexical pass.
+
 The lexical lock check is conservative by design: disciplines the rule
 cannot see (GIL-atomic monotonic flags, caller-holds-lock contracts)
 are allowlisted per attribute with a written justification.
@@ -36,13 +46,16 @@ are allowlisted per attribute with a written justification.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
 
 _THREAD_CTORS = {"threading.Thread", "Thread"}
 _TIMER_CTORS = {"threading.Timer", "Timer"}
+
+#: a write-site record: (scope qualname, line, under_lock, rel)
+_Site = Tuple[str, int, bool, str]
 
 
 def _target_of(call: ast.Call, index: ModuleIndex):
@@ -79,15 +92,34 @@ class LockDisciplineRule(Rule):
         "attribute written from both a thread-entry function and the "
         "main path without the engine lock")
 
+    def begin(self):
+        # candidate conflicts across classes, for base-most dedup:
+        # (attr, site identity) -> [(class fq, Finding)]
+        self._candidates: Dict[Tuple[str, frozenset],
+                               List[Tuple[str, Finding]]] = {}
+
     def check(self, index: ModuleIndex) -> Iterable[Finding]:
+        if self.project is not None:
+            return  # whole-program pass runs in finish()
         for cls_qual, cls in index.classes.items():
-            yield from self._check_class(index, cls_qual, cls)
+            methods = {
+                n.name: (index, n, cls_qual) for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            yield from self._check_class(index, cls_qual, cls, methods)
+
+    def finish(self) -> Iterable[Finding]:
+        if self.project is None:
+            return ()
+        for fq_class in sorted(self.project.classes):
+            idx, cls = self.project.classes[fq_class]
+            methods = self.project.class_methods(fq_class)
+            for f in self._check_class(
+                    idx, idx.def_qualname(cls), cls, methods,
+                    fq_class=fq_class):
+                pass  # collected in self._candidates
+        return self._dedup_candidates()
 
     # -- per-class analysis -------------------------------------------------
-
-    def _methods(self, cls: ast.ClassDef) -> Dict[str, ast.AST]:
-        return {n.name: n for n in cls.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
 
     def _own_nodes(self, index: ModuleIndex, fn: ast.AST, qual: str):
         """Walk ``fn``'s body excluding nested function/class scopes —
@@ -99,9 +131,8 @@ class LockDisciplineRule(Rule):
                 yield node
 
     def _self_writes(self, index: ModuleIndex, fn: ast.AST, qual: str
-                     ) -> List[Tuple[str, int, bool]]:
-        """(attr, line, under_lock) for every direct ``self.x = / +=``
-        in ``fn``'s own scope."""
+                     ) -> List[_Site]:
+        """Every direct ``self.x = / +=`` in ``fn``'s own scope."""
         out = []
         for node in self._own_nodes(index, fn, qual):
             targets = []
@@ -113,7 +144,8 @@ class LockDisciplineRule(Rule):
                 if isinstance(t, ast.Attribute) and \
                         isinstance(t.value, ast.Name) and \
                         t.value.id in ("self", "cls"):
-                    out.append((t.attr, t.lineno, index.under_lock(t)))
+                    out.append((t.attr, t.lineno, index.under_lock(t),
+                                index.rel))
         return out
 
     def _self_calls(self, index: ModuleIndex, fn: ast.AST, qual: str
@@ -129,73 +161,80 @@ class LockDisciplineRule(Rule):
                 out.append((node.func.attr, index.under_lock(node)))
         return out
 
-    def _check_class(self, index: ModuleIndex, cls_qual: str,
-                     cls: ast.ClassDef) -> Iterable[Finding]:
-        methods = self._methods(cls)
+    def _check_class(self, cls_index: ModuleIndex, cls_qual: str,
+                     cls: ast.ClassDef,
+                     methods: Dict[str, Tuple[ModuleIndex, ast.AST, str]],
+                     fq_class: Optional[str] = None) -> Iterable[Finding]:
+        """``methods`` carries (defining index, def node, owner) per
+        name — the single-class dict lexically, the MRO-merged table in
+        project mode."""
         # 1. thread entries
-        roots: List[Tuple[str, ast.AST, str]] = []  # (label, fn, qual)
-        for mname, m in methods.items():
+        roots: List[Tuple[str, ModuleIndex, ast.AST, str]] = []
+        for mname, (m_idx, m, _owner) in methods.items():
             # thread ctors may sit inside a local def, so scan the full
             # method subtree (not just its own scope)
             for node in ast.walk(m):
                 if not isinstance(node, ast.Call):
                     continue
-                tgt = _target_of(node, index)
+                tgt = _target_of(node, m_idx)
                 if tgt is None:
                     continue
                 kind, tname = tgt
                 if kind == "method" and tname in methods:
-                    roots.append((tname, methods[tname],
-                                  f"{cls_qual}.{tname}"))
+                    t_idx, t_fn, _ = methods[tname]
+                    roots.append((tname, t_idx, t_fn,
+                                  t_idx.def_qualname(t_fn)))
                 elif kind == "local":
                     # resolve the local function def by qualified name,
                     # searching outward from the launching scope
-                    scope = index.qualname(node)
-                    fn = index.functions.get(f"{scope}.{tname}")
+                    scope = m_idx.qualname(node)
+                    fn = m_idx.functions.get(f"{scope}.{tname}")
                     if fn is not None:
-                        roots.append((tname, fn, f"{scope}.{tname}"))
+                        roots.append((tname, m_idx, fn, f"{scope}.{tname}"))
         if not roots:
             return
         # 2. closure over unlocked self.method() calls
-        thread_fns: Dict[str, Tuple[ast.AST, str]] = {}
+        thread_fns: Dict[str, Tuple[ModuleIndex, ast.AST, str]] = {}
         work = list(roots)
         while work:
-            label, fn, qual = work.pop()
+            label, f_idx, fn, qual = work.pop()
             if label in thread_fns:
                 continue
-            thread_fns[label] = (fn, qual)
-            for callee, locked in self._self_calls(index, fn, qual):
+            thread_fns[label] = (f_idx, fn, qual)
+            for callee, locked in self._self_calls(f_idx, fn, qual):
                 if locked:
                     continue  # callee runs under the lock at this site
                 if callee in methods and callee not in thread_fns:
-                    work.append((callee, methods[callee],
-                                 f"{cls_qual}.{callee}"))
+                    c_idx, c_fn, _ = methods[callee]
+                    work.append((callee, c_idx, c_fn,
+                                 c_idx.def_qualname(c_fn)))
         # 3. writes on each side
-        thread_writes: Dict[str, List[Tuple[str, int, bool]]] = {}
-        for label, (fn, qual) in thread_fns.items():
-            for attr, line, locked in self._self_writes(index, fn, qual):
-                thread_writes.setdefault(attr, []).append(
-                    (qual, line, locked))
-        main_writes: Dict[str, List[Tuple[str, int, bool]]] = {}
-        for mname, m in methods.items():
+        thread_writes: Dict[str, List[_Site]] = {}
+        for label, (f_idx, fn, qual) in thread_fns.items():
+            for site in self._self_writes(f_idx, fn, qual):
+                thread_writes.setdefault(site[0], []).append(
+                    (qual,) + site[1:])
+        main_writes: Dict[str, List[_Site]] = {}
+        for mname, (m_idx, m, _owner) in methods.items():
             if mname in thread_fns or mname in ("__init__", "__new__",
                                                 "init") \
                     or mname.startswith("_init"):
                 continue
-            mqual = f"{cls_qual}.{mname}"
-            for attr, line, locked in self._self_writes(index, m, mqual):
-                main_writes.setdefault(attr, []).append(
-                    (mqual, line, locked))
+            mqual = m_idx.def_qualname(m)
+            for site in self._self_writes(m_idx, m, mqual):
+                main_writes.setdefault(site[0], []).append(
+                    (mqual,) + site[1:])
         # 4. conflicts: one finding per attribute, keyed Class.attr
         for attr in sorted(set(thread_writes) & set(main_writes)):
             sites = thread_writes[attr] + main_writes[attr]
-            unlocked = [(q, ln) for q, ln, locked in sites if not locked]
+            unlocked = [(q, ln, rel) for q, ln, locked, rel in sites
+                        if not locked]
             if not unlocked:
                 continue
-            where = ", ".join(f"{q}:{ln}" for q, ln in unlocked)
-            yield Finding(
+            where = ", ".join(f"{q}:{ln}" for q, ln, _rel in unlocked)
+            finding = Finding(
                 rule=self.name,
-                rel=index.rel,
+                rel=cls_index.rel,
                 line=unlocked[0][1],
                 scope=f"{cls_qual}.{attr}",
                 message=(
@@ -205,3 +244,32 @@ class LockDisciplineRule(Rule):
                     "every write with the engine/component lock, or "
                     "allowlist with a justification"),
             )
+            if fq_class is None:
+                yield finding
+            else:
+                ident = frozenset((rel, ln) for _q, ln, _lk, rel in sites)
+                self._candidates.setdefault(
+                    (attr, ident), []).append((fq_class, finding))
+
+    def _dedup_candidates(self) -> Iterable[Finding]:
+        """One finding per (attr, site set): inherited mixin state
+        shows the same conflict through every subclass — report it on
+        the base-most class in the group."""
+        out: List[Finding] = []
+        for (_attr, _ident), group in sorted(
+                self._candidates.items(),
+                key=lambda kv: (kv[1][0][1].rel, kv[1][0][1].scope)):
+            if len(group) == 1:
+                out.append(group[0][1])
+                continue
+            base = None
+            for fq, finding in group:
+                if all(fq in self.project.mro(other)
+                       for other, _f in group):
+                    base = finding
+                    break
+            if base is None:
+                base = sorted(group,
+                              key=lambda g: (g[1].rel, g[1].scope))[0][1]
+            out.append(base)
+        return out

@@ -17,13 +17,24 @@ wrapped callable escapes into an instance attribute — directly
 stored (``k = jit(f); self._kernels[key] = k``).  Builders called from
 ``__init__`` only are not matched; a genuinely-sanctioned per-batch
 wrap goes in the allowlist with a justification.
+
+With a ``ProjectIndex`` the rule additionally follows one call-graph
+hop: a hot-named function that calls a project-resolved **builder in
+another scope** whose body wraps ``jax.jit``/``shard_map`` without
+memoizing — neither inside the builder (``self._step_cache[...] =``
+makes it safe; ``make_flush_step`` is the engine's canonical example)
+nor at the call site (``self._fn = make_step(...)``) — re-compiles per
+batch just the same, only with the wrap hidden a file away.  The
+finding lands on the hot caller's call site.  Builders whose own name
+matches the hot pattern are skipped there (the direct pass already
+owns them).
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterable, List, Optional, Set
+from typing import Iterable, Set
 
 from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
@@ -110,3 +121,61 @@ class RetraceHazardRule(Rule):
                     "hoist to a builder / cache it, or allowlist with "
                     "a justification"),
             )
+        if self.project is not None:
+            yield from self._cross_module(index)
+
+    def _cross_module(self, index: ModuleIndex) -> Iterable[Finding]:
+        """One call-graph hop: hot caller → builder (any scope) whose
+        jit wrap neither memoizes internally nor at the call site."""
+        seen: Set[tuple] = set()
+        for qual, fn in index.functions.items():
+            if not HOT_NAME_RE.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if index.enclosing(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) is not fn:
+                    continue  # nested defs get their own hot-name check
+                hit = self.project.resolve_call(index, node)
+                if hit is None:
+                    continue
+                t_idx, t_fn, t_fq = hit
+                if not isinstance(t_fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if t_fn is fn or HOT_NAME_RE.search(t_fn.name):
+                    continue  # direct pass owns hot-named callees
+                if not self._builds_fresh_jit(t_idx, t_fn):
+                    continue
+                if _escapes_to_instance(index, node, fn):
+                    continue  # caller memoizes the built wrapper
+                key = (index.rel, qual, t_fq)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    rule=self.name,
+                    rel=index.rel,
+                    line=node.lineno,
+                    scope=qual,
+                    message=(
+                        f"per-batch call to {t_fq}() which wraps "
+                        "jax.jit/shard_map without memoizing — a fresh "
+                        "trace cache per call; memoize the built "
+                        "callable (builder-side cache or instance "
+                        "attribute at this call site), or allowlist "
+                        "with a justification"),
+                )
+
+    def _builds_fresh_jit(self, t_idx: ModuleIndex,
+                          t_fn: ast.AST) -> bool:
+        """Does ``t_fn`` contain a jit/shard_map wrap that does NOT
+        escape into an instance cache (i.e. a new wrapper per call)?"""
+        for site, _arg in jit_call_sites(t_idx):
+            if t_idx.enclosing(site, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) is not t_fn:
+                continue
+            if not _escapes_to_instance(t_idx, site, t_fn):
+                return True
+        return False

@@ -395,6 +395,10 @@ class PartitionRuntime:
                 logging.getLogger("siddhi_tpu").warning(
                     "%s: dense TPU path unavailable (%s); using per-key "
                     "instances", self.name, e)
+                sm = app_planner.app_context.statistics_manager
+                if sm is not None:
+                    sm.record_device_fallback(
+                        self.name, f"dense partition: {e}")
 
         if not self.is_dense:
             for sid, ex in self._executors.items():

@@ -55,8 +55,11 @@ class MultiplexPlanner:
         sm = self.ctx.statistics_manager
         if sm is not None:
             sm.record_multiplex_fallback(name, reason)
-        log.info("query '%s': multiplex ineligible (%s); dedicated engine "
-                 "used", name, reason)
+        # WARN, not info: @app:multiplex was requested and this query is
+        # not getting it — same visibility contract as every other
+        # planner fallback
+        log.warning("query '%s': multiplex ineligible (%s); dedicated "
+                    "engine used", name, reason)
         return None
 
     def _common_reject(self, query: Query, name: str) -> Optional[str]:

@@ -400,6 +400,9 @@ class QueryPlanner:
                 logging.getLogger("siddhi_tpu").warning(
                     "query '%s': join device probe unavailable (%s); "
                     "numpy probe used", name, e)
+                sm = self.app.app_context.statistics_manager
+                if sm is not None:
+                    sm.record_device_fallback(name, f"join probe: {e}")
         if any(s.window is not None and getattr(s.window, "needs_scheduler", False) for s in sides):
             self.app.scheduler.register_task(jr)
         for side, src, is_left in ((left, j.left, True), (right, j.right, False)):
@@ -449,6 +452,9 @@ class QueryPlanner:
                 logging.getLogger("siddhi_tpu").warning(
                     "query '%s': dense TPU path unavailable (%s); "
                     "using host pattern engine", name, e)
+                sm = self.app.app_context.statistics_manager
+                if sm is not None:
+                    sm.record_device_fallback(name, f"dense pattern: {e}")
 
         builder = NFABuilder(st, self.app.resolve_stream_definition)
         nodes = builder.build()
@@ -692,6 +698,9 @@ class QueryPlanner:
                 logging.getLogger("siddhi_tpu").warning(
                     "query '%s': device query path unavailable (%s); "
                     "using host engine", name, e)
+                sm = self.app.app_context.statistics_manager
+                if sm is not None:
+                    sm.record_device_fallback(name, f"device query: {e}")
 
         definition = self.app.resolve_stream_definition(s)
         ref = s.unique_id

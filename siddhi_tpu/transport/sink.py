@@ -119,7 +119,10 @@ class Sink(ConnectRetryMixin):
         self._shutdown_retry()
         if self.connected:
             self.disconnect()
-            self.connected = False
+            # the retry thread writes `connected` under _retry_lock;
+            # the main-path clear takes the same lock
+            with self._retry_lock:
+                self.connected = False
 
     # -- junction-facing ---------------------------------------------------
 
@@ -182,7 +185,10 @@ class Sink(ConnectRetryMixin):
                 fi.check("sink.publish")
             self.publish(payload)
         except ConnectionUnavailableError as e:
-            self.connected = False
+            # the retry thread writes `connected` under _retry_lock;
+            # the main-path clear takes the same lock
+            with self._retry_lock:
+                self.connected = False
             self.on_error(payload, e)
             self._connect_with_retry()
         except InjectedFaultError as e:

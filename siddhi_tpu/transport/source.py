@@ -124,7 +124,10 @@ class Source(ConnectRetryMixin):
         self._shutdown_retry()
         if self.connected:
             self.disconnect()
-            self.connected = False
+            # the retry thread writes `connected` under _retry_lock;
+            # the main-path clear takes the same lock
+            with self._retry_lock:
+                self.connected = False
 
     # -- delivery ----------------------------------------------------------
 

@@ -264,6 +264,12 @@ class StatisticsManager:
         # silent
         self.sharded_fallbacks: Dict[str, int] = {}
         self.sharded_fallback_reasons: Dict[str, str] = {}
+        # queries (or partitions) under execution('tpu') that fell back
+        # to a host engine — the dense/device/probe eligibility gates:
+        # count + last reason, populated by the planner so the
+        # downgrade is counted, not just logged
+        self.device_fallbacks: Dict[str, int] = {}
+        self.device_fallback_reasons: Dict[str, str] = {}
         # queries under @app:multiplex that could not be seated in a
         # shared engine (incompatible shape/feature): count + last
         # reason per query, populated by the multiplex planner; and the
@@ -342,6 +348,13 @@ class StatisticsManager:
         self.sharded_fallbacks[qname] = (
             self.sharded_fallbacks.get(qname, 0) + 1)
         self.sharded_fallback_reasons[qname] = reason
+
+    def record_device_fallback(self, qname: str, reason: str):
+        """A query (or partition) that requested execution('tpu') is
+        running on a host engine; counted with the last reason kept."""
+        self.device_fallbacks[qname] = (
+            self.device_fallbacks.get(qname, 0) + 1)
+        self.device_fallback_reasons[qname] = reason
 
     def record_multiplex_fallback(self, qname: str, reason: str):
         """A query under @app:multiplex is running on a dedicated
@@ -432,6 +445,10 @@ class StatisticsManager:
             out[self._metric("Queries", qname, "shardedFallbacks")] = n
             out[self._metric("Queries", qname, "shardedFallbackReason")] = (
                 self.sharded_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.device_fallbacks.items()):
+            out[self._metric("Queries", qname, "deviceFallbacks")] = n
+            out[self._metric("Queries", qname, "deviceFallbackReason")] = (
+                self.device_fallback_reasons.get(qname, ""))
         for qname, n in list(self.multiplex_fallbacks.items()):
             out[self._metric("Queries", qname, "multiplexFallbacks")] = n
             out[self._metric("Queries", qname, "multiplexFallbackReason")] = (

@@ -2,16 +2,19 @@
 
 ``python -m siddhi_tpu.analysis`` must exit 0 — zero unbaselined
 findings across ALL registered rules (device-contract, ingest staging,
-fault visibility, lock discipline, jit purity, retrace hazards) and no
-stale allowlist entries.  This is the single guard new code answers to:
+fault visibility, lock discipline, jit purity, retrace hazards,
+fallback discipline, thread lifecycle) and no stale allowlist
+entries.  This is the single guard new code answers to:
 a violation either gets fixed or gets an allowlist entry with a written
 justification, never a silent merge.
 """
 
+import json
 from pathlib import Path
 
 from siddhi_tpu.analysis import all_rules, index_package, run_rules
 from siddhi_tpu.analysis.__main__ import main
+from siddhi_tpu.analysis.index import ModuleIndex
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -19,9 +22,10 @@ REPO = Path(__file__).resolve().parent.parent
 def test_rule_catalog_is_complete():
     rules = all_rules()
     names = {r.name for r in rules}
-    assert len(rules) >= 6, names
+    assert len(rules) >= 8, names
     assert {"host-sync-hazard", "ingest-put-bypass", "broad-except-swallow",
-            "lock-discipline", "jit-purity", "retrace-hazard"} <= names
+            "lock-discipline", "jit-purity", "retrace-hazard",
+            "fallback-discipline", "thread-lifecycle"} <= names
     for r in rules:
         assert r.description, f"rule {r.name} has no description"
 
@@ -49,3 +53,41 @@ def test_cli_lists_rules(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     assert "jit-purity" in out and "lock-discipline" in out
+
+
+def test_cli_sarif_smoke(capsys):
+    """Fast-fail CI entry point: SARIF output, exit 0, >= 8 rules."""
+    rc = main(["--root", str(REPO / "siddhi_tpu"), "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    doc = json.loads(out)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    assert len(run["tool"]["driver"]["rules"]) >= 8
+    assert run["results"] == []  # clean package
+
+
+def test_json_report_stamps_rule_and_finding_counts(capsys):
+    rc = main(["--root", str(REPO / "siddhi_tpu"), "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    doc = json.loads(out)
+    assert doc["rule_count"] >= 8
+    assert doc["finding_count"] == 0
+    assert doc["rule_count"] == len(doc["rules"])
+
+
+def test_parse_cache_one_parse_per_file():
+    """The 8 rules (and repeated runs in one process) share one parse
+    per file, keyed (path, mtime, size)."""
+    root = REPO / "siddhi_tpu"
+    first = index_package(root, REPO)
+    count = ModuleIndex.parse_count
+    again = index_package(root, REPO)
+    assert ModuleIndex.parse_count == count  # no re-parse
+    assert [i.rel for i in again] == [i.rel for i in first]
+    assert all(a is b for a, b in zip(first, again))
+    # cache=False forces fresh parses (fixture isolation escape hatch)
+    index_package(root, REPO, cache=False)
+    assert ModuleIndex.parse_count == count + len(first)

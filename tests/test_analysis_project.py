@@ -1,0 +1,358 @@
+"""ProjectIndex: cross-module resolution the lexical pass cannot do.
+
+Covers the whole-program layer added over ``ModuleIndex``: import maps
+(absolute, relative, aliased, ``__init__`` re-exports), C3 MRO over
+project-local bases (mixins and diamonds), MRO-merged method tables,
+and the conservative call graph — plus the two DIFFERENTIAL fixtures
+the upgrade exists for: a mixin lock conflict and a cross-module jitted
+helper that the pre-project lexical pass provably misses (asserted:
+old resolver finds zero, project mode finds it).
+"""
+
+import textwrap
+from pathlib import Path
+
+from siddhi_tpu.analysis import (Allowlist, ModuleIndex, get_rule,
+                                 run_rules)
+from siddhi_tpu.analysis.project import ProjectIndex, module_name_of
+
+
+def _mod(rel, src):
+    return ModuleIndex(Path(rel), rel, source=textwrap.dedent(src))
+
+
+def make_project(files):
+    indexes = [_mod(rel, src) for rel, src in files.items()]
+    return ProjectIndex(indexes), {i.rel: i for i in indexes}
+
+
+# -- module naming / imports ------------------------------------------------
+
+def test_module_name_of():
+    assert module_name_of("siddhi_tpu/core/stream.py") == \
+        "siddhi_tpu.core.stream"
+    assert module_name_of("siddhi_tpu/core/__init__.py") == \
+        "siddhi_tpu.core"
+
+
+def test_import_map_absolute_relative_aliased():
+    proj, _ = make_project({
+        "pkg/__init__.py": "",
+        "pkg/a.py": "def f():\n    return 1\n",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/b.py": """
+            import pkg.a
+            from pkg.a import f
+            from pkg.a import f as g
+            from .. import a as amod
+            from ..a import f as h
+        """,
+    })
+    imp = proj.imports["pkg.sub.b"]
+    assert imp["f"] == "pkg.a.f"
+    assert imp["g"] == "pkg.a.f"
+    assert imp["amod"] == "pkg.a"
+    assert imp["h"] == "pkg.a.f"
+    assert imp["pkg"] == "pkg"
+    # all forms resolve to the same def
+    for name in ("f", "g", "h"):
+        assert proj.resolve_symbol("pkg.sub.b", name) == \
+            ("function", "pkg.a.f")
+    assert proj.resolve_symbol("pkg.sub.b", "amod.f") == \
+        ("function", "pkg.a.f")
+    assert proj.resolve_symbol("pkg.sub.b", "pkg.a.f") == \
+        ("function", "pkg.a.f")
+
+
+def test_reexport_chasing_through_package_init():
+    proj, _ = make_project({
+        "pkg/__init__.py": "from .impl import f\n",
+        "pkg/impl.py": "def f():\n    return 1\n",
+        "pkg/user.py": "from pkg import f\n",
+    })
+    assert proj.resolve_symbol("pkg.user", "f") == \
+        ("function", "pkg.impl.f")
+
+
+def test_function_local_imports_resolve():
+    proj, idxs = make_project({
+        "pkg/__init__.py": "",
+        "pkg/a.py": "def helper():\n    return 1\n",
+        "pkg/b.py": """
+            def outer():
+                from pkg.a import helper
+                return helper()
+        """,
+    })
+    idx = idxs["pkg/b.py"]
+    call = next(c for c in idx.calls())
+    hit = proj.resolve_call(idx, call)
+    assert hit is not None and hit[2] == "pkg.a.helper"
+
+
+# -- class hierarchy --------------------------------------------------------
+
+DIAMOND = {
+    "pkg/__init__.py": "",
+    "pkg/base.py": """
+        class Base:
+            def hello(self):
+                return "base"
+            def shared(self):
+                return "base"
+    """,
+    "pkg/mix.py": """
+        from pkg.base import Base
+        class Left(Base):
+            def shared(self):
+                return "left"
+        class Right(Base):
+            def hello(self):
+                return "right"
+    """,
+    "pkg/leaf.py": """
+        from pkg.mix import Left, Right
+        class Leaf(Left, Right):
+            pass
+    """,
+}
+
+
+def test_c3_mro_over_diamond():
+    proj, _ = make_project(DIAMOND)
+    assert proj.mro("pkg.leaf.Leaf") == [
+        "pkg.leaf.Leaf", "pkg.mix.Left", "pkg.mix.Right", "pkg.base.Base"]
+
+
+def test_method_resolution_most_derived_wins():
+    proj, _ = make_project(DIAMOND)
+    methods = proj.class_methods("pkg.leaf.Leaf")
+    assert methods["shared"][2] == "pkg.mix.Left"    # Left overrides Base
+    assert methods["hello"][2] == "pkg.mix.Right"    # Right overrides Base
+    # and the defining index is the defining module's
+    assert methods["shared"][0].rel == "pkg/mix.py"
+
+
+# -- call graph -------------------------------------------------------------
+
+def test_self_dispatch_resolves_through_mro():
+    proj, idxs = make_project({
+        "pkg/__init__.py": "",
+        "pkg/base.py": """
+            class Base:
+                def run(self):
+                    return self.work()
+        """,
+        "pkg/leaf.py": """
+            from pkg.base import Base
+            class Leaf(Base):
+                def work(self):
+                    return 1
+        """,
+    })
+    idx = idxs["pkg/base.py"]
+    call = next(c for c in idx.calls())
+    # from Base itself, work() is not defined anywhere on Base's MRO
+    assert proj.resolve_call(idx, call) is None
+    # ...but the merged table of Leaf sees Base.run AND Leaf.work
+    methods = proj.class_methods("pkg.leaf.Leaf")
+    assert set(methods) == {"run", "work"}
+
+
+def test_partial_and_wrapper_first_arg_resolve():
+    proj, idxs = make_project({
+        "pkg/__init__.py": "",
+        "pkg/a.py": "def f(x):\n    return x\n",
+        "pkg/b.py": """
+            import functools
+            from pkg.a import f
+            def build():
+                return functools.partial(f, 1)
+        """,
+    })
+    idx = idxs["pkg/b.py"]
+    call = next(c for c in idx.calls()
+                if idx.dotted(c.func) == "functools.partial")
+    hit = proj.resolve_call(idx, call)
+    assert hit is not None and hit[2] == "pkg.a.f"
+
+
+# -- differential fixtures: project mode catches what lexical misses --------
+
+MIXIN_LOCK_FILES = {
+    "pkg/__init__.py": "",
+    "pkg/retrymix.py": """
+        import threading
+        class RetryMixin:
+            def arm(self):
+                t = threading.Timer(1.0, self._fire)
+                t.daemon = True
+                t.start()
+            def _fire(self):
+                self.connected = True    # thread side, unlocked
+    """,
+    "pkg/client.py": """
+        from pkg.retrymix import RetryMixin
+        class Client(RetryMixin):
+            def shutdown(self):
+                self.connected = False   # main side, unlocked
+    """,
+}
+
+
+def test_lock_discipline_differential_mixin_conflict():
+    """The Timer target lives in the mixin, the main-path write in the
+    subclass: invisible lexically, a conflict through the MRO."""
+    rule = get_rule("lock-discipline")
+    indexes = [_mod(rel, src) for rel, src in MIXIN_LOCK_FILES.items()]
+    # OLD resolver (single-module lexical): zero findings on BOTH files
+    for idx in indexes:
+        rule.begin()
+        assert list(rule.check(idx)) == [], idx.rel
+    # NEW resolver (whole-program): exactly the mixin conflict
+    res = run_rules(indexes, [rule], {"lock-discipline":
+                                      Allowlist("lock-discipline", {})})
+    assert [(f.rel, f.scope) for f in res["findings"]] == \
+        [("pkg/client.py", "Client.connected")]
+
+
+CROSS_JIT_FILES = {
+    "pkg/__init__.py": "",
+    "pkg/steps.py": """
+        import time
+        def scan_step(state, cols):
+            t0 = time.time()    # host clock inside a jitted callable
+            return state + cols
+    """,
+    "pkg/engine.py": """
+        import jax
+        from pkg.steps import scan_step
+        class Engine:
+            def build(self):
+                self._step = jax.jit(scan_step)
+    """,
+}
+
+
+def test_jit_purity_differential_cross_module_callable():
+    """The jitted callable is imported from another module: the lexical
+    resolver cannot find its def; the project resolver follows the
+    import and attributes the finding to the helper's file."""
+    rule = get_rule("jit-purity")
+    indexes = [_mod(rel, src) for rel, src in CROSS_JIT_FILES.items()]
+    # OLD resolver: zero findings on BOTH files
+    for idx in indexes:
+        rule.begin()
+        assert list(rule.check(idx)) == [], idx.rel
+    # NEW resolver: the helper's host clock is found, in the helper
+    res = run_rules(indexes, [rule],
+                    {"jit-purity": Allowlist("jit-purity", {})})
+    assert [(f.rel, f.scope) for f in res["findings"]] == \
+        [("pkg/steps.py", "scan_step")]
+    assert "host clock" in res["findings"][0].message
+
+
+def test_jit_purity_follows_transitive_helpers():
+    """Effects two hops from the jitted root are still trace-time."""
+    rule = get_rule("jit-purity")
+    indexes = [_mod(rel, src) for rel, src in {
+        "pkg/__init__.py": "",
+        "pkg/low.py": """
+            def leaf(x):
+                print("tracing")   # effect two hops down
+                return x
+        """,
+        "pkg/mid.py": """
+            from pkg.low import leaf
+            def helper(x):
+                return leaf(x)
+        """,
+        "pkg/top.py": """
+            import jax
+            from pkg.mid import helper
+            def build():
+                return jax.jit(helper)
+        """,
+    }.items()]
+    res = run_rules(indexes, [rule],
+                    {"jit-purity": Allowlist("jit-purity", {})})
+    assert [(f.rel, f.scope) for f in res["findings"]] == \
+        [("pkg/low.py", "leaf")]
+
+
+def test_retrace_cross_module_builder_call():
+    """A hot function calling a non-hot builder in another module that
+    returns a fresh jit wrapper churns the compile cache; memoizing the
+    result at the call site is quiet."""
+    rule = get_rule("retrace-hazard")
+    churn = {
+        "pkg/__init__.py": "",
+        "pkg/build.py": """
+            import jax
+            def make_fn(c):
+                return jax.jit(lambda x: x * c)
+        """,
+        "pkg/hot.py": """
+            from pkg.build import make_fn
+            class E:
+                def process_batch(self, cols):
+                    f = make_fn(2)      # fresh wrapper per batch
+                    return f(cols)
+        """,
+    }
+    indexes = [_mod(rel, src) for rel, src in churn.items()]
+    # lexically invisible: the wrap is in another module
+    for idx in indexes:
+        rule.begin()
+        assert list(rule.check(idx)) == [], idx.rel
+    res = run_rules(indexes, [rule],
+                    {"retrace-hazard": Allowlist("retrace-hazard", {})})
+    assert [(f.rel, f.scope) for f in res["findings"]] == \
+        [("pkg/hot.py", "E.process_batch")]
+
+    memo = dict(churn)
+    memo["pkg/hot.py"] = """
+        from pkg.build import make_fn
+        class E:
+            def process_batch(self, cols):
+                if self._f is None:
+                    self._f = make_fn(2)
+                return self._f(cols)
+    """
+    indexes = [_mod(rel, src) for rel, src in memo.items()]
+    res = run_rules(indexes, [rule],
+                    {"retrace-hazard": Allowlist("retrace-hazard", {})})
+    assert res["findings"] == []
+
+
+def test_lock_discipline_mixin_conflict_dedups_to_base_most_class():
+    """The same mixin-internal conflict seen through N subclasses is
+    one finding, on the mixin."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/mix.py": """
+            import threading
+            class Mix:
+                def arm(self):
+                    t = threading.Timer(1.0, self._fire)
+                    t.daemon = True
+                    t.start()
+                def _fire(self):
+                    self.state = 1    # thread side
+                def reset(self):
+                    self.state = 0    # main side
+        """,
+        "pkg/subs.py": """
+            from pkg.mix import Mix
+            class A(Mix):
+                pass
+            class B(Mix):
+                pass
+        """,
+    }
+    indexes = [_mod(rel, src) for rel, src in files.items()]
+    rule = get_rule("lock-discipline")
+    res = run_rules(indexes, [rule], {"lock-discipline":
+                                      Allowlist("lock-discipline", {})})
+    assert [(f.rel, f.scope) for f in res["findings"]] == \
+        [("pkg/mix.py", "Mix.state")]

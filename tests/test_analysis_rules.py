@@ -283,6 +283,180 @@ def test_retrace_quiet_when_memoized_or_off_hot_path():
         assert _check("retrace-hazard", "siddhi_tpu/ops/x.py", src) == []
 
 
+# -- fallback-discipline ----------------------------------------------------
+
+def test_fallback_discipline_fires_when_not_counted():
+    hits = _check("fallback-discipline", "siddhi_tpu/planner/x.py", """
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+        def plan(log, name):
+            try:
+                lower(name)
+            except SiddhiAppCreationError as e:
+                log.warning("query '%s': fallback (%s)", name, e)
+    """)
+    assert [f.scope for f in hits] == ["plan"]
+    assert "no record_*_fallback" in hits[0].message
+
+
+def test_fallback_discipline_fires_when_not_logged():
+    hits = _check("fallback-discipline", "siddhi_tpu/planner/x.py", """
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+        def plan(sm, name):
+            try:
+                lower(name)
+            except SiddhiAppCreationError as e:
+                sm.record_kernel_fallback(name, str(e))
+    """)
+    assert [f.scope for f in hits] == ["plan"]
+    assert "no log.warning" in hits[0].message
+
+
+def test_fallback_discipline_quiet_when_counted_and_logged_or_reraised():
+    good = """
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+        def plan(log, sm, name):
+            try:
+                lower(name)
+            except SiddhiAppCreationError as e:
+                log.warning("query '%s': fallback (%s)", name, e)
+                sm.record_kernel_fallback(name, str(e))
+    """
+    reraise = """
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+        def plan(name):
+            try:
+                lower(name)
+            except SiddhiAppCreationError:
+                raise
+    """
+    assert _check("fallback-discipline", "siddhi_tpu/planner/x.py",
+                  good) == []
+    assert _check("fallback-discipline", "siddhi_tpu/planner/x.py",
+                  reraise) == []
+
+
+def test_fallback_discipline_follows_delegation_in_project_mode():
+    """Handler delegates to self._fallback two methods away — the call
+    graph proves both obligations are met."""
+    rule = get_rule("fallback-discipline")
+    src = """
+        import logging
+        from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+        log = logging.getLogger("x")
+        class Planner:
+            def _fallback(self, name, reason):
+                log.warning("query '%s': %s", name, reason)
+                self.sm.record_multiplex_fallback(name, reason)
+            def plan(self, name):
+                try:
+                    lower(name)
+                except SiddhiAppCreationError as e:
+                    return self._fallback(name, str(e))
+    """
+    idx = ModuleIndex(Path("fixture.py"), "siddhi_tpu/planner/x.py",
+                      source=textwrap.dedent(src))
+    # lexical mode cannot see into _fallback: it reports the gate
+    rule.begin()
+    assert [f.scope for f in rule.check(idx)] == ["Planner.plan"]
+    # project mode follows the edge and stays quiet
+    res = run_rules([idx], [rule], {"fallback-discipline":
+                                    Allowlist("fallback-discipline", {})})
+    assert res["findings"] == []
+
+
+# -- thread-lifecycle -------------------------------------------------------
+
+def test_thread_lifecycle_fires_on_unmanaged_thread():
+    hits = _check("thread-lifecycle", "siddhi_tpu/core/x.py", """
+        import threading
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+    """)
+    assert [f.scope for f in hits] == ["W.start"]
+
+
+def test_thread_lifecycle_quiet_on_daemon_or_joined():
+    daemon_kw = """
+        import threading
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+    """
+    daemon_attr = """
+        import threading
+        class W:
+            def arm(self):
+                t = threading.Timer(1.0, self._fire)
+                t.daemon = True
+                t.start()
+    """
+    joined = """
+        import threading
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+            def stop(self):
+                self._t.join()
+    """
+    cancelled = """
+        import threading
+        class W:
+            def arm(self):
+                self._timer = threading.Timer(1.0, self._fire)
+                self._timer.start()
+            def shutdown(self):
+                self._timer.cancel()
+    """
+    local_joined = """
+        import threading
+        def run_pool(fns):
+            ts = []
+            for fn in fns:
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+    """
+    for src in (daemon_kw, daemon_attr, joined, cancelled, local_joined):
+        assert _check("thread-lifecycle", "siddhi_tpu/core/x.py",
+                      src) == [], src
+
+
+def test_thread_lifecycle_join_in_subclass_resolves_in_project_mode():
+    """The mixin arms the Timer, the subclass's shutdown cancels it —
+    only the MRO-merged view connects the two."""
+    rule = get_rule("thread-lifecycle")
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/mix.py": """
+            import threading
+            class Mix:
+                def arm(self):
+                    self._timer = threading.Timer(1.0, self._fire)
+                    self._timer.start()
+        """,
+        "pkg/sub.py": """
+            from pkg.mix import Mix
+            class Sub(Mix):
+                def shutdown(self):
+                    self._timer.cancel()
+        """,
+    }
+    indexes = [ModuleIndex(Path(rel), rel, source=textwrap.dedent(src))
+               for rel, src in files.items()]
+    mix_idx = next(i for i in indexes if i.rel == "pkg/mix.py")
+    # lexically the mixin's Timer looks unmanaged...
+    rule.begin()
+    assert [f.scope for f in rule.check(mix_idx)] == ["Mix.arm"]
+    # ...project mode finds the subclass shutdown path
+    res = run_rules(indexes, [rule], {"thread-lifecycle":
+                                      Allowlist("thread-lifecycle", {})})
+    assert res["findings"] == []
+
+
 # -- allowlist mechanics ----------------------------------------------------
 
 BAD_EXCEPT = """
@@ -323,6 +497,67 @@ def test_allowlist_entries_expire():
                    {"siddhi_tpu/core/x.py:f": "obsolete"})
     assert [f.rule for f in res["findings"]] == ["stale-allowlist"]
     assert res["findings"][0].key == \
+        "broad-except-swallow:siddhi_tpu/core/x.py:f"
+
+
+def test_resolved_lock_entry_fails_as_stale_allowlist():
+    """The cross-module-upgrade hygiene loop: once a sanctioned
+    conflict is actually FIXED (the write is locked), its allowlist
+    entry fails the run until pruned."""
+    fixed = """
+        import threading
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+            def _loop(self):
+                with self._lock:
+                    self.count += 1
+            def reset(self):
+                with self._lock:
+                    self.count = 0
+    """
+    res = _run_one("lock-discipline", "siddhi_tpu/core/x.py", fixed,
+                   {"siddhi_tpu/core/x.py:Worker.count":
+                    "was unlocked before the fix"})
+    assert [f.rule for f in res["findings"]] == ["stale-allowlist"]
+    assert res["findings"][0].key == \
+        "lock-discipline:siddhi_tpu/core/x.py:Worker.count"
+
+
+# -- SARIF round-trip -------------------------------------------------------
+
+def test_sarif_round_trip_minimal_schema():
+    """Findings render to SARIF 2.1.0 with the minimal required shape:
+    schema/version, driver rule catalog, one result per finding with a
+    physical location and a stable fingerprint."""
+    import json
+
+    from siddhi_tpu.analysis import all_rules
+
+    res = _run_one("broad-except-swallow", "siddhi_tpu/core/x.py",
+                   BAD_EXCEPT, {})
+    rules = all_rules()
+    doc = json.loads(reporting.render_sarif(res["findings"], rules))
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "siddhi-tpu-analysis"
+    ids = [r["id"] for r in driver["rules"]]
+    assert ids == [r.name for r in rules]
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    (result,) = run["results"]
+    assert result["ruleId"] == "broad-except-swallow"
+    assert ids[result["ruleIndex"]] == "broad-except-swallow"
+    assert result["level"] == "error"
+    assert result["message"]["text"]
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "siddhi_tpu/core/x.py"
+    assert loc["region"]["startLine"] >= 1
+    # the fingerprint is the line-number-free allowlist identity
+    assert result["partialFingerprints"]["analysisKey/v1"] == \
         "broad-except-swallow:siddhi_tpu/core/x.py:f"
 
 
