@@ -117,6 +117,21 @@ SMOKE_FUSE_STEPS = 5
 SMOKE_HK_BATCH = 1_024
 SMOKE_HK_STEPS = 3
 
+# device-resident table measurement (siddhi_tpu/devtable/): a
+# stream-table join with concurrent update-or-insert traffic, once with
+# the table as device-resident columns (@app:devtables — [B,C] masked
+# probe + jitted scatters, matches stay device-resident to the
+# coalesced drain) and once against the host InMemoryTable (per-event
+# python probe + host materialization)
+DT_ROWS = 8_192
+DT_BATCH = 8_192
+DT_STEPS = 10
+DT_WARMUP = 2
+DT_WINDOWS = 3
+SMOKE_DT_ROWS = 512
+SMOKE_DT_BATCH = 2_048
+SMOKE_DT_STEPS = 4
+
 # Pallas kernel-vs-XLA variants (siddhi_tpu/kernels/): the same hot
 # step measured twice.  DEVICE ONLY — under --cpu-smoke the kernels run
 # interpreted (pure python loop semantics), so a kernel/XLA multiplier
@@ -678,6 +693,128 @@ def bench_hot_key(keys=HK_KEYS, batch=HK_BATCH, steps=HK_STEPS,
     return out
 
 
+def bench_devtable_join(rows=DT_ROWS, batch=DT_BATCH, steps=DT_STEPS,
+                        warmup=DT_WARMUP, windows=DT_WINDOWS):
+    """Device-resident table join (siddhi_tpu/devtable/): a bare
+    stream joined against a primary-key table under concurrent
+    update-or-insert traffic, once with ``@app:devtables`` (columnar
+    device storage, [B,C] masked probe, jitted one-hot scatters) and
+    once without (whatever path the planner picks when the table stays
+    host-resident).  Mutation batches ride WITH the probe traffic
+    inside the timed window, so the number prices the snapshot barrier
+    and scatter steps — not a frozen table.  Both runs see identical
+    traffic and must emit identical match counts."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    APP = ("@app:name('dtbench{tag}') @app:playback "
+           "@app:execution('tpu', ingest.depth='2', emit.depth='auto') "
+           "{dev}"
+           "define stream S (k int, x float); "
+           "define stream Ups (k int, v float); "
+           "@PrimaryKey('k') define table T (k int, v float); "
+           "from Ups update or insert into T set T.v = v on T.k == k; "
+           "@info(name='j') from S join T as t on S.k == t.k "
+           "select S.k as k, S.x as x, t.v as v insert into Out;")
+
+    rng = np.random.default_rng(41)
+
+    def mk_probe(i):
+        # stride keys over [0, 2*rows): ~50% of probes hit the table
+        k = ((np.arange(batch, dtype=np.int64) * 524287 + i * batch)
+             % (rows * 2)).astype(np.int32)
+        x = rng.uniform(0.0, 1.0, batch).astype(np.float32)
+        ts = np.full(batch, 1_000 + i * 20, dtype=np.int64)
+        return EventBatch("S", ["k", "x"], {"k": k, "x": x}, ts)
+
+    def mk_ups(i):
+        n = max(batch // 8, 1)
+        k = rng.integers(0, rows, n).astype(np.int32)
+        v = rng.uniform(0.0, 100.0, n).astype(np.float32)
+        ts = np.full(n, 1_010 + i * 20, dtype=np.int64)
+        return EventBatch("Ups", ["k", "v"], {"k": k, "v": v}, ts)
+
+    probes = [mk_probe(i) for i in range(warmup + steps)]
+    upserts = [mk_ups(i) for i in range(warmup + steps)]
+    seed_k = np.arange(rows, dtype=np.int32)
+    seed = EventBatch("Ups", ["k", "v"],
+                      {"k": seed_k, "v": (seed_k % 97).astype(np.float32)},
+                      np.full(rows, 500, dtype=np.int64))
+
+    def run(dev):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(APP.format(
+                tag="D" if dev else "H",
+                dev=(f"@app:devtables(capacity='{rows * 2}') "
+                     if dev else "")))
+            n_out = [0]
+            rt.add_callback("Out", lambda evs: n_out.__setitem__(
+                0, n_out[0] + len(evs)))
+            rt.start()
+            hs = rt.get_input_handler("S")
+            hu = rt.get_input_handler("Ups")
+            hu.send_batch(seed)
+            lowering = rt.lowering().get("j")
+            if dev:
+                assert lowering == "devtable", (
+                    f"bench join failed to take the devtable path: "
+                    f"{lowering}")
+            for i in range(warmup):
+                hu.send_batch(upserts[i])
+                hs.send_batch(probes[i])
+            rt.drain_device_emits()
+            window_rates = []
+            for w in range(windows):
+                # re-offset per window: timestamps stay monotone when
+                # the same batches are replayed each window
+                off = (w + 1) * 1_000_000
+                t_w = time.perf_counter()
+                for i in range(warmup, warmup + steps):
+                    u, p = upserts[i], probes[i]
+                    hu.send_batch(EventBatch(
+                        u.stream_id, u.attribute_names, u.columns,
+                        u.timestamps + off, u.types))
+                    hs.send_batch(EventBatch(
+                        p.stream_id, p.attribute_names, p.columns,
+                        p.timestamps + off, p.types))
+                rt.drain_device_emits()
+                window_rates.append(
+                    batch * steps / (time.perf_counter() - t_w))
+            counters = {}
+            if dev:
+                for k, v in rt.statistics().items():
+                    for sfx in ("devtableScatterSteps", "devtableLiveRows",
+                                "devtableCompactions", "devtableDemotions"):
+                        if k.endswith(sfx):
+                            counters[sfx] = counters.get(sfx, 0) + v
+            rt.shutdown()
+            return (float(np.median(window_rates)), window_rates,
+                    counters, n_out[0], lowering)
+        finally:
+            m.shutdown()
+
+    d_rate, d_windows, counters, d_rows, _ = run(True)
+    h_rate, _h_windows, _, h_rows, h_lowering = run(False)
+    assert counters.get("devtableScatterSteps", 0) >= 1, (
+        f"no scatter steps recorded on the device run: {counters}")
+    assert counters.get("devtableDemotions", 0) == 0, (
+        f"table demoted mid-bench (capacity sized wrong): {counters}")
+    assert d_rows == h_rows, (
+        f"devtable run emitted {d_rows} rows, host-table run {h_rows}")
+    out = {
+        "events_per_sec": d_rate,
+        "window_rates": [round(r, 1) for r in d_windows],
+        "fallback_events_per_sec": h_rate,
+        "vs_fallback": round(d_rate / h_rate, 3),
+        "fallback_lowering": h_lowering,
+        "matches": d_rows,
+        "table_rows": rows,
+    }
+    out.update(counters)
+    return out
+
+
 def kernel_eligible_app() -> str:
     """Capture-free escalation chain: fixed thresholds, final-node
     select only — the class the packed-plane NFA kernel covers (any
@@ -1162,6 +1299,18 @@ def main():
         except Exception as e:
             out["cpu_smoke_hot_key_error"] = str(e)
         try:
+            dt = bench_devtable_join(rows=SMOKE_DT_ROWS,
+                                     batch=SMOKE_DT_BATCH,
+                                     steps=SMOKE_DT_STEPS,
+                                     warmup=1, windows=2)
+            out["cpu_smoke_devtable_join_events_per_sec"] = round(
+                dt["events_per_sec"], 1)
+            out["cpu_smoke_devtable_join_vs_fallback"] = dt["vs_fallback"]
+            out["cpu_smoke_devtableScatterSteps"] = dt.get(
+                "devtableScatterSteps")
+        except Exception as e:
+            out["cpu_smoke_devtable_join_error"] = str(e)
+        try:
             ps = bench_persist_stall(keys=256, batch=4_096, fill_batches=8,
                                      rounds=3)
             out["cpu_smoke_persist_stall_ms_sync"] = round(ps["sync_ms"], 2)
@@ -1220,6 +1369,11 @@ def main():
                 "cpu_smoke_hot_key_vs_dense"),
             "cpu_smoke_hotkeyPromotions": smoke.get(
                 "cpu_smoke_hotkeyPromotions"),
+            "devtable_join_events_per_sec_per_chip": None,
+            "cpu_smoke_devtable_join_events_per_sec": smoke.get(
+                "cpu_smoke_devtable_join_events_per_sec"),
+            "cpu_smoke_devtable_join_vs_fallback": smoke.get(
+                "cpu_smoke_devtable_join_vs_fallback"),
             "persist_stall_ms_sync": None,
             "persist_stall_ms_async": None,
             "cpu_smoke_persist_stall_ms_sync": smoke.get(
@@ -1246,6 +1400,7 @@ def main():
     fused = bench_fused_pipeline()
     trace_oh = bench_trace_overhead()
     hotkey = bench_hot_key()
+    devtable = bench_devtable_join()
     host = bench_host_baseline()
     persist = bench_persist_stall()
     # Pallas kernel-vs-XLA variants: guarded individually — a Mosaic
@@ -1332,6 +1487,13 @@ def main():
         "hot_key_hotkeyPromotions": hotkey["hotkeyPromotions"],
         "hot_key_hotkeyDemotions": hotkey["hotkeyDemotions"],
         "hot_key_hotkeyRoutedEvents": hotkey["hotkeyRoutedEvents"],
+        "devtable_join_events_per_sec_per_chip": round(
+            devtable["events_per_sec"], 1),
+        "devtable_join_vs_fallback": devtable["vs_fallback"],
+        "devtable_join_fallback_lowering": devtable["fallback_lowering"],
+        "devtable_join_window_rates": devtable["window_rates"],
+        "devtable_join_matches": devtable["matches"],
+        "devtable_join_scatter_steps": devtable.get("devtableScatterSteps"),
         "persist_stall_ms_sync": round(persist["sync_ms"], 2),
         "persist_stall_ms_async": round(persist["async_ms"], 2),
         "persist_stall_ratio": round(persist["stall_ratio"], 3),

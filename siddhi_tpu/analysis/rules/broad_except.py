@@ -24,7 +24,7 @@ from ..index import ModuleIndex
 
 SCANNED_DIRS = ("siddhi_tpu/core/", "siddhi_tpu/transport/",
                 "siddhi_tpu/durability/", "siddhi_tpu/observability/",
-                "siddhi_tpu/kernels/")
+                "siddhi_tpu/kernels/", "siddhi_tpu/devtable/")
 
 BROAD = {"Exception", "BaseException"}
 

@@ -62,6 +62,13 @@ SCANNED = (
     "siddhi_tpu/kernels/bank_scatter.py",
     "siddhi_tpu/kernels/scan_chain.py",
     "siddhi_tpu/kernels/dense_step.py",
+    # device tables: columnar HBM storage + join probes — mutations may
+    # only touch the device through staged_put and leave it through the
+    # count-gated fetch_coalesced drain (demotion rebuilds included)
+    "siddhi_tpu/devtable/__init__.py",
+    "siddhi_tpu/devtable/storage.py",
+    "siddhi_tpu/devtable/join.py",
+    "siddhi_tpu/devtable/planner.py",
 )
 
 MATERIALIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",

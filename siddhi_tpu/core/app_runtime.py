@@ -91,9 +91,15 @@ class SiddhiAppRuntime:
         """App-wide flush barrier of the async emit pipeline: every
         device runtime's queued match batches materialize and emit (in
         the synchronous order) before host code observes state —
-        snapshot/persist/restore, pull queries, shutdown."""
+        snapshot/persist/restore, pull queries, shutdown.  Device
+        tables drain LAST: an emit drain can trigger mutation callbacks,
+        and the table barrier (compaction + revision advance + pinning)
+        must see them."""
         for rt in self._device_runtimes():
             rt.drain()
+        for t in self.tables.values():
+            if hasattr(t, "drain"):
+                t.drain()
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -148,6 +148,14 @@ class SiddhiAppContext:
         # kernelFallbackReasons.
         self.kernels = False
         self.kernel_kinds = ("nfa", "bank", "scan")
+        # @app:devtables(capacity='N'): store eligible tables as
+        # device-resident columnar arrays (siddhi_tpu/devtable/) — one
+        # [capacity] device column per attribute + validity lane, jitted
+        # scatter mutations, [B,C] masked join probes.  Off by default;
+        # ineligible tables/queries keep the host path with counted
+        # devtableFallbackReasons.  capacity is the per-table slot count.
+        self.devtables = False
+        self.devtable_capacity = 1024
         # @app:persist(interval='30 sec', mode='async'): default persist()
         # mode ('sync' keeps the historical stop-the-world behavior;
         # 'async' captures under the barrier and writes on the checkpoint

@@ -1,0 +1,21 @@
+"""Device-resident tables: columnar HBM storage, jitted scatter
+mutations, snapshot-consistent stream-table joins.
+
+Enabled per app by ``@app:devtables(capacity='N')`` under
+``@app:execution('tpu')``.  Eligible tables build as ``DeviceTable``
+(columnar ``[C]`` device arrays + validity lane + host slot map);
+ineligible ones fall back to ``InMemoryTable`` — logged and counted,
+never an error.
+"""
+
+from .join import DevTableJoinReceiver, DevTableJoinRuntime
+from .planner import plan_devtable_mutation, try_plan_devtable_join
+from .storage import DeviceTable
+
+__all__ = [
+    "DeviceTable",
+    "DevTableJoinReceiver",
+    "DevTableJoinRuntime",
+    "plan_devtable_mutation",
+    "try_plan_devtable_join",
+]

@@ -1,0 +1,287 @@
+"""Stream-table joins against device-resident tables.
+
+``DevTableJoinRuntime`` replaces the host ``JoinRuntime`` +
+``JoinStreamReceiver`` pair for eligible queries (inner join, one
+``DeviceTable`` side, windowless/filterless stream side, a primary-key
+equality conjunct): the arriving micro-batch ships its key lane and the
+condition-referenced attribute lanes to the device once, a jitted
+``[B, C]`` masked probe gathers the matched table row per event and
+evaluates the FULL join condition on device lanes, and matched pairs
+ride the existing async emit pipeline — zero host materialization
+between ingest and emit.
+
+Snapshot consistency: the probe closes over the table's CURRENT column
+references at dispatch (``DeviceTable.device_state`` under the table
+lock).  JAX arrays are immutable, so scatter mutations landing while
+the probe is in flight produce NEW arrays and never tear the probed
+view — the probe reads exactly the revision-in-progress it dispatched
+against, the device analog of the host path's lock-ordered probe.
+
+Because the eligibility gate requires a primary-key equality conjunct,
+at most ONE table row matches each event, so output shapes are fixed
+``[B]`` lanes and matched pairs emit in arrival order — bit-identical
+to the host ``JoinRuntime._join``'s row-major ``np.nonzero`` order.
+
+The runtime mirrors ``DeviceQueryRuntime``'s pipeline discipline:
+``IngestStage`` staging for the count gate, ``EmitQueue`` for deferred
+materialization, per-batch fault isolation through ``on_fault``, cycle
+tokens and ``table.probe`` spans for observability.  A demoted table
+(or a null-carrying batch) falls back per batch to the exact host
+cross-product semantics — after a pipeline drain, so emit order holds.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.emit_queue import EmitQueue, EmitStats, PendingEmit, fetch_coalesced
+from siddhi_tpu.core.event import EventBatch
+from siddhi_tpu.core.ingest_stage import IngestStage, IngestStats, staged_put
+from siddhi_tpu.planner.expr import N_KEY, TS_KEY
+
+log = logging.getLogger("siddhi_tpu")
+
+
+def _pow2(n: int, floor: int = 16) -> int:
+    return max(1 << (max(n, 1) - 1).bit_length(), floor)
+
+
+class DevTableJoinRuntime:
+    """One stream-table join lowered onto the device batch cycle."""
+
+    MAX_CHUNK = 4096  # [B, C] probe work bound; larger batches chunk
+
+    def __init__(self, name: str, stream_side, table_side, stream_is_left: bool,
+                 condition, key_expr, cond_stream_lanes: Dict[str, Tuple[str, np.dtype]],
+                 out_stream_id: str, emit, emit_depth=1, ingest_depth=1,
+                 clock=None, faults=None, tracer=None):
+        import jax
+
+        self.name = name
+        self.stream_side = stream_side
+        self.table_side = table_side
+        self.table = table_side.table
+        self.stream_is_left = stream_is_left
+        self.condition = condition
+        self.key_expr = key_expr
+        # condition-referenced stream attrs riding device lanes:
+        # env key -> (attribute name, lane dtype)
+        self._cond_lanes = cond_stream_lanes
+        self.out_stream_id = out_stream_id
+        self.emit = emit
+        self.clock = clock
+        self.faults = faults
+        self.tracer = tracer
+        self.engine_kind = "devtable_join"
+        self.step_invocations = 0
+        self.probe_invocations = 0
+        self.host_fallback_batches = 0
+        self.emit_stats = EmitStats()
+        self.emit_queue = EmitQueue(depth=emit_depth, stats=self.emit_stats,
+                                    faults=faults, on_fault=self._on_fault)
+        self.ingest_stats = IngestStats()
+        self.ingest_stage = IngestStage(depth=ingest_depth, stats=self.ingest_stats,
+                                        faults=faults, on_fault=self._on_fault)
+        left, right = ((stream_side, table_side) if stream_is_left
+                       else (table_side, stream_side))
+        self._out_names = [
+            left.qualified_key(a.name) for a in left.definition.attributes
+        ] + [right.qualified_key(a.name) for a in right.definition.attributes]
+        self._tbl_names = [a.name for a in self.table.definition.attributes]
+        tbl_env = {table_side.qualified_key(a.name): a.name
+                   for a in self.table.definition.attributes}
+        cond_fn = condition.fn
+
+        def probe(keys, ev_mask, ev_lanes, pk_col, tcols, valid):
+            import jax.numpy as jnp
+
+            oneh = (keys[:, None] == pk_col[None, :]) & valid[None, :]
+            matched = oneh.any(axis=1) & ev_mask
+            slot = jnp.argmax(oneh, axis=1)
+            gathered = {nm: c[slot] for nm, c in tcols.items()}
+            env = dict(ev_lanes)
+            for qk, nm in tbl_env.items():
+                env[qk] = gathered[nm]
+            env[N_KEY] = keys.shape[0]
+            ok = jnp.broadcast_to(
+                jnp.asarray(cond_fn(env)).astype(bool), matched.shape)
+            mask = matched & ok
+            return mask, gathered, jnp.sum(mask.astype(jnp.int32))
+
+        self._probe = jax.jit(probe)
+
+    def _on_fault(self, e):
+        if self.tracer is not None:
+            self.tracer.dump(f"onerror-isolation:{type(e).__name__}")
+        if self.faults is not None:
+            self.faults.notify(e)
+
+    # -- batch entry ------------------------------------------------------
+
+    def process_stream_batch(self, batch: EventBatch):
+        cur = batch.only(ev.CURRENT)
+        n = len(cur)
+        if n == 0:
+            return
+        now = self.clock() if self.clock is not None else 0
+        host_reason = self._host_only_reason(cur)
+        if host_reason is not None:
+            # pipeline barrier first so the synchronous host emit cannot
+            # overtake queued device emits from earlier batches
+            self.ingest_stage.flush()
+            self.emit_queue.drain()
+            self.host_fallback_batches += 1
+            self._host_join(cur, now)
+            return
+        tok = (self.tracer.begin_cycle(self.engine_kind, n)
+               if self.tracer is not None else None)
+        keys = self._event_keys(cur)
+        for lo in range(0, n, self.MAX_CHUNK):
+            hi = min(n, lo + self.MAX_CHUNK)
+            self._dispatch_chunk(cur, keys, lo, hi, now, tok)
+
+    def _host_only_reason(self, cur: EventBatch) -> Optional[str]:
+        if self.table.demoted:
+            return "table demoted to host"
+        for _, (attr, _dt) in self._cond_lanes.items():
+            if cur.columns[attr].dtype.kind == "O":
+                return f"nulls in condition attribute '{attr}'"
+        return None
+
+    def _event_keys(self, cur: EventBatch) -> np.ndarray:
+        env = {self.stream_side.qualified_key(a.name): cur.columns[a.name]
+               for a in self.stream_side.definition.attributes}
+        env[TS_KEY] = cur.timestamps
+        env[N_KEY] = len(cur)
+        return np.broadcast_to(self.key_expr.fn(env), (len(cur),))
+
+    def _dispatch_chunk(self, cur, keys, lo, hi, now, tok):
+        cn = hi - lo
+        B = _pow2(cn)
+        klane = np.zeros(B, dtype=np.int32)
+        klane[:cn] = keys[lo:hi].astype(np.int32, copy=False)
+        mlane = np.zeros(B, dtype=bool)
+        mlane[:cn] = True
+        lanes = {}
+        for ek, (attr, dt) in self._cond_lanes.items():
+            col = np.zeros(B, dtype=dt)
+            col[:cn] = cur.columns[attr][lo:hi].astype(dt, copy=False)
+            lanes[ek] = col
+        # snapshot-consistent: CURRENT immutable refs, under the table lock
+        tcols, tvalid = self.table.device_state()
+        t0 = time.perf_counter()
+        k_d, m_d, l_d = staged_put((klane, mlane, lanes),
+                                   faults=self.faults, stats=self.ingest_stats)
+        mask_d, gathered_d, count_d = self._probe(
+            k_d, m_d, l_d, tcols[self.table.pk], tcols, tvalid)
+        self.step_invocations += 1
+        self.probe_invocations += 1
+        if self.tracer is not None:
+            from siddhi_tpu.observability.trace import STAGE_TABLE_PROBE
+
+            self.tracer.record_span(STAGE_TABLE_PROBE, self.engine_kind,
+                                    t0, time.perf_counter(), n_events=cn)
+
+        def finish():
+            c = int(fetch_coalesced([count_d])[0])
+            if tok is not None:
+                tok.step_done(c)
+            if c == 0:
+                self.emit_queue.skip()
+                return
+            arrays = [mask_d] + [gathered_d[nm] for nm in self._tbl_names]
+            self.emit_queue.push(PendingEmit(
+                arrays,
+                lambda host: self._materialize(host, cur, lo, now),
+                trace=tok))
+
+        self.ingest_stage.submit(count_d, finish, trace=tok)
+
+    # -- deferred materialization (runs on fetched HOST arrays) -----------
+
+    def _materialize(self, host: List[np.ndarray], cur: EventBatch,
+                     lo: int, now: int):
+        mask = host[0]
+        sel = np.flatnonzero(mask)
+        rows = sel + lo
+        cols: Dict[str, np.ndarray] = {}
+        for a in self.stream_side.definition.attributes:
+            cols[self.stream_side.qualified_key(a.name)] = \
+                cur.columns[a.name][rows]
+        for i, nm in enumerate(self._tbl_names):
+            cols[self.table_side.qualified_key(nm)] = host[1 + i][sel]
+        out = EventBatch(
+            self.out_stream_id,
+            self._out_names,
+            {k: cols[k] for k in self._out_names},
+            cur.timestamps[rows],
+            np.full(len(rows), ev.CURRENT, dtype=np.int8),
+        )
+        out.aux["emit_now"] = now
+        self.emit(out)
+
+    # -- per-batch host fallback (exact host-join semantics) ---------------
+
+    def _host_join(self, cur: EventBatch, now: int):
+        buf = self.table.rows_batch()
+        n_a, n_b = len(cur), len(buf)
+        if n_b == 0:
+            return
+        env: Dict[str, np.ndarray] = {}
+        for a in self.stream_side.definition.attributes:
+            env[self.stream_side.qualified_key(a.name)] = np.repeat(
+                cur.columns[a.name], n_b)
+        for a in self.table.definition.attributes:
+            env[self.table_side.qualified_key(a.name)] = np.tile(
+                buf.columns[a.name], n_a)
+        env[TS_KEY] = np.repeat(cur.timestamps, n_b)
+        env[N_KEY] = n_a * n_b
+        mask2 = np.broadcast_to(
+            self.condition.fn(env), (n_a * n_b,)).reshape(n_a, n_b)
+        ai, bi = np.nonzero(mask2)
+        if len(ai) == 0:
+            return
+        cols: Dict[str, np.ndarray] = {}
+        for a in self.stream_side.definition.attributes:
+            cols[self.stream_side.qualified_key(a.name)] = \
+                cur.columns[a.name][ai]
+        for a in self.table.definition.attributes:
+            cols[self.table_side.qualified_key(a.name)] = buf.columns[a.name][bi]
+        out = EventBatch(
+            self.out_stream_id,
+            self._out_names,
+            {k: cols[k] for k in self._out_names},
+            cur.timestamps[ai],
+            np.full(len(ai), ev.CURRENT, dtype=np.int8),
+        )
+        out.aux["emit_now"] = now
+        self.emit(out)
+
+    # -- barrier contract ---------------------------------------------------
+
+    def drain(self):
+        self.ingest_stage.flush()
+        self.emit_queue.drain()
+
+    def snapshot(self) -> Dict:
+        self.drain()
+        return {}
+
+    def restore(self, state: Dict):
+        self.drain()
+
+
+class DevTableJoinReceiver:
+    """Junction subscriber replacing ``JoinStreamReceiver`` for the
+    stream side of a devtable-lowered join."""
+
+    def __init__(self, runtime: DevTableJoinRuntime):
+        self.runtime = runtime
+
+    def receive(self, batch: EventBatch):
+        self.runtime.process_stream_batch(batch)

@@ -42,9 +42,14 @@ STAGE_EMIT = "emit"
 #: checkpoint-path stages (free-running, engine kind 'persist')
 STAGE_PERSIST_CAPTURE = "persist.capture"
 STAGE_PERSIST_WRITE = "persist.write"
+#: device-table stages (devtable/): join-probe dispatch and the
+#: mutation scatter step
+STAGE_TABLE_PROBE = "table.probe"
+STAGE_TABLE_UPSERT = "table.upsert"
 
 _STAGES = (STAGE_INGEST, STAGE_STEP, STAGE_EMIT,
-           STAGE_PERSIST_CAPTURE, STAGE_PERSIST_WRITE)
+           STAGE_PERSIST_CAPTURE, STAGE_PERSIST_WRITE,
+           STAGE_TABLE_PROBE, STAGE_TABLE_UPSERT)
 
 
 class CycleToken:

@@ -259,6 +259,29 @@ class AppPlanner:
                 self.app_context.kernels = True
                 self.app_context.kernel_kinds = kinds
 
+        # @app:devtables / @app:devtables(capacity='N'): device-resident
+        # columnar tables (siddhi_tpu/devtable/); ineligible tables and
+        # queries keep the host path with counted devtableFallbackReasons.
+        dt_ann = find_annotation(siddhi_app.annotations, "app:devtables")
+        if dt_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:devtables needs @app:execution('tpu')")
+            v = (dt_ann.element() or "true").strip().lower()
+            if v != "false":
+                self.app_context.devtables = True
+            cap = dt_ann.element("capacity")
+            if cap:
+                try:
+                    ncap = int(cap)
+                except ValueError:
+                    ncap = -1
+                if ncap < 1 or ncap > 1 << 24:
+                    raise SiddhiAppCreationError(
+                        f"@app:devtables: capacity='{cap}' must be an "
+                        "integer in 1..16777216 (device slots per table)")
+                self.app_context.devtable_capacity = ncap
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")
@@ -622,6 +645,27 @@ class AppPlanner:
 
         store_ann = find_annotation(td.annotations, "store")
         if store_ann is None:
+            if self.app_context.devtables:
+                import logging
+
+                from siddhi_tpu.devtable import DeviceTable
+
+                sm = self.app_context.statistics_manager
+                try:
+                    table = DeviceTable(
+                        td, capacity=self.app_context.devtable_capacity,
+                        faults=self.app_context.fault_injector,
+                        tracer=self.app_context.tracer,
+                        statistics_manager=sm)
+                    if sm is not None:
+                        sm.register_devtable(td.id, table)
+                    return table
+                except SiddhiAppCreationError as e:
+                    logging.getLogger("siddhi_tpu").warning(
+                        "table '%s': @app:devtables requested but the "
+                        "table stays host-resident (%s)", td.id, e)
+                    if sm is not None:
+                        sm.record_devtable_fallback(f"table:{td.id}", str(e))
             return InMemoryTable(td)
         stype, options = self._transport_config(store_ann, "store")
         factory = self.extensions.lookup("store", stype)

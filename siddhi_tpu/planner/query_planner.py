@@ -368,7 +368,7 @@ class QueryPlanner:
             query.selector, scope, compiler, name, query, batch_mode,
             star_sources=[left, right],
         )
-        output = self._plan_output(query, out_def)
+        output = self._plan_output(query, out_def, qname=name)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
         if rate_limiter.needs_scheduler_task:
@@ -380,6 +380,48 @@ class QueryPlanner:
             out_stream_id=f"#join_{name}",
         )
         qr.join_runtime = jr
+        # @app:devtables: an inner join against a DeviceTable side lowers
+        # to the [B,C] masked device probe (devtable/join.py) — the
+        # stream side subscribes the devtable receiver INSTEAD of the
+        # host JoinStreamReceiver, so matched pairs never materialize on
+        # the host between ingest and emit
+        devtable_runtime = None
+        if self.app.app_context.devtables and (
+                left.table is not None or right.table is not None):
+            import logging
+
+            from siddhi_tpu.devtable import (
+                DeviceTable,
+                DevTableJoinReceiver,
+                try_plan_devtable_join,
+            )
+
+            if isinstance(left.table, DeviceTable) or \
+                    isinstance(right.table, DeviceTable):
+                try:
+                    devtable_runtime = try_plan_devtable_join(
+                        name, j, left, right, condition, compiler,
+                        emit=lambda batch: qr.process(batch, 0),
+                        app_context=self.app.app_context)
+                    qr.device_runtime = devtable_runtime
+                    qr.lowered_to = "devtable"
+                    logging.getLogger("siddhi_tpu").info(
+                        "query '%s': stream-table join lowered to the "
+                        "device-resident table probe", name)
+                except SiddhiAppCreationError as e:
+                    logging.getLogger("siddhi_tpu").warning(
+                        "query '%s': devtable join unavailable (%s); "
+                        "host join path used", name, e)
+                    sm = self.app.app_context.statistics_manager
+                    if sm is not None:
+                        sm.record_devtable_fallback(name, str(e))
+        if devtable_runtime is not None:
+            for side, src in ((left, j.left), (right, j.right)):
+                if side.table is not None or side.aggregation is not None:
+                    continue
+                junction = self.app.junction_for_input(src)
+                junction.subscribe(DevTableJoinReceiver(devtable_runtime))
+            return qr
         # @app:execution('tpu'): run the O(B*W) cross-product condition
         # as a jitted device kernel (buffering/expiry/materialization
         # keep the host runtime's exact semantics — SURVEY §7 step 7's
@@ -465,7 +507,7 @@ class QueryPlanner:
         selector, out_def = self._plan_selector(
             query.selector, scope, compiler, name, query, batch_mode=False
         )
-        output = self._plan_output(query, out_def)
+        output = self._plan_output(query, out_def, qname=name)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
         if rate_limiter.needs_scheduler_task:
@@ -599,7 +641,7 @@ class QueryPlanner:
             ]
             selector = self._passthrough_selector(sel, out_names, out_target)
             out_def = StreamDefinition(id=out_target, attributes=out_attrs)
-        output = self._plan_output(query, out_def)
+        output = self._plan_output(query, out_def, qname=name)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(name, [[]], selector, rate_limiter, output, self.app.app_context)
 
@@ -714,7 +756,7 @@ class QueryPlanner:
             query.selector, scope, compiler, name, query, batch_mode,
             extra_attrs=extra_attrs,
         )
-        output = self._plan_output(query, out_def)
+        output = self._plan_output(query, out_def, qname=name)
         rate_limiter = self._plan_rate_limiter(query)
 
         qr = QueryRuntime(name, [chain], selector, rate_limiter, output, self.app.app_context)
@@ -828,7 +870,7 @@ class QueryPlanner:
         selector = self._passthrough_selector(
             query.selector, engine.output_names, out_target)
         out_def = StreamDefinition(id=out_target, attributes=out_attrs)
-        output = self._plan_output(query, out_def)
+        output = self._plan_output(query, out_def, qname=name)
         rate_limiter = self._plan_rate_limiter(query)
         qr = QueryRuntime(
             name, [[]], selector, rate_limiter, output, self.app.app_context)
@@ -1059,7 +1101,8 @@ class QueryPlanner:
 
     # -- output -------------------------------------------------------------
 
-    def _plan_output(self, query: Query, out_def: StreamDefinition):
+    def _plan_output(self, query: Query, out_def: StreamDefinition,
+                     qname: Optional[str] = None):
         from siddhi_tpu.query_api import DeleteStream, UpdateOrInsertStream, UpdateStream
         from siddhi_tpu.table import (
             DeleteTableCallback,
@@ -1108,20 +1151,47 @@ class QueryPlanner:
                 table, out.on_condition, out_scope, table_resolver=self.app.table_resolver
             )
             if isinstance(out, DeleteStream):
-                return DeleteTableCallback(table, condition, out.event_type)
-            set_ops = compile_set_clause(
-                table,
-                out.set_clause,
-                out_scope,
-                [a.name for a in out_def.attributes],
-                table_resolver=self.app.table_resolver,
-            )
-            if isinstance(out, UpdateOrInsertStream):
-                return UpdateOrInsertTableCallback(
-                    table, condition, set_ops, out.event_type,
+                cb = DeleteTableCallback(table, condition, out.event_type)
+            else:
+                set_ops = compile_set_clause(
+                    table,
+                    out.set_clause,
+                    out_scope,
                     [a.name for a in out_def.attributes],
+                    table_resolver=self.app.table_resolver,
                 )
-            return UpdateTableCallback(table, condition, set_ops, out.event_type)
+                if isinstance(out, UpdateOrInsertStream):
+                    cb = UpdateOrInsertTableCallback(
+                        table, condition, set_ops, out.event_type,
+                        [a.name for a in out_def.attributes],
+                    )
+                else:
+                    cb = UpdateTableCallback(
+                        table, condition, set_ops, out.event_type)
+            # @app:devtables: lower the mutation to one scatter step per
+            # batch when the gates pass; the generic callback rides along
+            # as the per-batch delegate for kernel-inexpressible shapes
+            if self.app.app_context.devtables:
+                from siddhi_tpu.devtable import DeviceTable, plan_devtable_mutation
+
+                if isinstance(table, DeviceTable):
+                    import logging
+
+                    who = qname or f"table:{out.target}"
+                    try:
+                        return plan_devtable_mutation(
+                            who, out, out_def, out_scope, table, cb,
+                            functions=self.app.functions,
+                            table_resolver=self.app.table_resolver)
+                    except SiddhiAppCreationError as e:
+                        logging.getLogger("siddhi_tpu").warning(
+                            "query '%s': devtable mutation lowering "
+                            "unavailable (%s); per-row host callback "
+                            "used", who, e)
+                        sm = self.app.app_context.statistics_manager
+                        if sm is not None:
+                            sm.record_devtable_fallback(who, str(e))
+            return cb
         if isinstance(out, ReturnStream) or out is None:
             return QueryCallbackOutput()
         raise SiddhiAppCreationError(

@@ -597,8 +597,17 @@ class RecordTableRuntime:
             ]
             self.handler.on_update(self._row_ir, params_list, set_maps, self.store.update)
             if self.cache is not None and self.primary_keys:
-                for r in rows:
+                # A set clause that rewrites a primary-key attribute moves
+                # the row to a NEW key: invalidating only the pre-update key
+                # would leave any cached entry under the destination key
+                # stale (update-or-insert probes would keep serving it).
+                pk_rewrite = any(k in values for k in self.primary_keys)
+                for j, r in enumerate(rows):
                     self.cache.invalidate(self._pk_key(r))
+                    if pk_rewrite:
+                        merged = [set_maps[j].get(nm, r[i])
+                                  for i, nm in enumerate(self._names)]
+                        self.cache.invalidate(self._pk_key(merged))
 
     def contains_fn(self, attr_hint: Optional[str] = None) -> Callable:
         if self.primary_keys and len(self.primary_keys) == 1:

@@ -297,6 +297,15 @@ class StatisticsManager:
         # the plain XLA formulation is never silent
         self.kernel_fallbacks: Dict[str, int] = {}
         self.kernel_fallback_reasons: Dict[str, str] = {}
+        # queries/tables under @app:devtables that kept (or returned to)
+        # the host table path — build-time eligibility gates, plan-time
+        # join/mutation gates, mid-run demotions and per-batch generic
+        # delegations: count + last reason, keyed '<query>' or
+        # 'table:<id>'; and the live DeviceTable instances, read each
+        # report for their rows/capacity/revision/demotion gauges
+        self.devtable_fallbacks: Dict[str, int] = {}
+        self.devtable_fallback_reasons: Dict[str, str] = {}
+        self.devtables: Dict[str, object] = {}
         # batch-cycle tracer (observability/trace.py); registered ungated
         # at app build — stage_stats() only reports stages that actually
         # recorded spans, so host-only apps keep an empty feed
@@ -386,6 +395,20 @@ class StatisticsManager:
             self.kernel_fallbacks.get(qname, 0) + 1)
         self.kernel_fallback_reasons[qname] = reason
 
+    def record_devtable_fallback(self, name: str, reason: str):
+        """A query or table under @app:devtables is using the host
+        table path (ineligible, demoted, or a batch delegated to the
+        generic callback); counted with the last reason kept."""
+        self.devtable_fallbacks[name] = (
+            self.devtable_fallbacks.get(name, 0) + 1)
+        self.devtable_fallback_reasons[name] = reason
+
+    def register_devtable(self, tname: str, table):
+        """A live DeviceTable; its ``devtable_metrics()`` gauges (live
+        rows, capacity, revision, scatter steps, compactions,
+        demotions) join the feed under ``Tables.<name>.*``."""
+        self.devtables[tname] = table
+
     def register_hotkey_router(self, qname: str, router):
         """A live HotKeyRouterRuntime; its ``hot_metrics()`` gauges
         (promotions/demotions/routed events/active keys) join the
@@ -470,6 +493,13 @@ class StatisticsManager:
             out[self._metric("Queries", qname, "kernelFallbacks")] = n
             out[self._metric("Queries", qname, "kernelFallbackReason")] = (
                 self.kernel_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.devtable_fallbacks.items()):
+            out[self._metric("Queries", qname, "devtableFallbacks")] = n
+            out[self._metric("Queries", qname, "devtableFallbackReason")] = (
+                self.devtable_fallback_reasons.get(qname, ""))
+        for tname, table in list(self.devtables.items()):
+            for metric, v in table.devtable_metrics().items():
+                out[self._metric("Tables", tname, metric)] = v
         if self.tracer is not None:
             for stage, metrics in self.tracer.stage_stats().items():
                 for metric, v in metrics.items():
