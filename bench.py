@@ -117,6 +117,17 @@ SMOKE_FUSE_STEPS = 5
 SMOKE_HK_BATCH = 1_024
 SMOKE_HK_STEPS = 3
 
+# cost-based unified lowering acceptance (planner/costmodel.py): each
+# annotated bench shape re-run UN-annotated under @app:plan(auto='true')
+# — the cost model must re-derive the hand-pinned lowering and match
+# its throughput (same engines, so any gap is model overhead)
+PLN_BATCH = 8_192
+PLN_STEPS = 6
+PLN_WARMUP = 2
+PLN_WINDOWS = 3
+SMOKE_PLN_BATCH = 2_048
+SMOKE_PLN_STEPS = 3
+
 # device-resident table measurement (siddhi_tpu/devtable/): a
 # stream-table join with concurrent update-or-insert traffic, once with
 # the table as device-resident columns (@app:devtables — [B,C] masked
@@ -690,6 +701,209 @@ def bench_hot_key(keys=HK_KEYS, batch=HK_BATCH, steps=HK_STEPS,
         "matches": h_rows,
     }
     out.update(counters)
+    return out
+
+
+def _plan_stamp(rt):
+    """Planner provenance for a BENCH json line: per query the chosen
+    path, the realized lowering, and the model's predicted per-batch
+    cost (planner/costmodel.py units)."""
+    sm = rt.app_context.statistics_manager
+    if sm is None:
+        return {}
+    return {q: {"path": rec.chosen, "actual": rec.actual,
+                "predictedCost": round(rec.predicted_cost, 1)}
+            for q, rec in sorted(sm.plans.items())}
+
+
+def bench_planner_auto_vs_annotated(batch=PLN_BATCH, steps=PLN_STEPS,
+                                    warmup=PLN_WARMUP,
+                                    windows=PLN_WINDOWS,
+                                    ratio_floor=0.8):
+    """Cost-based unified lowering acceptance: three annotated bench
+    shapes (fused filter chain, multiplex tumbling pack, hot-key Zipf
+    pattern) re-run UN-annotated under ``@app:plan(auto='true')``.  The
+    model must re-derive the hand-pinned lowering on each shape, and —
+    since the same engines then run — match its events/s.  Each shape
+    reports both rates, the ratio, and the plan provenance stamp
+    (chosen path + predicted cost) the auto run planned with."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    AUTO = "@app:plan(auto='true') "
+
+    # one batch set per shape, built ONCE: the annotated and the auto
+    # run must see identical data or the row-count cross-check (and the
+    # rate comparison) is meaningless
+    def measure(app, stream, bs, sink):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(app)
+            rows = [0]
+            rt.add_callback(sink, lambda evs: rows.__setitem__(
+                0, rows[0] + len(evs)))
+            rt.start()
+            h = rt.get_input_handler(stream)
+            for b in bs[:warmup]:
+                h.send_batch(b)
+            window_rates = []
+            for w in range(windows):
+                t_w = time.perf_counter()
+                for b in bs[warmup:]:
+                    h.send_batch(EventBatch(
+                        b.stream_id, b.attribute_names, b.columns,
+                        b.timestamps + (w + 1) * 1_000_000, b.types))
+                rt.drain_device_emits()
+                window_rates.append(
+                    batch * steps / (time.perf_counter() - t_w))
+            low = dict(rt.lowering())
+            stamp = _plan_stamp(rt)
+            rt.shutdown()
+            return float(np.median(window_rates)), low, stamp, rows[0]
+        finally:
+            m.shutdown()
+
+    out = {}
+
+    # -- fused filter chain --------------------------------------------------
+    CHAIN = ("@app:name('plnfuse{t}') @app:playback "
+             "@app:execution('tpu') {ann}"
+             "define stream SIn (sym int, price float, vol int); "
+             "@info(name='q1') from SIn[price > 4.0] "
+             "select sym, price, vol insert into Mid; "
+             "@info(name='q2') from Mid[vol > 50] "
+             "select sym, price insert into Out;")
+
+    rng = np.random.default_rng(41)
+    chain_bs = [EventBatch(
+        "SIn", ["sym", "price", "vol"],
+        {"sym": rng.integers(0, 8, batch),
+         "price": rng.uniform(0.0, 30.0, batch).astype(np.float32),
+         "vol": rng.integers(1, 100, batch)},
+        np.full(batch, 1_000 + i * 10, dtype=np.int64))
+        for i in range(warmup + steps)]
+
+    for label, ann in (("annotated", "@app:fuse "), ("auto", AUTO)):
+        rate, low, stamp, n = measure(
+            CHAIN.format(t=label[0], ann=ann), "SIn", chain_bs, "Out")
+        assert low == {"q1": "fused", "q2": "fused"}, \
+            f"fuse shape ({label}) lowered to {low}"
+        out[f"fuse_{label}_events_per_sec"] = round(rate, 1)
+        if label == "auto":
+            out["fuse_plan"] = stamp
+    out["fuse_auto_vs_annotated"] = round(
+        out["fuse_auto_events_per_sec"]
+        / out["fuse_annotated_events_per_sec"], 3)
+
+    # -- multiplex tumbling pack ---------------------------------------------
+    TEN = 4
+    MUXAPP = ("@app:name('plnmux{t}{i}') @app:playback "
+              "@app:execution('tpu') {ann}"
+              "define stream Mkt (k long, v double); "
+              f"@info(name='w') from Mkt#window.lengthBatch({batch}) "
+              "select k, sum(v) as s, count() as c group by k "
+              "insert into Panes;")
+
+    rng = np.random.default_rng(42)
+    mux_bs = [EventBatch(
+        "Mkt", ["k", "v"],
+        {"k": (np.arange(batch, dtype=np.int64) * 524287
+               + i * batch) % 256,
+         "v": rng.integers(0, 50, batch).astype(np.float64)},
+        np.full(batch, 1_000 + i * 10, dtype=np.int64))
+        for i in range(warmup + steps)]
+
+    def run_mux(label, ann, bs):
+        m = SiddhiManager()
+        try:
+            rts = []
+            for i in range(TEN):
+                rt = m.create_siddhi_app_runtime(
+                    MUXAPP.format(t=label[0], i=i, ann=ann))
+                rt.add_callback("Panes", lambda evs: None)
+                rt.start()
+                rts.append(rt)
+            low = {f"t{i}": rt.lowering()["w"]
+                   for i, rt in enumerate(rts)}
+            hs = [rt.get_input_handler("Mkt") for rt in rts]
+            for b in bs[:warmup]:
+                for h in hs:
+                    h.send_batch(b)
+            window_rates = []
+            for w in range(windows):
+                t_w = time.perf_counter()
+                for b in bs[warmup:]:
+                    for h in hs:
+                        h.send_batch(EventBatch(
+                            b.stream_id, b.attribute_names, b.columns,
+                            b.timestamps + (w + 1) * 1_000_000, b.types))
+                window_rates.append(
+                    TEN * batch * steps / (time.perf_counter() - t_w))
+            stamp = _plan_stamp(rts[0])
+            for rt in rts:
+                rt.shutdown()
+            return float(np.median(window_rates)), low, stamp
+        finally:
+            m.shutdown()
+
+    for label, ann in (
+            ("annotated", f"@app:multiplex(slots='{TEN}') "),
+            ("auto", AUTO)):
+        rate, low, stamp = run_mux(label, ann, mux_bs)
+        assert set(low.values()) == {"multiplex"}, \
+            f"multiplex shape ({label}) lowered to {low}"
+        out[f"multiplex_{label}_events_per_sec"] = round(rate, 1)
+        if label == "auto":
+            out["multiplex_plan"] = stamp
+    out["multiplex_auto_vs_annotated"] = round(
+        out["multiplex_auto_events_per_sec"]
+        / out["multiplex_annotated_events_per_sec"], 3)
+
+    # -- hot-key Zipf pattern ------------------------------------------------
+    HKAPP = ("@app:name('plnhk{t}') @app:playback "
+             "@app:execution('tpu', instances='8') {ann}"
+             "define stream S (k long, u double, v double); "
+             "partition with (k of S) begin "
+             "@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0] "
+             "select b.v as bv insert into Alerts; end;")
+    HOT = "@app:hotkeys(k='8', promote='0.1', demote='0.04') "
+
+    rng = np.random.default_rng(43)
+    hk_bs = [EventBatch(
+        "S", ["k", "u", "v"],
+        {"k": (rng.zipf(1.2, batch).astype(np.int64) - 1) % 512,
+         "u": rng.uniform(0.0, 20.0, batch),
+         "v": rng.uniform(0.0, 20.0, batch)},
+        np.full(batch, 1_000 + i * 10, dtype=np.int64))
+        for i in range(warmup + steps)]
+
+    hk_rows = {}
+    for label, ann in (("annotated", HOT), ("auto", AUTO)):
+        rate, low, stamp, n = measure(
+            HKAPP.format(t=label[0], ann=ann), "S", hk_bs, "Alerts")
+        assert low == {"q": "hotkey"}, \
+            f"hotkey shape ({label}) lowered to {low}"
+        out[f"hotkey_{label}_events_per_sec"] = round(rate, 1)
+        hk_rows[label] = n
+        if label == "auto":
+            # partition-instance planning bypasses plan_query() (the
+            # hotkey router self-gates on observed skew), so this stamp
+            # is empty today — kept so a future per-instance record
+            # shows up here without a bench change
+            out["hotkey_plan"] = stamp
+    assert hk_rows["auto"] == hk_rows["annotated"], (
+        f"auto run emitted {hk_rows['auto']} rows, "
+        f"annotated {hk_rows['annotated']}")
+    out["hotkey_auto_vs_annotated"] = round(
+        out["hotkey_auto_events_per_sec"]
+        / out["hotkey_annotated_events_per_sec"], 3)
+    # same lowering means the same engines ran: the ratio only measures
+    # plan-pass overhead + timing noise, so a loose floor suffices
+    # (looser still at --cpu-smoke sizes where windows are milliseconds)
+    for shape in ("fuse", "multiplex", "hotkey"):
+        r = out[f"{shape}_auto_vs_annotated"]
+        assert r >= ratio_floor, \
+            f"auto {shape} run at {r}x annotated rate"
     return out
 
 
@@ -1320,6 +1534,17 @@ def main():
                 ps["stall_ratio"], 3)
         except Exception as e:
             out["cpu_smoke_persist_stall_error"] = str(e)
+        try:
+            pln = bench_planner_auto_vs_annotated(
+                batch=SMOKE_PLN_BATCH, steps=SMOKE_PLN_STEPS,
+                warmup=1, windows=2, ratio_floor=0.4)
+            for shape in ("fuse", "multiplex", "hotkey"):
+                out[f"cpu_smoke_planner_{shape}_auto_vs_annotated"] = pln[
+                    f"{shape}_auto_vs_annotated"]
+            out["cpu_smoke_planner_fuse_plan"] = pln["fuse_plan"]
+            out["cpu_smoke_planner_multiplex_plan"] = pln["multiplex_plan"]
+        except Exception as e:
+            out["cpu_smoke_planner_auto_error"] = str(e)
         # kernel-vs-XLA multipliers are REFUSED here: on the CPU backend
         # the Pallas kernels run under interpret=True (a python-level
         # emulation), so any speedup/slowdown ratio would characterize
@@ -1382,6 +1607,12 @@ def main():
                 "cpu_smoke_persist_stall_ms_async"),
             "cpu_smoke_persist_stall_ratio": smoke.get(
                 "cpu_smoke_persist_stall_ratio"),
+            "cpu_smoke_planner_fuse_auto_vs_annotated": smoke.get(
+                "cpu_smoke_planner_fuse_auto_vs_annotated"),
+            "cpu_smoke_planner_multiplex_auto_vs_annotated": smoke.get(
+                "cpu_smoke_planner_multiplex_auto_vs_annotated"),
+            "cpu_smoke_planner_hotkey_auto_vs_annotated": smoke.get(
+                "cpu_smoke_planner_hotkey_auto_vs_annotated"),
             "cpu_smoke_note": (
                 f"CPU backend, {SMOKE_PARTITIONS}-partition reduced "
                 "kernel smoke + 8-virtual-device sharded-window smoke — "
@@ -1403,6 +1634,14 @@ def main():
     devtable = bench_devtable_join()
     host = bench_host_baseline()
     persist = bench_persist_stall()
+    # cost-model acceptance: @app:plan(auto) must re-derive each
+    # hand-pinned lowering and match its rate.  Guarded like the Pallas
+    # variants — a planner regression costs these keys, not the round.
+    try:
+        planner = {f"planner_{k}": v
+                   for k, v in bench_planner_auto_vs_annotated().items()}
+    except Exception as e:
+        planner = {"planner_auto_vs_annotated_error": str(e)}
     # Pallas kernel-vs-XLA variants: guarded individually — a Mosaic
     # rejection on a new TPU generation should cost that variant's
     # number, not the round (mirrors the planner's counted fallback)
@@ -1441,6 +1680,7 @@ def main():
     print(json.dumps({
         **_env_stamp(cpu_smoke=False),
         **pallas,
+        **planner,
         "metric": "pattern_match_events_per_sec_per_chip",
         "value": round(events_per_sec, 1),
         "unit": "events/s",

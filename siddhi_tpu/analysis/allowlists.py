@@ -139,6 +139,20 @@ ALLOWLISTS = {
         # counts, or re-routes today
     },
     "lock-discipline": {
+        "siddhi_tpu/core/app_runtime.py:SiddhiAppRuntime._snapshot_svc":
+            "replan() clears the lazy cache from the main path, but "
+            "only inside the process-lock barrier with sources paused, "
+            "device emits drained, and the persist daemon flushed — no "
+            "thread entry can race the clear; the lazy re-init itself "
+            "is idempotent (same service rebuilt from the same parts)",
+        "siddhi_tpu/core/app_runtime.py:SiddhiAppRuntime._durab_stats":
+            "replan() clears the lazy cache from the main path, but "
+            "only inside the process-lock barrier with the persist "
+            "daemon flushed; re-init is idempotent",
+        "siddhi_tpu/core/app_runtime.py:SiddhiAppRuntime._ckpt_writer":
+            "replan() clears the lazy cache from the main path, but "
+            "only inside the process-lock barrier with the persist "
+            "daemon flushed; re-init is idempotent",
         "siddhi_tpu/core/stream.py:StreamJunction._running":
             "GIL-atomic monotonic bool handshake: the worker only ever "
             "clears it (sentinel mid-coalesce), lifecycle writes happen "
@@ -177,6 +191,13 @@ ALLOWLISTS = {
             "plan time, never on the batch path",
     },
     "fallback-discipline": {
+        "siddhi_tpu/planner/monitor.py:PlanMonitor.decide":
+            "the skipped candidate was already log.warning'd AND "
+            "counted (record_planner_fallback) at plan time by "
+            "costmodel.build_plan_record; decide() re-checks the same "
+            "static composability every tick only to keep infeasible "
+            "paths out of the re-score — repeating the count each tick "
+            "would inflate the fallback counters without new events",
         "siddhi_tpu/planner/fusion.py:_try_lower_chain":
             "delegates to the `fallback` callback built in "
             "plan_fused_chains (log.warning + record_fused_fallback) "

@@ -60,6 +60,14 @@ class SiddhiAppRuntime:
         self._on_demand_cache: Dict[str, object] = {}
         self.running = False
         self._manager = None  # back-ref set by SiddhiManager
+        # raw app source (set by AppPlanner.build) — a live re-plan
+        # rebuilds the whole engine set from a fresh parse of this
+        self._app_string = ""
+        # (target, callback) pairs as the user registered them, so a
+        # re-plan can re-attach them to the replacement runtimes; the
+        # ledger keys ("stream", id) / ("query", name) / ("sink", ...)
+        # are structural, so replay suppression carries across
+        self._user_callbacks: List = []
         self._apply_statistics_level(self.app_context.root_metrics_level)
         # fault-injection / recovery counters register UNGATED by the
         # metrics level: when @app:faults is armed, its evidence must be
@@ -168,6 +176,16 @@ class SiddhiAppRuntime:
             self._start_playback_heartbeat()
         if self.app_context.persist_interval_ms > 0:
             self._start_persist_daemon()
+        if (self.app_context.plan_auto
+                and self.app_context.plan_interval_ms > 0):
+            from siddhi_tpu.planner.monitor import PlanMonitor
+
+            # @app:plan(auto, interval): online refinement daemon — reads
+            # the observability feed and re-lowers when the active plan's
+            # observed cost exceeds a cheaper alternative by the
+            # hysteresis margin
+            self._plan_monitor = PlanMonitor(self)
+            self._plan_monitor.start()
 
     def _start_playback_heartbeat(self):
         """@app:playback(idle.time, increment): when no events arrive for
@@ -230,6 +248,10 @@ class SiddhiAppRuntime:
         t.start()
 
     def shutdown(self):
+        mon = getattr(self, "_plan_monitor", None)
+        if mon is not None:
+            mon.stop()
+            self._plan_monitor = None
         stop = getattr(self, "_persist_stop", None)
         if stop is not None:
             stop.set()
@@ -306,11 +328,13 @@ class SiddhiAppRuntime:
             if callable(callback) and not isinstance(callback, StreamCallback):
                 callback = FunctionStreamCallback(callback)
             self.junctions[target].add_callback(callback)
+            self._user_callbacks.append((target, callback))
             return
         if target in self.query_runtimes:
             if callable(callback) and not isinstance(callback, QueryCallback):
                 callback = FunctionQueryCallback(callback)
             self.query_runtimes[target].add_callback(callback)
+            self._user_callbacks.append((target, callback))
             return
         raise SiddhiAppRuntimeError(
             f"no stream or query named '{target}' in app '{self.name}'"
@@ -411,6 +435,191 @@ class SiddhiAppRuntime:
             if hasattr(pr, "query_lowering"):
                 out.update(pr.query_lowering())
         return out
+
+    # -- live re-planning ---------------------------------------------------
+
+    def replan(self, pins: Optional[Dict[str, str]] = None,
+               forced: bool = True, reason: str = "") -> Dict[str, str]:
+        """Re-lower the RUNNING app under a new plan, bit-exact across
+        the switch.
+
+        Protocol (all under the process lock): pause ingest and drain
+        the async emit pipeline; build a COMPLETE replacement engine set
+        from a fresh parse with ``pins`` as per-query exact-path
+        overrides (``{'q': 'fuse+shard'}``; absent queries re-plan by
+        cost); cross the ``replan.reseat`` crash point (a kill there
+        abandons the replacement and leaves the old engines fully
+        operational); tear the old engines down; adopt the new
+        internals onto this SAME runtime object (manager registry,
+        handles, and REST routes keep working); re-attach user
+        callbacks; then rebuild all engine state by replaying the input
+        journal's FULL history with the output ledger suppressing every
+        event each callback/sink already received — the observable
+        sequence is identical to an uninterrupted run on either plan.
+
+        Requires ``@app:faults(journal='N')`` with the whole input
+        history still in memory; refused with a counted
+        ``plannerFallbackReason`` otherwise.  Returns the new per-query
+        lowering map."""
+        import logging
+
+        from siddhi_tpu.planner.app_planner import AppPlanner
+
+        log = logging.getLogger("siddhi_tpu")
+        sm = self.app_context.statistics_manager
+
+        def refuse(why: str):
+            if sm is not None:
+                sm.record_planner_fallback(self.name,
+                                           f"replan refused: {why}")
+            log.warning("app '%s': replan refused (%s)", self.name, why)
+            raise SiddhiAppRuntimeError(
+                f"app '{self.name}': replan refused — {why}")
+
+        if not self.running:
+            refuse("app is not running")
+        jr = self.app_context.input_journal
+        if jr is None:
+            refuse("no input journal — @app:faults(journal='N') is the "
+                   "replay substrate a live re-plan rebuilds state from")
+        with self.app_context.process_lock:
+            old_sources = list(self.sources)
+            for s in old_sources:
+                s.pause()
+            committed = False
+            try:
+                self.drain_device_emits()
+                self._flush_persists()
+                if not jr.covers_from_start():
+                    refuse("journal no longer holds the full input "
+                           "history (overflowed or spilled); raise the "
+                           "journal depth to re-plan live")
+                old_lowering = self.lowering()
+                entries = jr.all_entries()
+
+                app_str = getattr(self, "_app_string", "") or ""
+                if app_str:
+                    from siddhi_tpu.compiler.compiler import SiddhiCompiler
+
+                    ast = SiddhiCompiler.parse(app_str)
+                else:
+                    ast = self.siddhi_app
+                planner = AppPlanner(
+                    ast, app_str, self.app_context.siddhi_context)
+                planner.app_context.plan_pins = dict(pins or {})
+                new_rt = planner.build()
+
+                fi = self.app_context.fault_injector
+                try:
+                    if fi is not None:
+                        # crash point: replacement built, old engines not
+                        # yet torn down — a kill here must leave the old
+                        # runtime fully operational
+                        fi.check("replan.reseat")
+                except BaseException:
+                    # abandon the replacement; drop its registrations so
+                    # the old runtime keeps exclusive ownership
+                    try:
+                        new_rt._manager = None
+                        new_rt.shutdown()
+                    except Exception:
+                        log.warning(
+                            "replan: abandoned replacement engines did "
+                            "not tear down cleanly", exc_info=True)
+                    raise
+
+                # ---- point of no return: adopt the replacement --------
+                new_ctx = new_rt.app_context
+                # ONE lock serializes both incarnations: transports of
+                # the new sources must block on the lock this thread
+                # holds until the replay below finishes
+                new_ctx.process_lock = self.app_context.process_lock
+                new_sm = new_ctx.statistics_manager
+                if sm is not None and new_sm is not None:
+                    # app-wide re-plan history survives the switch
+                    new_sm.replans.extend(sm.replans)
+                mgr = self._manager
+                self._manager = None  # identity-guarded pop must not fire
+                try:
+                    self.shutdown()
+                finally:
+                    self._manager = mgr
+                committed = True
+                self.siddhi_app = new_rt.siddhi_app
+                self.app_context = new_ctx
+                self.junctions = new_rt.junctions
+                self.query_runtimes = new_rt.query_runtimes
+                # keep the OLD InputManager object (user code holds
+                # InputHandlers it created): re-point it and every cached
+                # handler at the replacement junctions/context in place
+                old_im = self.input_manager
+                new_im = new_rt.input_manager
+                old_im.app_context = new_ctx
+                old_im._junctions = new_im._junctions
+                for sid, h in list(old_im._handlers.items()):
+                    nj = new_im._junctions.get(sid)
+                    if nj is None:  # pragma: no cover - defs are static
+                        old_im._handlers.pop(sid)
+                        continue
+                    h.junction = nj
+                    h.app_context = new_ctx
+                    h.definition = nj.definition
+                self.scheduler = new_rt.scheduler
+                self.tables = new_rt.tables
+                self.named_windows = new_rt.named_windows
+                self.partitions = new_rt.partitions
+                self.aggregations = new_rt.aggregations
+                self.sources = new_rt.sources
+                self.sinks = new_rt.sinks
+                self.functions = new_rt.functions
+                self._handler_registrations = new_rt._handler_registrations
+                self._on_demand_cache = {}
+                self._snapshot_svc = None
+                self._ckpt_writer = None
+                self._durab_stats = None
+                cbs, self._user_callbacks = self._user_callbacks, []
+                for target, cb in cbs:
+                    self.add_callback(target, cb)
+
+                # restart under the new plan, then rebuild engine state
+                # by replaying the full journaled history through the
+                # suppressing output ledger
+                self.start()
+                jr.begin_replay_from_start()
+                try:
+                    for stream_id, batch in entries:
+                        self.input_manager.get_input_handler(
+                            stream_id).send_batch(batch)
+                        if jr.stats is not None:
+                            jr.stats.replayed_batches += 1
+                    # barrier INSIDE the replay window (same contract as
+                    # _replay_journal): deferred emits must flow through
+                    # the suppressing ledger, not escape as duplicates
+                    self.drain_device_emits()
+                finally:
+                    jr.end_replay()
+                new_lowering = self.lowering()
+                rsm = self.app_context.statistics_manager
+                if rsm is not None:
+                    changed = False
+                    for q, p in sorted(new_lowering.items()):
+                        o = old_lowering.get(q, "")
+                        if o != p:
+                            changed = True
+                            rsm.record_replan(q, o, p, forced, reason)
+                    if not changed:
+                        rsm.record_replan("*", "", "", forced,
+                                          reason or "no lowering change")
+                log.info("app '%s': re-planned (%s); lowering now %s",
+                         self.name, reason or "forced", new_lowering)
+                return new_lowering
+            finally:
+                if not committed:
+                    for s in old_sources:
+                        try:
+                            s.resume()
+                        except Exception:  # pragma: no cover - best effort
+                            log.exception("replan: source resume failed")
 
     def pattern_state(self) -> Dict[str, Dict]:
         """Ops introspection of every pattern/sequence query's engine

@@ -156,6 +156,23 @@ class SiddhiAppContext:
         # devtableFallbackReasons.  capacity is the per-table slot count.
         self.devtables = False
         self.devtable_capacity = 1024
+        # @app:plan(auto='true', hysteresis='0.3', interval='5 sec'):
+        # cost-based unified lowering (planner/costmodel.py).  auto turns
+        # the model on for un-annotated queries — it enumerates every
+        # eligible lowering, scores them statically and picks the
+        # cheapest; legacy annotations stay pins that override it.
+        # hysteresis is the margin an alternative's predicted cost must
+        # beat the active plan's observed cost by before the PlanMonitor
+        # re-lowers the live query; interval (0 = no daemon) paces the
+        # monitor's background sweep.
+        self.plan_auto = False
+        self.plan_hysteresis = 0.3
+        self.plan_interval_ms = 0
+        # Per-query path pins ('device', 'dense+hotkey', ...) that
+        # override BOTH the annotations and the cost model — the replan
+        # machinery rebuilds an app through these so the new runtime
+        # lands on the exact target path (core/app_runtime.py replan()).
+        self.plan_pins: Dict[str, str] = {}
         # @app:persist(interval='30 sec', mode='async'): default persist()
         # mode ('sync' keeps the historical stop-the-world behavior;
         # 'async' captures under the barrier and writes on the checkpoint

@@ -191,6 +191,13 @@ class FusedGraphEngine:
         """
         if self._fused_step is not None:
             return self._fused_step
+        self._fused_step = self.jax.jit(self._build_fused())
+        return self._fused_step
+
+    def _build_fused(self) -> Callable:
+        """The raw (un-jitted) fused chain function — the subclassable
+        seam: ShardedFusedGraphEngine (parallel/fused_shard.py) wraps
+        it in shard_map before jitting."""
         jax, jnp = self.jax, self.jnp
         dev_steps = [eng.make_step(jit=False) for eng in self.stages]
         wires = self._wires
@@ -266,8 +273,7 @@ class FusedGraphEngine:
             return (tuple(new_states), emitmask, out_f, out_i, anchor,
                     count)
 
-        self._fused_step = jax.jit(fused)
-        return self._fused_step
+        return fused
 
     # -- host entry points ---------------------------------------------------
 
@@ -292,10 +298,15 @@ class FusedGraphEngine:
             states = self._chunk(states, cols, ts, 0, chunks)
         return states, FusedDeferredEmit(self, chunks, ts)
 
+    def _pad_batch(self, n: int) -> int:
+        """Padded chunk width.  The sharded subclass rounds up further
+        so the batch axis splits evenly over the mesh."""
+        return _pow2(n)
+
     def _chunk(self, states: Tuple, cols: Dict[str, np.ndarray],
                ts: np.ndarray, offset: int, chunks: List[dict]) -> Tuple:
         n = len(ts)
-        B = _pow2(n)
+        B = self._pad_batch(n)
         states = list(states)
         # per-stage relative timestamps: each stage keeps its own epoch
         # (base_ts), re-anchored host-side at the int32 horizon exactly

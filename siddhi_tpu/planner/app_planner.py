@@ -282,6 +282,48 @@ class AppPlanner:
                         "integer in 1..16777216 (device slots per table)")
                 self.app_context.devtable_capacity = ncap
 
+        # @app:plan(auto='true', hysteresis='0.3', interval='5 sec'):
+        # cost-based unified lowering (planner/costmodel.py) — auto
+        # enumerates + scores every eligible lowering per un-annotated
+        # query and picks the cheapest (the legacy fast-path annotations
+        # stay pins that override it); hysteresis is the PlanMonitor's
+        # re-plan margin and interval paces its background sweep
+        # (0 = decide() on demand only).
+        plan_ann = find_annotation(siddhi_app.annotations, "app:plan")
+        if plan_ann is not None:
+            if self.app_context.execution_mode != "tpu":
+                raise SiddhiAppCreationError(
+                    "@app:plan needs @app:execution('tpu')")
+            v = (plan_ann.element("auto") or plan_ann.element()
+                 or "true").strip().lower()
+            if v not in ("true", "false"):
+                raise SiddhiAppCreationError(
+                    f"@app:plan: auto='{v}' must be 'true' or 'false'")
+            self.app_context.plan_auto = v == "true"
+            hy = plan_ann.element("hysteresis")
+            if hy:
+                try:
+                    h = float(hy)
+                except ValueError:
+                    h = -1.0
+                if not (0.0 <= h <= 10.0):
+                    raise SiddhiAppCreationError(
+                        f"@app:plan: hysteresis='{hy}' must be a fraction "
+                        "in 0..10 (margin before a live re-plan)")
+                self.app_context.plan_hysteresis = h
+            iv = plan_ann.element("interval")
+            if iv:
+                try:
+                    ims = int(iv)
+                except ValueError:
+                    from siddhi_tpu.compiler.parser import parse_time_string
+
+                    ims = parse_time_string(iv)
+                if ims <= 0:
+                    raise SiddhiAppCreationError(
+                        f"@app:plan: interval {iv!r} must be > 0")
+                self.app_context.plan_interval_ms = ims
+
         from siddhi_tpu.util.statistics import Level, StatisticsManager
 
         stats_ann = find_annotation(siddhi_app.annotations, "app:statistics")
@@ -696,6 +738,23 @@ class AppPlanner:
             cache = TableCache(size, policy, retention_ms=retention_ms)
         return RecordTableRuntime(td, store, cache=cache, handler=handler)
 
+    def _note_fused_conflicts(self, qname: str):
+        """A query the fusion pre-pass claimed while another fast-path
+        annotation was also pinned on the app: the documented precedence
+        (fuse > shard > multiplex > hotkeys) resolved it — count the
+        losing pin so the resolution is visible, not implicit."""
+        sm = self.app_context.statistics_manager
+        if sm is None:
+            return
+        if self.app_context.multiplex:
+            sm.record_planner_conflict(
+                qname, "@app:multiplex pinned but the query fused "
+                "(precedence: fuse > multiplex)")
+        if self.app_context.hotkeys:
+            sm.record_planner_conflict(
+                qname, "@app:hotkeys pinned but the query fused "
+                "(precedence: fuse > hotkeys)")
+
     def build(self):
         from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
         from siddhi_tpu.planner.query_planner import QueryPlanner
@@ -765,7 +824,15 @@ class AppPlanner:
         # back pre-planned, keyed by query identity; everything else
         # takes the ordinary per-query path below.
         fused: Dict[int, object] = {}
-        if self.app_context.fuse:
+        # in auto (cost-model) mode the pre-pass also runs for
+        # un-annotated apps — a fused chain beats any per-query lowering
+        # whenever one exists (it deletes the junction hops), so the
+        # model treats chain membership as the cheapest candidate; a
+        # replan pin naming 'fuse' forces the pass too
+        want_fuse = (self.app_context.fuse or self.app_context.plan_auto
+                     or any("fuse" in str(p).split("+")
+                            for p in self.app_context.plan_pins.values()))
+        if want_fuse:
             from siddhi_tpu.planner.fusion import plan_fused_chains
 
             fused = plan_fused_chains(self, qp)
@@ -774,7 +841,11 @@ class AppPlanner:
         self.partition_runtimes: Dict[str, object] = {}
         for element in self.siddhi_app.execution_elements:
             if isinstance(element, Query):
-                qr = fused.pop(id(element), None) or qp.plan(element, qi)
+                qr = fused.pop(id(element), None)
+                if qr is not None:
+                    self._note_fused_conflicts(qr.name)
+                else:
+                    qr = qp.plan_query(element, qi)
                 qi += 1
                 if qr.name in self.query_runtimes:
                     raise SiddhiAppCreationError(f"duplicate query name '{qr.name}'")
@@ -789,7 +860,7 @@ class AppPlanner:
             if not key.startswith("#") and key not in self.named_windows:
                 input_manager.register(j)
 
-        return SiddhiAppRuntime(
+        runtime = SiddhiAppRuntime(
             name=self.name,
             siddhi_app=self.siddhi_app,
             app_context=self.app_context,
@@ -806,6 +877,10 @@ class AppPlanner:
             functions=self.functions,
             handler_registrations=self.handler_registrations,
         )
+        # the raw source rides along so a live re-plan
+        # (core/app_runtime.py replan) can rebuild from a fresh parse
+        runtime._app_string = self.app_string
+        return runtime
 
 
 class _AggregationReceiver:

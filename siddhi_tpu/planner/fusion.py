@@ -304,6 +304,17 @@ def _try_lower_chain(app, qp, entries, run: List[int], hops: List[str],
     chain_names = [entries[i][1] for i in run]
     chain_label = "->".join(chain_names)
 
+    # a replan pin is an EXACT path override: a member pinned away from
+    # 'fuse' (e.g. {'q1': 'device'}) un-claims the whole chain and the
+    # per-query loop lowers each member under its own pin
+    pins = getattr(ctx, "plan_pins", None) or {}
+    for nm in chain_names:
+        p = pins.get(nm)
+        if p is not None and "fuse" not in str(p).split("+"):
+            log.info("chain %s: member '%s' pinned to '%s' — chain left "
+                     "to per-query planning", chain_label, nm, p)
+            return {}
+
     # synthesize undeclared intermediate defs from producer schemas as
     # we go; declared defs must match the producer's output exactly
     # (the junction path's insert-into contract)
@@ -363,11 +374,37 @@ def _try_lower_chain(app, qp, entries, run: List[int], hops: List[str],
             fallback(name, f"chain {chain_label}: {e}")
             return None
 
-    try:
-        graph = FusedGraphEngine(stages, dense_tail, dense_key)
-    except SiddhiAppCreationError as e:
-        fallback(chain_names[-1], f"chain {chain_label}: {e}")
-        return None
+    graph = None
+    tail_name = chain_names[-1]
+    nd = ctx.tpu_devices
+    pin = str(ctx.plan_pins.get(tail_name, "") or "")
+    want_shard = bool(nd) and dense_tail is None and (
+        ctx.plan_auto or "shard" in pin.split("+"))
+    if want_shard and "shard" not in pin.split("+") and pin:
+        # an explicit replan pin without 'shard' stays single-device
+        want_shard = False
+    if want_shard:
+        from siddhi_tpu.parallel.fused_shard import ShardedFusedGraphEngine
+
+        sm = ctx.statistics_manager
+        try:
+            graph = ShardedFusedGraphEngine(stages, qp._get_mesh(nd))
+            log.info("fused chain %s: batch axis sharded over %d devices",
+                     chain_label, nd)
+        except SiddhiAppCreationError as e:
+            # NOT silent: the mesh stays idle for this chain, so log the
+            # reason and count it on the statistics feed before falling
+            # back to the single-device fused engine
+            log.warning("query '%s': fuse+shard unavailable (%s); "
+                        "single-device fused engine used", tail_name, e)
+            if sm is not None:
+                sm.record_sharded_fallback(tail_name, str(e))
+    if graph is None:
+        try:
+            graph = FusedGraphEngine(stages, dense_tail, dense_key)
+        except SiddhiAppCreationError as e:
+            fallback(tail_name, f"chain {chain_label}: {e}")
+            return None
     return _wire_chain(app, qp, entries, run, hops, graph, chain_label)
 
 
@@ -489,7 +526,10 @@ def _wire_chain(app, qp, entries, run: List[int], hops: List[str],
         task = _RateLimiterTask(qr, rate_limiter, device_runtime=runtime)
         qr._rate_task = task
         app.scheduler.register_task(task)
-    qr.lowered_to = "fused"
+    lowered = ("fuse+shard"
+               if getattr(graph, "engine_kind", "") == "fused_shard"
+               else "fused")
+    qr.lowered_to = lowered
 
     planned: Dict[int, QueryRuntime] = {id(tail_q): qr}
 
@@ -506,11 +546,24 @@ def _wire_chain(app, qp, entries, run: List[int], hops: List[str],
                 q.selector, graph.stages[pos].output_names, hops[pos]),
             PassThroughRateLimiter(),
             _InertOutput(), ctx)
-        iqr.lowered_to = "fused"
+        iqr.lowered_to = lowered
         planned[id(q)] = iqr
         if hops[pos] in app.junctions:
             app.junctions[hops[pos]].subscribe(
                 _FusedIntermediateTap(hops[pos], chain_label))
+    # per-member plan records: the per-query cost enumeration never sees
+    # chain members (the pre-pass claims them), so register theirs here
+    sm = ctx.statistics_manager
+    if sm is not None and hasattr(sm, "register_plan"):
+        from siddhi_tpu.planner.costmodel import fused_plan_record
+
+        n_total = len(graph.stages) + (1 if graph.dense is not None else 0)
+        for idx in run:
+            _q, nm = entries[idx]
+            rec = fused_plan_record(nm, ctx, n_total,
+                                    sharded=(lowered == "fuse+shard"))
+            rec.actual = lowered
+            sm.register_plan(nm, rec)
     log.info("fused chain %s: %d stages lowered to one device program",
              chain_label, len(graph.stages)
              + (1 if graph.dense is not None else 0))

@@ -192,6 +192,10 @@ class QueryPlanner:
 
     def __init__(self, app_planner):
         self.app = app_planner  # AppPlanner
+        # the PlanRecord for the query currently inside plan_query();
+        # _want() consults it so the cost model's pick steers the same
+        # gate sites the legacy annotations do
+        self._active_record = None
 
     def _passthrough_selector(self, sel: Selector, out_names: List[str],
                               out_target: str) -> QuerySelector:
@@ -223,6 +227,67 @@ class QueryPlanner:
             mesh = make_mesh(nd)
             self.app._tpu_mesh = mesh
         return mesh
+
+    def plan_query(self, query: Query, query_index: int) -> QueryRuntime:
+        """Unified lowering entry: build the query's PlanRecord (cost
+        candidates + pick), plan through the existing per-kind paths
+        with the record steering the fast-path gates, then pin the
+        realized lowering back onto the record for /siddhi-plan.
+
+        In legacy (annotation-only) mode the record is informational —
+        _want() keeps reading the annotation flags, so annotated apps
+        lower exactly as before."""
+        info = find_annotation(query.annotations, "info")
+        name = (info.element("name") if info else None) \
+            or f"query_{query_index}"
+        from siddhi_tpu.planner.costmodel import build_plan_record
+
+        record = build_plan_record(self.app, query, name)
+        self._active_record = record
+        try:
+            qr = self.plan(query, query_index)
+        finally:
+            self._active_record = None
+        record.actual = getattr(qr, "lowered_to", "host")
+        sm = self.app.app_context.statistics_manager
+        if sm is not None:
+            sm.register_plan(name, record)
+        return qr
+
+    def _host_pinned(self) -> bool:
+        """An explicit pin (replan override) naming 'host' disables the
+        device fast-path gates entirely — the only way a tpu app drops a
+        query back to the host chain on purpose."""
+        rec = self._active_record
+        return rec is not None and rec.mode == "pinned" \
+            and rec.chosen == "host"
+
+    def _want(self, path: str, name: str) -> bool:
+        """Does this query want fast path ``path`` ('multiplex' |
+        'hotkey') at its gate site?  Pin precedence: a replan pin names
+        the exact composed path; else the legacy annotation; else — in
+        auto mode — the cost model's pick.  The real eligibility gate
+        of the path still runs after a True."""
+        ctx = self.app.app_context
+        pin = (getattr(ctx, "plan_pins", None) or {}).get(name)
+        if pin is not None:
+            return path in str(pin).split("+")
+        if path == "multiplex" and ctx.multiplex:
+            return True
+        if path == "hotkey" and ctx.hotkeys:
+            return True
+        if path == "shard" and ctx.tpu_devices:
+            # legacy: a declared mesh IS the shard pin
+            return True
+        if getattr(ctx, "plan_auto", False):
+            rec = self._active_record
+            if rec is None:
+                # partition-instance planning bypasses plan_query(); the
+                # hotkey router self-gates (promotion needs observed
+                # skew) so auto mode opts partitioned dense state in
+                return path == "hotkey"
+            return path in rec.components()
+        return False
 
     def plan(self, query: Query, query_index: int) -> QueryRuntime:
         info = find_annotation(query.annotations, "info")
@@ -470,14 +535,15 @@ class QueryPlanner:
         if (
             self.app.app_context.execution_mode == "tpu"
             and not getattr(self.app, "in_partition_instance", False)
+            and not self._host_pinned()
         ):
             import logging
 
-            # @app:multiplex: try seating the pattern in a manager-wide
-            # shared dense engine first; ineligibility is counted
-            # (multiplexFallbackReason) and falls through to the
-            # dedicated dense path below
-            if self.app.app_context.multiplex:
+            # @app:multiplex (or the cost model's pick): try seating the
+            # pattern in a manager-wide shared dense engine first;
+            # ineligibility is counted (multiplexFallbackReason) and
+            # falls through to the dedicated dense path below
+            if self._want("multiplex", name):
                 from siddhi_tpu.multiplex.planner import MultiplexPlanner
 
                 qr = MultiplexPlanner(self).try_state(query, name, st)
@@ -650,7 +716,7 @@ class QueryPlanner:
         # pointless for single-partition queries
         mesh = None
         nd = self.app.app_context.tpu_devices
-        if nd and n_partitions > 1:
+        if nd and n_partitions > 1 and self._want("shard", name):
             mesh = self._get_mesh(nd)
         runtime = DensePatternRuntime(
             engine, f"#matches_{name}", emit=lambda b: qr.process(b, 0),
@@ -667,13 +733,24 @@ class QueryPlanner:
         # cold keys stay dense).  Mesh-sharded and aggregating forms
         # stay dense: the router's state handoff assumes single-device
         # rows and final-node-only selects.
-        if (self.app.app_context.hotkeys and partitioned
+        if (self._want("hotkey", name) and partitioned
                 and key_fn is None and mesh is None and not aggregating):
             from siddhi_tpu.planner.hotkeys import try_wrap_hotkey
 
             wrapped = try_wrap_hotkey(self.app, st, runtime, name)
             if wrapped is not None:
                 runtime = wrapped
+        elif (self.app.app_context.hotkeys and partitioned
+                and key_fn is None and mesh is not None and not aggregating):
+            # pinned @app:hotkeys lost to the mesh pin: the router's
+            # promote/demote state handoff assumes single-device
+            # partition rows (precedence: shard > hotkeys) — count the
+            # losing pin so the resolution is visible
+            sm = self.app.app_context.statistics_manager
+            if sm is not None:
+                sm.record_planner_conflict(
+                    name, "@app:hotkeys pinned but the partition axis is "
+                    "mesh-sharded (precedence: shard > hotkeys)")
         # @app:kernels: swap the hot inner step for Pallas kernels where
         # the runtime is eligible; counted fallback otherwise.  After the
         # hotkey wrap so the router's dense and scan halves gate
@@ -717,12 +794,14 @@ class QueryPlanner:
         if (
             self.app.app_context.execution_mode == "tpu"
             and not getattr(self.app, "in_partition_instance", False)
+            and not self._host_pinned()
         ):
             import logging
 
-            # @app:multiplex: shared tumbling engine attempt first, with
-            # counted fallback to the dedicated device path
-            if self.app.app_context.multiplex:
+            # @app:multiplex (or the cost model's pick): shared tumbling
+            # engine attempt first, with counted fallback to the
+            # dedicated device path
+            if self._want("multiplex", name):
                 from siddhi_tpu.multiplex.planner import MultiplexPlanner
 
                 qr = MultiplexPlanner(self).try_single(query, name, s)
@@ -839,7 +918,7 @@ class QueryPlanner:
         # BASE engine so the sharded wrapper's __getattr__ still sees it
         engine.faults = self.app.app_context.fault_injector
         nd = self.app.app_context.tpu_devices
-        if nd:
+        if nd and self._want("shard", name):
             from siddhi_tpu.parallel import ShardedDeviceQueryEngine
 
             import logging

@@ -10,6 +10,10 @@ server instead of MSF4J:
     GET  /siddhi-persist/{name}             (checkpoint; @app:persist mode)
     GET  /siddhi-restore-last/{name}        (restore newest good revision)
     GET  /siddhi-trace/{name}               (flight recorder; ?format=chrome)
+    GET  /siddhi-plan/{name}                (per-query plan: candidates,
+                                             costs, pins, re-plan history)
+    GET  /siddhi-replan/{name}?q0=path      (force a live re-lowering;
+                                             pairs pin per-query paths)
     GET  /metrics                           (Prometheus text exposition)
 
 Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}`` except
@@ -91,6 +95,14 @@ class SiddhiService:
                     self._send(code, payload)
                 elif len(parts) == 3 and parts[1] == "siddhi-query-lowering":
                     code, payload = service.query_lowering(parts[2])
+                    self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-plan":
+                    code, payload = service.plan(parts[2])
+                    self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-replan":
+                    pins = {k: v[0]
+                            for k, v in parse_qs(url.query).items()}
+                    code, payload = service.replan(parts[2], pins)
                     self._send(code, payload)
                 elif len(parts) == 3 and parts[1] == "siddhi-statistics":
                     code, payload = service.statistics(parts[2])
@@ -195,6 +207,48 @@ class SiddhiService:
                 "message": f"there is no Siddhi app named '{name}'",
             }
         return 200, {"status": "OK", "metrics": runtime.statistics()}
+
+    def plan(self, name: str):
+        """Chosen plan per query of a deployed app: the cost model's
+        candidates with scores, the pick, the pin that forced it,
+        rejected alternatives with reasons, and the live re-plan
+        history (planner/costmodel.py PlanRecord)."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        sm = runtime.app_context.statistics_manager
+        plans = {}
+        replans = []
+        if sm is not None:
+            plans = {q: rec.to_dict()
+                     for q, rec in sorted(sm.plans.items())}
+            replans = list(sm.replans)
+        return 200, {"status": "OK", "app": name,
+                     "lowering": runtime.lowering(),
+                     "plans": plans, "replans": replans}
+
+    def replan(self, name: str, pins: Optional[Dict[str, str]] = None):
+        """Force a live re-lowering of a deployed app.  Query-string
+        pairs pin per-query paths (``?q0=fuse%2Bshard``); with no pairs
+        the cost model re-chooses every query.  Refused (409) without a
+        full-history input journal — see SiddhiAppRuntime.replan."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        try:
+            lowering = runtime.replan(pins or {}, forced=True,
+                                      reason="forced via REST")
+        except Exception as e:  # noqa: BLE001 — surface refusals to client
+            return 409, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", "queries": lowering}
 
     def persist(self, name: str):
         """Checkpoint a deployed app in its configured persist mode

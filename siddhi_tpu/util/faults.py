@@ -613,6 +613,46 @@ class InputJournal:
                     1 for s in needed if s not in mem_seqs)
             return [collected[s] for s in needed]
 
+    # -- live re-plan support -----------------------------------------
+    #
+    # A live re-plan (core/app_runtime.py replan) rebuilds the engines
+    # from scratch — there is no checkpoint revision to restore, so the
+    # new engines start EMPTY and the journal replays the WHOLE history
+    # to rebuild their state.  The output ledger then suppresses every
+    # event each endpoint already received, so the observable sequence
+    # across the switch is bit-identical to an uninterrupted run on
+    # either plan.
+
+    def covers_from_start(self) -> bool:
+        """True when the in-memory journal still holds every batch since
+        the app started — the precondition for a full-history replay.
+        Overflow (dropped OR spilled entries) breaks it: a re-plan needs
+        the contiguous in-memory history, under the process lock, with
+        no store round-trips mid-switch."""
+        with self._lock:
+            if self._gap or self._segments:
+                return False
+            if self._seq == 0:
+                return True
+            return bool(self._entries) and \
+                self._entries[0][0] == 1 and \
+                len(self._entries) == self._seq
+
+    def all_entries(self) -> List[Tuple[str, Any]]:
+        """Every recorded batch, oldest first (caller checked
+        :meth:`covers_from_start`)."""
+        with self._lock:
+            return [(sid, b) for _seq, sid, b in self._entries]
+
+    def begin_replay_from_start(self) -> None:
+        """Arm the ledger for a full-history replay: every endpoint's
+        entire delivered count becomes the suppression budget, and
+        counts rebuild from zero as the replay re-delivers."""
+        with self._lock:
+            self.replaying = True
+            self._remaining = dict(self._counts)
+            self._counts = {}
+
     # -- replay + output dedup ---------------------------------------
 
     def begin_replay(self, revision: Optional[str] = None) -> None:

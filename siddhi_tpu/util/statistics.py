@@ -306,6 +306,19 @@ class StatisticsManager:
         self.devtable_fallbacks: Dict[str, int] = {}
         self.devtable_fallback_reasons: Dict[str, str] = {}
         self.devtables: Dict[str, object] = {}
+        # cost-based planner feed (planner/costmodel.py): candidates the
+        # cost gates rejected (count + last reason — same discipline as
+        # every other fallback family), pins that LOST to a
+        # higher-precedence pin (fuse > shard > multiplex > hotkeys),
+        # the per-query PlanRecords behind /siddhi-plan, and the
+        # app-wide replan history the PlanMonitor / forced-REST path
+        # appends to
+        self.planner_fallbacks: Dict[str, int] = {}
+        self.planner_fallback_reasons: Dict[str, str] = {}
+        self.planner_conflicts: Dict[str, int] = {}
+        self.planner_conflict_reasons: Dict[str, str] = {}
+        self.plans: Dict[str, object] = {}
+        self.replans: List[Dict[str, object]] = []
         # batch-cycle tracer (observability/trace.py); registered ungated
         # at app build — stage_stats() only reports stages that actually
         # recorded spans, so host-only apps keep an empty feed
@@ -403,6 +416,39 @@ class StatisticsManager:
             self.devtable_fallbacks.get(name, 0) + 1)
         self.devtable_fallback_reasons[name] = reason
 
+    def record_planner_fallback(self, qname: str, reason: str):
+        """The cost model rejected a candidate lowering (or refused a
+        replan) for a query; counted per query with the last reason
+        kept — a cost-gate rejection is never silent."""
+        self.planner_fallbacks[qname] = (
+            self.planner_fallbacks.get(qname, 0) + 1)
+        self.planner_fallback_reasons[qname] = reason
+
+    def record_planner_conflict(self, qname: str, reason: str):
+        """Two pinned annotations applied to one query and the
+        lower-precedence pin lost (fuse > shard > multiplex > hotkeys);
+        counted per query with the last reason kept."""
+        self.planner_conflicts[qname] = (
+            self.planner_conflicts.get(qname, 0) + 1)
+        self.planner_conflict_reasons[qname] = reason
+
+    def register_plan(self, qname: str, record):
+        """The chosen PlanRecord for a query (planner/costmodel.py):
+        candidates with costs, the pick, pins, and the per-query
+        re-plan history — the payload behind /siddhi-plan/<app>."""
+        self.plans[qname] = record
+
+    def record_replan(self, qname: str, old: str, new: str,
+                      forced: bool, reason: str):
+        """A live re-lowering switched a query's plan; appended to the
+        app-wide history and the query's PlanRecord."""
+        entry = {"query": qname, "from": old, "to": new,
+                 "forced": forced, "reason": reason, "ts": time.time()}
+        self.replans.append(entry)
+        rec = self.plans.get(qname)
+        if rec is not None:
+            rec.note_replan(old, new, forced, reason)
+
     def register_devtable(self, tname: str, table):
         """A live DeviceTable; its ``devtable_metrics()`` gauges (live
         rows, capacity, revision, scatter steps, compactions,
@@ -497,6 +543,25 @@ class StatisticsManager:
             out[self._metric("Queries", qname, "devtableFallbacks")] = n
             out[self._metric("Queries", qname, "devtableFallbackReason")] = (
                 self.devtable_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.planner_fallbacks.items()):
+            out[self._metric("Queries", qname, "plannerFallbacks")] = n
+            out[self._metric("Queries", qname, "plannerFallbackReason")] = (
+                self.planner_fallback_reasons.get(qname, ""))
+        for qname, n in list(self.planner_conflicts.items()):
+            out[self._metric("Queries", qname, "plannerConflicts")] = n
+            out[self._metric("Queries", qname, "plannerConflictReason")] = (
+                self.planner_conflict_reasons.get(qname, ""))
+        for qname, rec in list(self.plans.items()):
+            # legacy-mode records are informational (the REST plan dump
+            # reads them); they stay off the metrics feed so un-annotated
+            # apps keep their pre-cost-model statistics surface
+            if rec.mode == "legacy" and not rec.replans:
+                continue
+            out[self._metric("Queries", qname, "plannerPath")] = rec.chosen
+            out[self._metric("Queries", qname, "plannerPredictedCost")] = (
+                rec.predicted_cost)
+            out[self._metric("Queries", qname, "plannerReplans")] = (
+                len(rec.replans))
         for tname, table in list(self.devtables.items()):
             for metric, v in table.devtable_metrics().items():
                 out[self._metric("Tables", tname, metric)] = v

@@ -42,6 +42,25 @@ def test_whole_package_has_no_unbaselined_findings():
     assert len(res["suppressed"]) > 50
 
 
+def test_new_planner_modules_are_in_the_scan_set():
+    """The cost-model pass (planner/costmodel.py), the plan monitor
+    (planner/monitor.py) and the fuse+shard engine
+    (parallel/fused_shard.py) answer to the same whole-package scan —
+    in particular the fallback-discipline rule walks their
+    ``except SiddhiAppCreationError`` gates (monitor.decide's candidate
+    skip is allowlisted WITH a justification, not invisible)."""
+    indexes = index_package(REPO / "siddhi_tpu", REPO)
+    rels = {i.rel for i in indexes}
+    assert {"siddhi_tpu/planner/costmodel.py",
+            "siddhi_tpu/planner/monitor.py",
+            "siddhi_tpu/parallel/fused_shard.py"} <= rels
+    res = run_rules(indexes)
+    suppressed = {(f.rule, f.key) for f in res["suppressed"]}
+    assert ("fallback-discipline",
+            "siddhi_tpu/planner/monitor.py:PlanMonitor.decide") \
+        in suppressed
+
+
 def test_cli_exits_zero_on_clean_package(capsys):
     rc = main(["--root", str(REPO / "siddhi_tpu")])
     out = capsys.readouterr().out

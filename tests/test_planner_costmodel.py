@@ -1,0 +1,331 @@
+"""Cost-based unified lowering (planner/costmodel.py) suite.
+
+``@app:plan(auto='true')`` replaces the per-annotation opt-ins with one
+cost-model pass: every query's eligible lowerings — including the
+fuse+shard composition the annotation gates never offered — are scored
+with static shape/arity costs and the cheapest feasible candidate wins.
+Explicit annotations keep working as pins.
+
+The contract under test:
+
+- auto mode reaches the SAME lowering as the hand-annotated equivalent
+  on each existing differential shape (fuse chain, multiplex tumbling
+  window, mesh-sharded partition, hot-key partition);
+- the fuse+shard composition runs bit-identical to the dedicated
+  single-device fused engine;
+- cost-gate rejections are counted (plannerFallbacks) and pinned
+  annotation conflicts are counted (plannerConflicts) — never silent;
+- ``PlanMonitor.decide()`` re-scores with observed batch widths and
+  respects the hysteresis margin.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import SiddhiAppCreationError
+from siddhi_tpu.planner import costmodel as cm
+from siddhi_tpu.planner.monitor import MIN_BATCHES, PlanMonitor
+
+
+def _collector(res):
+    return lambda events: res.extend(
+        (e.timestamp, tuple(e.data)) for e in events)
+
+
+def _lowering(app):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        out = dict(rt.lowering())
+        rt.shutdown()
+        return out
+    finally:
+        m.shutdown()
+
+
+FUSE_APP = """
+@app:name('cf{tag}') @app:playback @app:execution('tpu') {ann}
+define stream SIn (sym int, price float, vol int);
+@info(name='q1') from SIn[price > 10.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid[vol > 50] select sym, price insert into Out;
+"""
+
+MUX_APP = """
+@app:name('cm{tag}') @app:execution('tpu') @app:playback {ann}
+define stream S (k long, v double);
+@info(name='qw') from S#window.lengthBatch(4)
+select k, sum(v) as s, count() as c group by k insert into OutW;
+"""
+
+SHARD_APP = """
+@app:playback @app:execution('tpu', partitions='64', devices='8') {ann}
+define stream Txn (card string, amount double);
+partition with (card of Txn) begin
+@info(name='q') from every a=Txn[amount > 100.0] -> b=Txn[amount > a.amount]
+within 10 min select a.amount as base, b.amount as bv insert into Alerts;
+end;
+"""
+
+HK_APP = """
+@app:playback @app:execution('tpu', instances='16') {ann}
+define stream S (k long, u double, v double);
+partition with (k of S) begin
+@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0]
+select b.v as bv insert into Alerts;
+end;
+"""
+
+AUTO = "@app:plan(auto='true')"
+
+
+class TestAutoVsAnnotatedParity:
+    """Un-annotated + @app:plan(auto) lands on the same lowering the
+    hand-annotated app pins, on every existing differential shape."""
+
+    def test_fuse_shape(self):
+        ann = _lowering(FUSE_APP.format(tag="a", ann="@app:fuse"))
+        auto = _lowering(FUSE_APP.format(tag="b", ann=AUTO))
+        assert ann == {"q1": "fused", "q2": "fused"}
+        assert auto == ann
+
+    def test_multiplex_shape(self):
+        ann = _lowering(MUX_APP.format(
+            tag="a", ann="@app:multiplex(slots='8')"))
+        auto = _lowering(MUX_APP.format(tag="b", ann=AUTO))
+        assert ann == {"qw": "multiplex"}
+        assert auto == ann
+
+    def test_shard_shape(self):
+        def run(ann):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(SHARD_APP.format(ann=ann))
+                rt.start()
+                low = dict(rt.lowering())
+                pr = rt.partitions.get("partition_0")
+                runtime = next(
+                    iter(pr.dense_query_runtimes.values())).pattern_processor
+                sharded = runtime._sharded is not None
+                rt.shutdown()
+                return low, sharded
+            finally:
+                m.shutdown()
+
+        ann_low, ann_sharded = run("")
+        auto_low, auto_sharded = run(AUTO)
+        assert ann_low == auto_low == {"q": "dense"}
+        # a declared mesh IS the shard pin: auto mode keeps the 8-way
+        # sharded dense engine the legacy planner builds
+        assert ann_sharded and auto_sharded
+
+    def test_hotkey_shape(self):
+        ann = _lowering(HK_APP.format(
+            ann="@app:hotkeys(k='4', promote='0.3', demote='0.1')"))
+        auto = _lowering(HK_APP.format(ann=AUTO))
+        assert ann == {"q": "hotkey"}
+        assert auto == ann
+
+
+class TestFuseShardComposition:
+    """The composition the annotation gates forbade: an all-filter
+    fused chain with its batch axis sharded over the mesh, bit-identical
+    to the dedicated single-device fused engine."""
+
+    def _run(self, dev, ann, sends):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                FUSE_APP.format(tag="s" if dev else "r", ann=ann)
+                .replace("@app:execution('tpu')",
+                         f"@app:execution('tpu'{dev})"))
+            got = []
+            rt.add_callback("Out", _collector(got))
+            rt.start()
+            h = rt.get_input_handler("SIn")
+            for row, ts in sends:
+                h.send(list(row), timestamp=ts)
+            low = dict(rt.lowering())
+            rt.shutdown()
+            return got, low
+        finally:
+            m.shutdown()
+
+    def test_fuse_shard_bit_identical_to_fused_reference(self):
+        rng = np.random.default_rng(7)
+        sends, ts = [], 1000
+        for _ in range(300):
+            sends.append(([int(rng.integers(0, 5)),
+                           float(np.float32(rng.uniform(0, 30))),
+                           int(rng.integers(1, 100))], ts))
+            ts += 3
+        ref, low_ref = self._run("", "@app:fuse", sends)
+        got, low = self._run(", devices='8'", AUTO, sends)
+        assert low_ref == {"q1": "fused", "q2": "fused"}
+        assert low == {"q1": "fuse+shard", "q2": "fuse+shard"}
+        assert len(ref) > 0
+        assert got == ref
+
+
+class TestCostModelUnits:
+    def _traits(self, kind="single", **kw):
+        t = cm.QueryTraits(kind)
+        for k, v in kw.items():
+            setattr(t, k, v)
+        return t
+
+    def _ctx(self, devices=0, slots=8):
+        return types.SimpleNamespace(tpu_devices=devices,
+                                     multiplex_slots=slots)
+
+    def test_host_cost_grows_with_batch_device_amortizes(self):
+        t, ctx = self._traits(), self._ctx()
+        assert cm.score_path("host", t, ctx, 64) \
+            < cm.score_path("host", t, ctx, 4096)
+        # at the planning batch hint the device path beats host
+        assert cm.score_path("device", t, ctx, cm.BATCH_HINT) \
+            < cm.score_path("host", t, ctx, cm.BATCH_HINT)
+        # at tiny batches the dispatch+H2D overhead flips the order
+        assert cm.score_path("host", t, ctx, 4) \
+            < cm.score_path("device", t, ctx, 4)
+
+    def test_multiplex_amortizes_dispatch_and_fusion_kills_hops(self):
+        t, ctx = self._traits(tumbling_batch=True), self._ctx()
+        assert cm.score_path("multiplex", t, ctx, cm.BATCH_HINT) \
+            < cm.score_path("device", t, ctx, cm.BATCH_HINT)
+        chain = self._traits(n_stages=3)
+        # a 3-stage fused program vs 3 dispatches + 2 junction hops
+        three_dedicated = 3 * cm.score_path(
+            "device", self._traits(), ctx, cm.BATCH_HINT) \
+            + 2 * cm.JUNCTION_HOP
+        assert cm.score_path("fuse", chain, ctx, cm.BATCH_HINT) \
+            < three_dedicated
+
+    def test_uncomposable_paths_raise_with_reason(self):
+        t = self._traits("state")
+        ctx = self._ctx(devices=8)
+        for path, frag in [
+            ("multiplex+hotkey", "not composable"),
+            ("dense+hotkey+shard", "not composable"),
+            ("multiplex+shard", "does not multiplex"),
+        ]:
+            with pytest.raises(SiddhiAppCreationError, match=frag):
+                cm._check_composable(path, t, ctx)
+        with pytest.raises(SiddhiAppCreationError, match="no device mesh"):
+            cm._check_composable("device+shard", t, self._ctx(devices=0))
+
+    def test_auto_mode_counts_rejected_candidates(self):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime("""
+@app:name('cj') @app:playback @app:execution('tpu') @app:plan(auto='true')
+define stream S (sym int, price float);
+@info(name='q1') from S[price > 10.0] select sym, price insert into Out;
+""")
+            rt.start()
+            st = rt.statistics()
+            # a sliding filter cannot seat in a multiplex group: the
+            # enumerated candidate is rejected, logged AND counted
+            key = "io.siddhi.SiddhiApps.cj.Siddhi.Queries.q1"
+            assert st[f"{key}.plannerFallbacks"] >= 1
+            assert "multiplex" in st[f"{key}.plannerFallbackReason"]
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_pinned_annotation_conflict_is_counted(self):
+        # @app:multiplex + a declared mesh: precedence says shard wins
+        # (mesh-sharded state does not multiplex) and the losing pin is
+        # counted, never silent
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(MUX_APP.format(
+                tag="c", ann="@app:multiplex(slots='8')").replace(
+                "@app:execution('tpu')",
+                "@app:execution('tpu', devices='8')"))
+            rt.start()
+            st = rt.statistics()
+            conf = {k: v for k, v in st.items() if "plannerConflict" in k}
+            assert any(v for k, v in conf.items()
+                       if k.endswith("plannerConflicts")), st
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+
+class TestPlanMonitorDecide:
+    """decide() is side-effect free: feed it observed widths, read the
+    pins it would switch."""
+
+    def _auto_rt(self, m):
+        rt = m.create_siddhi_app_runtime("""
+@app:name('mon') @app:playback @app:execution('tpu') @app:plan(auto='true')
+define stream S (sym int, price float);
+@info(name='q1') from S[price > 10.0] select sym insert into Out;
+""")
+        rt.start()
+        return rt
+
+    def _feed(self, rt, events, batches):
+        sm = rt.app_context.statistics_manager
+        sm.latency["q1"] = types.SimpleNamespace(
+            name="q1", events=events, batches=batches)
+
+    def test_small_observed_batches_switch_to_host(self):
+        m = SiddhiManager()
+        try:
+            rt = self._auto_rt(m)
+            assert rt.lowering() == {"q1": "device"}
+            mon = PlanMonitor(rt)
+            # device was chosen at the 4096-event planning hint; the
+            # app actually sees 4-event batches where host dispatch wins
+            self._feed(rt, events=40, batches=10)
+            assert mon.decide() == {"q1": "host"}
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_hysteresis_margin_blocks_marginal_wins(self):
+        m = SiddhiManager()
+        try:
+            rt = self._auto_rt(m)
+            mon = PlanMonitor(rt)
+            # at ~47 events/batch host is cheaper than device but NOT
+            # by the 30% hysteresis margin — no flip-flop
+            self._feed(rt, events=470, batches=10)
+            assert mon.decide() == {}
+            # a wider margin setting blocks even the clear win
+            strict = PlanMonitor(rt, hysteresis=9.0)
+            self._feed(rt, events=40, batches=10)
+            assert strict.decide() == {}
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_too_few_batches_is_not_evidence(self):
+        m = SiddhiManager()
+        try:
+            rt = self._auto_rt(m)
+            mon = PlanMonitor(rt)
+            self._feed(rt, events=4, batches=MIN_BATCHES - 1)
+            assert mon.decide() == {}
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+    def test_pinned_records_never_auto_switch(self):
+        m = SiddhiManager()
+        try:
+            rt = self._auto_rt(m)
+            sm = rt.app_context.statistics_manager
+            sm.plans["q1"].mode = "pinned"
+            mon = PlanMonitor(rt)
+            self._feed(rt, events=40, batches=10)
+            assert mon.decide() == {}
+            rt.shutdown()
+        finally:
+            m.shutdown()

