@@ -609,6 +609,88 @@ def bench_trace_overhead(batch=FUSE_BATCH, steps=FUSE_STEPS,
     }
 
 
+OVH_BATCH = 8_192
+OVH_STEPS = 30
+OVH_WARMUP = 5
+OVH_WINDOWS = 5
+
+OVH_APP = (
+    "@app:name('ovh{tag}') @app:execution('tpu') {limits}"
+    "define stream SIn (sym int, price float, vol int); "
+    "@info(name='q') from SIn[price > 5.0] "
+    "select sym, price, vol insert into Out;")
+
+
+def _run_shed_overhead(limits, batch, steps, warmup, windows):
+    """One admission-overhead bench run; ``limits`` is an
+    ``@app:limits(...)`` annotation (or '') so both arms share the exact
+    same app/workload with only the admission controller toggled."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(OVH_APP.format(
+            tag="L" if limits else "U", limits=limits))
+        rows = [0]
+        rt.add_callback("Out", lambda evs: rows.__setitem__(
+            0, rows[0] + len(evs)))
+        rt.start()
+        h = rt.get_input_handler("SIn")
+        rng = np.random.default_rng(47)
+
+        def mk(i):
+            sym = ((np.arange(batch, dtype=np.int64) * 524287
+                    + i * batch) % 8)
+            price = rng.uniform(0.0, 30.0, batch).astype(np.float32)
+            vol = rng.integers(1, 100, batch)
+            ts = np.full(batch, 1_000 + i * 10, dtype=np.int64)
+            return EventBatch(
+                "SIn", ["sym", "price", "vol"],
+                {"sym": sym, "price": price, "vol": vol}, ts)
+
+        bs = [mk(i) for i in range(warmup + steps)]
+        for b in bs[:warmup]:
+            h.send_batch(b)
+        window_rates = []
+        for _w in range(windows):
+            t_w = time.perf_counter()
+            for b in bs[warmup:]:
+                h.send_batch(b)
+            window_rates.append(
+                batch * steps / (time.perf_counter() - t_w))
+        rb = rt.app_context.robustness
+        shed = rb.events_shed if rb is not None else 0
+        rt.shutdown()
+        return float(np.median(window_rates)), shed, rows[0]
+    finally:
+        m.shutdown()
+
+
+def bench_overload_shed_overhead(batch=OVH_BATCH, steps=OVH_STEPS,
+                                 warmup=OVH_WARMUP, windows=OVH_WINDOWS):
+    """Admission-control cost on the hot path: the same device filter
+    app run once without ``@app:limits`` and once with a budget far
+    above the offered rate, so the token bucket runs its bookkeeping on
+    every batch but never sheds.  The acceptance bar for the robustness
+    layer is ``overload_shed_overhead_pct <= 5`` — overload protection
+    an app never needs may cost at most 5% of its throughput."""
+    limits = ("@app:limits(rate='1000000000/s', burst='1000000000', "
+              "shed='drop') ")
+    un_rate, _, un_rows = _run_shed_overhead(
+        "", batch, steps, warmup, windows)
+    lim_rate, shed, lim_rows = _run_shed_overhead(
+        limits, batch, steps, warmup, windows)
+    assert shed == 0, "sub-limit admission bench shed events"
+    assert lim_rows == un_rows, "admission changed the output row count"
+    return {
+        "limited_events_per_sec": lim_rate,
+        "unlimited_events_per_sec": un_rate,
+        "overload_shed_overhead_pct": round(
+            (un_rate - lim_rate) / un_rate * 100.0, 2) if un_rate else 0.0,
+    }
+
+
 def bench_hot_key(keys=HK_KEYS, batch=HK_BATCH, steps=HK_STEPS,
                   warmup=HK_WARMUP, windows=HK_WINDOWS):
     """Skew-aware hot-key routing: the same partitioned 2-node pattern
@@ -1503,6 +1585,14 @@ def main():
         except Exception as e:
             out["cpu_smoke_trace_overhead_error"] = str(e)
         try:
+            so = bench_overload_shed_overhead(
+                batch=SMOKE_FUSE_BATCH, steps=SMOKE_FUSE_STEPS,
+                warmup=1, windows=2)
+            out["cpu_smoke_overload_shed_overhead_pct"] = so[
+                "overload_shed_overhead_pct"]
+        except Exception as e:
+            out["cpu_smoke_overload_shed_overhead_error"] = str(e)
+        try:
             hk = bench_hot_key(keys=512, batch=SMOKE_HK_BATCH,
                                steps=SMOKE_HK_STEPS, warmup=1, windows=2)
             out["cpu_smoke_hot_key_events_per_sec"] = round(
@@ -1587,6 +1677,8 @@ def main():
                 "cpu_smoke_fused_vs_junction"),
             "cpu_smoke_trace_overhead_pct": smoke.get(
                 "cpu_smoke_trace_overhead_pct"),
+            "cpu_smoke_overload_shed_overhead_pct": smoke.get(
+                "cpu_smoke_overload_shed_overhead_pct"),
             "hot_key_pattern_events_per_sec_per_chip": None,
             "cpu_smoke_hot_key_events_per_sec": smoke.get(
                 "cpu_smoke_hot_key_events_per_sec"),
@@ -1634,6 +1726,18 @@ def main():
     devtable = bench_devtable_join()
     host = bench_host_baseline()
     persist = bench_persist_stall()
+    # admission-control acceptance: overload protection an app never
+    # needs must stay within 5% of unprotected throughput.  Guarded —
+    # a robustness regression costs these keys, not the round.
+    try:
+        ovh = bench_overload_shed_overhead()
+        shed_oh = {
+            "overload_shed_overhead_pct": ovh["overload_shed_overhead_pct"],
+            "overload_limited_events_per_sec": round(
+                ovh["limited_events_per_sec"], 1),
+        }
+    except Exception as e:
+        shed_oh = {"overload_shed_overhead_error": str(e)}
     # cost-model acceptance: @app:plan(auto) must re-derive each
     # hand-pinned lowering and match its rate.  Guarded like the Pallas
     # variants — a planner regression costs these keys, not the round.
@@ -1681,6 +1785,7 @@ def main():
         **_env_stamp(cpu_smoke=False),
         **pallas,
         **planner,
+        **shed_oh,
         "metric": "pattern_match_events_per_sec_per_chip",
         "value": round(events_per_sec, 1),
         "unit": "events/s",

@@ -153,6 +153,12 @@ ALLOWLISTS = {
             "replan() clears the lazy cache from the main path, but "
             "only inside the process-lock barrier with the persist "
             "daemon flushed; re-init is idempotent",
+        "siddhi_tpu/robustness/watchdog.py:Watchdog._last_progress":
+            "single-writer lifecycle handshake: start() stamps it once "
+            "BEFORE the daemon thread exists (Thread.start is the "
+            "happens-before edge), and every later write is from the "
+            "daemon thread itself (_tick/_trip) — there is never a "
+            "concurrent second writer, and a float store is GIL-atomic",
         "siddhi_tpu/core/stream.py:StreamJunction._running":
             "GIL-atomic monotonic bool handshake: the worker only ever "
             "clears it (sentinel mid-coalesce), lifecycle writes happen "
@@ -207,5 +213,9 @@ ALLOWLISTS = {
     "thread-lifecycle": {
         # empty: every spawn site is daemon=True or joined/cancelled on
         # a shutdown path today
+    },
+    "bounded-queue-discipline": {
+        # empty: every deque/Queue in core/, transport/ and robustness/
+        # states its bound at the construction site today
     },
 }

@@ -22,9 +22,13 @@ Catalog (one module per rule):
   ``log.warning`` and a counted ``record_*_fallback`` stats write
 - ``thread_lifecycle`` — ``thread-lifecycle``: every Thread/Timer is
   daemon or joined/cancelled on an owner-class shutdown path
+- ``bounded_queues`` — ``bounded-queue-discipline``: every deque/Queue
+  in ``core/``, ``transport/`` and ``robustness/`` carries an explicit
+  bound (``maxlen=``/``maxsize=``) or an allowlist justification
 """
 
 from . import (  # noqa: F401
+    bounded_queues,
     broad_except,
     fallback_discipline,
     host_sync,

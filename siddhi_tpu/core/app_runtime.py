@@ -76,6 +76,12 @@ class SiddhiAppRuntime:
         fi = self.app_context.fault_injector
         if sm is not None and fi is not None:
             sm.fault_tracker("injector", fi.stats)
+        # @app:limits counters register ungated too: shed/breaker/
+        # watchdog evidence must survive statistics level 'off' — the
+        # health endpoint and the metrics feed read the SAME object
+        rb = self.app_context.robustness
+        if sm is not None and rb is not None:
+            sm.robustness_tracker("overload", rb)
 
     # -- async emit pipeline barriers ---------------------------------------
 
@@ -108,6 +114,35 @@ class SiddhiAppRuntime:
         for t in self.tables.values():
             if hasattr(t, "drain"):
                 t.drain()
+
+    # -- overload gauges (robustness/watchdog.py reads these) ---------------
+
+    def _pending_work(self) -> int:
+        """Units of accepted-but-undelivered work: queued async-junction
+        batches plus staged ingest probes and deferred device emits.
+        Zero means a frozen beat is just idleness, not a stall."""
+        n = 0
+        for j in self.junctions.values():
+            if j.is_async and j._queue is not None:
+                n += j._queue.qsize()
+        for rt in self._device_runtimes():
+            eq = getattr(rt, "emit_queue", None)
+            if eq is not None:
+                n += len(eq)
+            stage = getattr(rt, "ingest_stage", None)
+            if stage is not None:
+                n += len(stage)
+        return n
+
+    def _queue_fill(self) -> float:
+        """Worst async-junction fill fraction in [0, 1] — the sustained-
+        pressure signal the degradation ladder watches."""
+        worst = 0.0
+        for j in self.junctions.values():
+            q = j._queue if j.is_async else None
+            if q is not None and q.maxsize > 0:
+                worst = max(worst, q.qsize() / q.maxsize)
+        return min(worst, 1.0)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -186,6 +221,21 @@ class SiddhiAppRuntime:
             # hysteresis margin
             self._plan_monitor = PlanMonitor(self)
             self._plan_monitor.start()
+        if (self.app_context.watchdog_deadline_ms > 0
+                and getattr(self, "_watchdog", None) is None):
+            from siddhi_tpu.robustness import DegradationLadder, Watchdog
+
+            # @app:limits(watchdog='...', ladder='true'): stall detector
+            # + self-heal daemon, optionally driving the degradation
+            # ladder.  replan() restarts the pair through here, with the
+            # transplanted stats so counters survive the heal.
+            rb = self.app_context.robustness
+            self._ladder = (DegradationLadder(self, rb)
+                            if self.app_context.ladder else None)
+            self._watchdog = Watchdog(
+                self, rb, self.app_context.watchdog_deadline_ms,
+                ladder=self._ladder)
+            self._watchdog.start()
 
     def _start_playback_heartbeat(self):
         """@app:playback(idle.time, increment): when no events arrive for
@@ -248,6 +298,13 @@ class SiddhiAppRuntime:
         t.start()
 
     def shutdown(self):
+        # the watchdog stops FIRST: a daemon that can force a replan
+        # must not race an intentional teardown
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            wd.stop()
+            self._watchdog = None
+            self._ladder = None
         mon = getattr(self, "_plan_monitor", None)
         if mon is not None:
             mon.stop()
@@ -507,6 +564,29 @@ class SiddhiAppRuntime:
                 planner = AppPlanner(
                     ast, app_str, self.app_context.siddhi_context)
                 planner.app_context.plan_pins = dict(pins or {})
+                # robustness continuity (BEFORE build, so breakers and
+                # trackers bind to the carried objects): shed/breaker
+                # counters, token-bucket levels and the degradation rung
+                # survive a self-heal exactly like the journal does
+                rb = self.app_context.robustness
+                if rb is not None and planner.app_context.robustness is not None:
+                    planner.app_context.robustness = rb
+                    ac = self.app_context.admission
+                    if ac is not None:
+                        ac.app_context = planner.app_context
+                        ac.stats = rb
+                        planner.app_context.admission = ac
+                level = self.app_context.degrade_level
+                if level:
+                    from siddhi_tpu.robustness import apply_degradation
+
+                    planner.app_context.degrade_level = level
+                    # record what the rung disabled: the rebuilt ladder
+                    # derives its rung list from these flags, and the
+                    # now-cleared annotation flags alone would leave it
+                    # zero-rung — unable to ever re-promote
+                    planner.app_context.degraded_features = tuple(
+                        apply_degradation(planner.app_context, level))
                 new_rt = planner.build()
 
                 fi = self.app_context.fault_injector
@@ -620,6 +700,46 @@ class SiddhiAppRuntime:
                             s.resume()
                         except Exception:  # pragma: no cover - best effort
                             log.exception("replan: source resume failed")
+
+    # -- health -------------------------------------------------------------
+
+    def health(self) -> Dict:
+        """Overload-protection health report (``GET /siddhi-health``).
+
+        ``healthy`` is the roll-up verdict: running, not shedding within
+        the admission window, no OPEN breaker, watchdog not wedged.  All
+        counters come off the live ``RobustnessStats`` object — the same
+        one the statistics feed wraps, so the two can never disagree.
+        Lock-free by design: a health probe must answer even while the
+        app is wedged."""
+        ctx = self.app_context
+        ac = ctx.admission
+        rb = ctx.robustness
+        wd = getattr(self, "_watchdog", None)
+        ld = getattr(self, "_ladder", None)
+        breakers = []
+        for s in list(self.sinks) + list(self.sources):
+            for t in [s] + list(getattr(s, "children", None) or []):
+                b = getattr(t, "_breaker", None)
+                if b is not None:
+                    breakers.append(b.describe())
+        shedding = ac.shedding_now() if ac is not None else False
+        wedged = wd.wedged if wd is not None else False
+        healthy = (self.running and not shedding and not wedged
+                   and not any(b["state"] == "open" for b in breakers))
+        return {
+            "app": self.name,
+            "healthy": healthy,
+            "running": self.running,
+            "shedding": shedding,
+            "wedged": wedged,
+            "degrade_level": ctx.degrade_level,
+            "admission": ac.snapshot() if ac is not None else None,
+            "breakers": breakers,
+            "watchdog": wd.describe() if wd is not None else None,
+            "ladder": ld.describe() if ld is not None else None,
+            "counters": rb.as_dict() if rb is not None else {},
+        }
 
     def pattern_state(self) -> Dict[str, Dict]:
         """Ops introspection of every pattern/sequence query's engine

@@ -44,6 +44,25 @@ class TimestampGenerator:
         return self.current_time()
 
 
+class ProgressBeat:
+    """Monotone liveness counter for the watchdog (robustness/).
+
+    Bumped on every journaled ingest and every junction dispatch — one
+    integer increment per BATCH, not per event, so the hot path cost is
+    negligible and behavior stays bit-identical.  The watchdog reads it
+    against the pending-work gauges: beats frozen + work pending =
+    stalled batch cycle.
+    """
+
+    __slots__ = ("beats",)
+
+    def __init__(self):
+        self.beats = 0
+
+    def beat(self):
+        self.beats += 1
+
+
 class SiddhiContext:
     """Per-manager shared state: extensions, persistence stores, config
     (reference: config/SiddhiContext)."""
@@ -180,6 +199,36 @@ class SiddhiAppContext:
         # daemon interval (0 = no daemon).
         self.persist_mode = "sync"
         self.persist_interval_ms = 0
+        # @app:limits(rate='N/s', burst='M', shed='drop|oldest|block',
+        # block.max='1 sec', watchdog='2 sec', breaker='3',
+        # breaker.cooldown='1 sec', ladder='true'): overload protection
+        # (robustness/).  All off by default — without the annotation
+        # the admission/watchdog/breaker/ladder hooks are None and
+        # behavior is bit-identical to an unprotected app.
+        self.limits_rate = 0.0          # events/s per stream (0 = off)
+        self.limits_burst = 0.0         # bucket depth (default = rate)
+        self.limits_shed = "drop"
+        self.limits_block_max_ms = 1000
+        self.watchdog_deadline_ms = 0   # 0 = watchdog off
+        self.breaker_threshold = 0      # 0 = breakers off
+        self.breaker_cooldown_ms = 1000
+        self.ladder = False
+        # degradation-ladder rung currently applied (replan() threads it
+        # through each rebuilt context via robustness.apply_degradation)
+        # plus the features that rung disabled — a rebuilt context's
+        # annotation flags no longer show them as enabled, so the ladder
+        # needs this record to keep its rung list (and the ability to
+        # re-promote) across the rebuild
+        self.degrade_level = 0
+        self.degraded_features = ()
+        # live robustness handles: counters, admission controller.
+        # Created by the planner when @app:limits is present; replan()
+        # re-adopts BOTH onto the replacement context so budgets and
+        # shed accounting survive a self-heal like the journal does.
+        self.robustness = None
+        self.admission = None
+        # watchdog liveness counter — always present, always beating
+        self.progress = ProgressBeat()
         self.timestamp_generator = TimestampGenerator()
         # one re-entrant lock quiesces the whole app for snapshot/restore —
         # the ThreadBarrier analog (reference: util/ThreadBarrier.java:30)

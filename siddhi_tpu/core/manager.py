@@ -93,6 +93,13 @@ class SiddhiManager:
     def get_siddhi_app_runtimes(self):
         return dict(self._app_runtimes)
 
+    def health(self) -> Dict[str, dict]:
+        """Overload-protection health of every registered app (the
+        manager-wide roll-up of ``SiddhiAppRuntime.health`` — what
+        ``GET /siddhi-health/<app>`` serves per app)."""
+        return {name: rt.health()
+                for name, rt in sorted(self._app_runtimes.items())}
+
     def set_extension(self, name: str, factory, kind: str = "function"):
         """Register a custom extension: name may be 'ns:name' or 'name'
         (reference: SiddhiManager.setExtension)."""

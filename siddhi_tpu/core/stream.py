@@ -157,6 +157,16 @@ class StreamJunction:
         if self.throughput_tracker is not None:
             self.throughput_tracker.add(len(batch))
         if self.is_async and self._running:
+            jr = getattr(self.app_context, "input_journal", None)
+            if jr is not None and jr.replaying:
+                # journal replay (replan / restore) runs single-threaded
+                # under the process lock on FRESH junctions whose queues
+                # are empty: dispatch inline so every re-delivery crosses
+                # the suppressing ledger INSIDE the replay window — a
+                # queued batch the worker dispatches after end_replay()
+                # would escape suppression and double-emit
+                self._dispatch(batch)
+                return
             self._queue.put(batch)
             return
         self._dispatch(batch)
@@ -185,6 +195,8 @@ class StreamJunction:
 
     def _dispatch(self, batch: EventBatch):
         self.dispatches += 1
+        # watchdog liveness: one beat per dispatched batch (robustness/)
+        self.app_context.progress.beat()
         for r in self.receivers:
             try:
                 r.receive(batch)
@@ -275,6 +287,9 @@ class InputHandler:
                 e.timestamp = tsgen.current_time()
             tsgen.set_event_time(e.timestamp)
         batch = batch_from_events(self.definition, events)
+        batch = self._admit(batch)
+        if batch is None:
+            return
         with self.app_context.process_lock:
             self._journal_and_check(batch)
             scheduler = self.app_context.scheduler
@@ -288,12 +303,30 @@ class InputHandler:
             # event time is monotone-max; one update per batch suffices
             self.app_context.timestamp_generator.set_event_time(
                 int(batch.timestamps.max()))
+        batch = self._admit(batch)
+        if batch is None:
+            return
         with self.app_context.process_lock:
             self._journal_and_check(batch)
             scheduler = self.app_context.scheduler
             if scheduler is not None:
                 scheduler.advance(self.app_context.timestamp_generator.current_time())
             self.junction.send(batch)
+
+    def _admit(self, batch: EventBatch) -> Optional[EventBatch]:
+        """Admission control (@app:limits, robustness/admission.py):
+        trim the batch to the per-stream token budget BEFORE journaling
+        — the journal records only admitted events, so a replay
+        reproduces exactly the admitted set.  Replay itself bypasses
+        admission (the decision was already made and journaled); apps
+        without the annotation take the None fast path unchanged."""
+        ac = getattr(self.app_context, "admission", None)
+        if ac is None:
+            return batch
+        jr = getattr(self.app_context, "input_journal", None)
+        if jr is not None and jr.replaying:
+            return batch
+        return ac.admit(self.junction.stream_id, batch)
 
     def _journal_and_check(self, batch: EventBatch):
         """Crash-recovery hook (under the process lock): journal the
@@ -304,6 +337,8 @@ class InputHandler:
         jr = getattr(self.app_context, "input_journal", None)
         if jr is not None:
             jr.record(self.junction.stream_id, batch)
+        # watchdog liveness: ingest accepted work (robustness/)
+        self.app_context.progress.beat()
         fi = getattr(self.app_context, "fault_injector", None)
         if fi is not None:
             fi.check("ingest")

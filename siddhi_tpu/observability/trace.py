@@ -46,10 +46,15 @@ STAGE_PERSIST_WRITE = "persist.write"
 #: mutation scatter step
 STAGE_TABLE_PROBE = "table.probe"
 STAGE_TABLE_UPSERT = "table.upsert"
+#: watchdog self-heal (robustness/watchdog.py): one span per trip,
+#: covering the replan-driven restore-and-replay — recovery time is a
+#: latency distribution like any other stage
+STAGE_WATCHDOG_HEAL = "watchdog.heal"
 
 _STAGES = (STAGE_INGEST, STAGE_STEP, STAGE_EMIT,
            STAGE_PERSIST_CAPTURE, STAGE_PERSIST_WRITE,
-           STAGE_TABLE_PROBE, STAGE_TABLE_UPSERT)
+           STAGE_TABLE_PROBE, STAGE_TABLE_UPSERT,
+           STAGE_WATCHDOG_HEAL)
 
 
 class CycleToken:

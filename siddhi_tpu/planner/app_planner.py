@@ -434,6 +434,115 @@ class AppPlanner:
                         f"@app:persist: interval {iv!r} must be > 0")
                 self.app_context.persist_interval_ms = interval_ms
 
+        # @app:limits(rate='N/s', burst='M', shed='drop|oldest|block',
+        # block.max='1 sec', watchdog='2 sec', breaker='3',
+        # breaker.cooldown='1 sec', ladder='true'): overload protection
+        # (robustness/) — admission control at ingest, watchdog-driven
+        # self-healing, transport circuit breakers, and the unified
+        # degradation ladder.  Absent ⇒ every hook stays None and the
+        # engine is bit-identical to an unprotected app.
+        limits_ann = find_annotation(siddhi_app.annotations, "app:limits")
+        if limits_ann is not None:
+            from siddhi_tpu.compiler.parser import parse_time_string
+            from siddhi_tpu.robustness import (
+                AdmissionController,
+                RobustnessStats,
+            )
+            from siddhi_tpu.robustness.admission import SHED_POLICIES
+
+            ctx = self.app_context
+
+            def limits_time_ms(key):
+                v = limits_ann.element(key)
+                if v is None:
+                    return None
+                try:
+                    ms = int(v)
+                except ValueError:
+                    ms = parse_time_string(v)
+                if ms <= 0:
+                    raise SiddhiAppCreationError(
+                        f"@app:limits: {key}={v!r} must be > 0")
+                return ms
+
+            rate = limits_ann.element("rate") or limits_ann.element()
+            if rate:
+                r = rate.strip().lower()
+                for suffix in ("/sec", "/s"):
+                    if r.endswith(suffix):
+                        r = r[: -len(suffix)]
+                        break
+                try:
+                    ctx.limits_rate = float(r)
+                except ValueError:
+                    ctx.limits_rate = -1.0
+                if ctx.limits_rate <= 0:
+                    raise SiddhiAppCreationError(
+                        f"@app:limits: rate='{rate}' must be a positive "
+                        "events-per-second figure ('1000' or '1000/s')")
+            burst = limits_ann.element("burst")
+            if burst:
+                try:
+                    ctx.limits_burst = float(burst)
+                except ValueError:
+                    ctx.limits_burst = -1.0
+                if ctx.limits_burst < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:limits: burst='{burst}' must be >= 1 "
+                        "(token-bucket depth in events)")
+                if not ctx.limits_rate:
+                    raise SiddhiAppCreationError(
+                        "@app:limits: burst needs rate")
+            elif ctx.limits_rate:
+                ctx.limits_burst = max(ctx.limits_rate, 1.0)
+            shed = limits_ann.element("shed")
+            if shed:
+                if shed not in SHED_POLICIES:
+                    raise SiddhiAppCreationError(
+                        f"@app:limits: shed='{shed}' must be one of "
+                        f"{', '.join(SHED_POLICIES)}")
+                if not ctx.limits_rate:
+                    raise SiddhiAppCreationError(
+                        "@app:limits: shed needs rate")
+                ctx.limits_shed = shed
+            bm = limits_time_ms("block.max")
+            if bm is not None:
+                ctx.limits_block_max_ms = bm
+            wd = limits_time_ms("watchdog")
+            if wd is not None:
+                ctx.watchdog_deadline_ms = wd
+            br = limits_ann.element("breaker")
+            if br:
+                try:
+                    nb = int(br)
+                except ValueError:
+                    nb = -1
+                if nb < 1:
+                    raise SiddhiAppCreationError(
+                        f"@app:limits: breaker='{br}' must be a positive "
+                        "integer (consecutive failures before opening)")
+                ctx.breaker_threshold = nb
+            bc = limits_time_ms("breaker.cooldown")
+            if bc is not None:
+                ctx.breaker_cooldown_ms = bc
+            lv = (limits_ann.element("ladder") or "false").strip().lower()
+            if lv not in ("true", "false"):
+                raise SiddhiAppCreationError(
+                    f"@app:limits: ladder='{lv}' must be 'true' or 'false'")
+            ctx.ladder = lv == "true"
+            if ctx.ladder and not ctx.watchdog_deadline_ms:
+                raise SiddhiAppCreationError(
+                    "@app:limits: ladder='true' needs watchdog='<deadline>'"
+                    " — the watchdog tick is what drives the ladder")
+            if not (ctx.limits_rate or ctx.watchdog_deadline_ms
+                    or ctx.breaker_threshold):
+                raise SiddhiAppCreationError(
+                    "@app:limits: needs at least one of rate, watchdog, "
+                    "breaker")
+            ctx.robustness = RobustnessStats()
+            if ctx.limits_rate:
+                ctx.admission = AdmissionController(ctx, ctx.robustness)
+
         self.scheduler = Scheduler(self.app_context)
         self.app_context.scheduler = self.scheduler
 
@@ -558,6 +667,20 @@ class AppPlanner:
             raise SiddhiAppCreationError(f"unknown @map(type='{map_type}') for {kind}")
         return factory(), self._ann_options(map_ann) if map_ann else {}
 
+    def _make_breaker(self, name: str):
+        """@app:limits(breaker='N'): one CircuitBreaker per transport
+        endpoint, all counting on the app's RobustnessStats."""
+        from siddhi_tpu.robustness import CircuitBreaker
+
+        ctx = self.app_context
+        return CircuitBreaker(
+            name,
+            threshold=ctx.breaker_threshold,
+            cooldown_ms=ctx.breaker_cooldown_ms,
+            stats=ctx.robustness,
+            fault_injector=ctx.fault_injector,
+        )
+
     def _attach_transports(self, definition, junction):
         from siddhi_tpu.transport.sink import DistributedSink, SinkStreamCallback
 
@@ -578,6 +701,12 @@ class AppPlanner:
                     src.handler = shm.generate(self.name, definition.id)
                     self.handler_registrations.append((shm, src.handler.element_id))
                 src.init(definition, opts, mapper, junction, self.app_context)
+                if self.app_context.breaker_threshold:
+                    # sources have nothing to spool (their transport
+                    # holds the data); the breaker just spaces out
+                    # doomed connect attempts on the mixin's chain
+                    src._breaker = self._make_breaker(
+                        f"source:{definition.id}")
                 self.sources.append(src)
             elif nm == "sink":
                 stype, opts = self._transport_config(ann, "sink")
@@ -611,6 +740,17 @@ class AppPlanner:
                     sink.handler = khm.generate(self.name, definition.id)
                     self.handler_registrations.append((khm, sink.handler.element_id))
                 sink.init(definition, opts, mapper, self.app_context)
+                if self.app_context.breaker_threshold:
+                    # per-endpoint breakers: a distributed sink breaks
+                    # each destination independently, never the fan-out
+                    targets = (sink.children
+                               if isinstance(sink, DistributedSink)
+                               else [sink])
+                    for di, child in enumerate(targets):
+                        suffix = f"#{di}" if child is not sink else ""
+                        child.attach_breaker(self._make_breaker(
+                            f"sink:{definition.id}:{len(self.sinks)}"
+                            f"{suffix}"))
                 # publish failures follow the stream's @OnError contract
                 # (reference: Sink.onError:354 routing into '!stream')
                 sink.stream_junction = junction

@@ -14,6 +14,9 @@ server instead of MSF4J:
                                              costs, pins, re-plan history)
     GET  /siddhi-replan/{name}?q0=path      (force a live re-lowering;
                                              pairs pin per-query paths)
+    GET  /siddhi-health/{name}              (overload-protection health:
+                                             200 healthy / 503 shedding,
+                                             open breaker or wedged)
     GET  /metrics                           (Prometheus text exposition)
 
 Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}`` except
@@ -48,6 +51,11 @@ class SiddhiService:
         service = self
 
         class Handler(BaseHTTPRequestHandler):
+            # per-request socket timeout: a stalled client (or a wedge
+            # downstream of a blocking read) must not pin one of the
+            # server's threads forever
+            timeout = 10
+
             def log_message(self, *args):  # quiet test output
                 pass
 
@@ -103,6 +111,9 @@ class SiddhiService:
                     pins = {k: v[0]
                             for k, v in parse_qs(url.query).items()}
                     code, payload = service.replan(parts[2], pins)
+                    self._send(code, payload)
+                elif len(parts) == 3 and parts[1] == "siddhi-health":
+                    code, payload = service.health(parts[2])
                     self._send(code, payload)
                 elif len(parts) == 3 and parts[1] == "siddhi-statistics":
                     code, payload = service.statistics(parts[2])
@@ -170,6 +181,41 @@ class SiddhiService:
         runtime.shutdown()
         return 200, {"status": "OK", "message": f"Siddhi app '{name}' undeployed"}
 
+    @staticmethod
+    def _overload_503(name: str, runtime):
+        """503 + the health report when the target app is shedding, has
+        an open breaker, or is wedged — for routes that would otherwise
+        BLOCK on the app's process lock.  None when the app (or an app
+        without @app:limits) can serve the request now."""
+        if getattr(runtime.app_context, "robustness", None) is None:
+            return None
+        h = runtime.health()
+        if h["healthy"]:
+            return None
+        return 503, {
+            "status": "ERROR",
+            "message": f"Siddhi app '{name}' is overloaded "
+                       "(shedding, open breaker, or wedged) — "
+                       "see /siddhi-health/" + name,
+            "health": h,
+        }
+
+    def health(self, name: str):
+        """Overload-protection health of a deployed app: admission
+        budgets + shed counts, breaker states, watchdog and ladder
+        state, and the full robustness counter block (the same live
+        objects the statistics feed reads).  200 healthy / 503 not."""
+        with self._lock:
+            runtime = self._runtimes.get(name)
+        if runtime is None:
+            return 404, {
+                "status": "ERROR",
+                "message": f"there is no Siddhi app named '{name}'",
+            }
+        h = runtime.health()
+        code = 200 if h["healthy"] else 503
+        return code, {"status": "OK" if h["healthy"] else "UNHEALTHY", **h}
+
     def pattern_state(self, name: str):
         """Per-query pattern-engine occupancy of a deployed app (dense:
         partitions/instances/overflow; host: live instances)."""
@@ -180,6 +226,12 @@ class SiddhiService:
                 "status": "ERROR",
                 "message": f"there is no Siddhi app named '{name}'",
             }
+        # pattern_state() takes the app lock — answer 503 with the
+        # health report instead of parking the request thread behind a
+        # shedding or wedged app
+        busy = self._overload_503(name, runtime)
+        if busy is not None:
+            return busy
         return 200, {"status": "OK", "queries": runtime.pattern_state()}
 
     def query_lowering(self, name: str):
@@ -243,6 +295,9 @@ class SiddhiService:
                 "status": "ERROR",
                 "message": f"there is no Siddhi app named '{name}'",
             }
+        busy = self._overload_503(name, runtime)
+        if busy is not None:
+            return busy
         try:
             lowering = runtime.replan(pins or {}, forced=True,
                                       reason="forced via REST")
@@ -262,6 +317,9 @@ class SiddhiService:
                 "status": "ERROR",
                 "message": f"there is no Siddhi app named '{name}'",
             }
+        busy = self._overload_503(name, runtime)
+        if busy is not None:
+            return busy
         try:
             revision = runtime.persist()
         except Exception as e:  # noqa: BLE001 — surface persist errors to client

@@ -51,6 +51,9 @@ class ConnectRetryMixin:
         self._retry_lock = threading.Lock()
         self._retry_timer = None
         self._shutdown = False
+        # circuit breaker (robustness/breaker.py), attached by the
+        # planner when @app:limits(breaker='N') is present
+        self._breaker = None
 
     def start(self):
         # under the retry lock: a pending Timer chain from a previous
@@ -82,12 +85,30 @@ class ConnectRetryMixin:
             if self._retrying:
                 return
             self._retrying = True
+        breaker = getattr(self, "_breaker", None)
+        if breaker is not None and not breaker.allow():
+            # circuit open: skip the connect attempt entirely and
+            # re-check after the ladder interval (by then the cooldown
+            # may have elapsed and this chain becomes the half-open probe)
+            interval = self._retry.get_time_interval_ms()
+            self._retry.increment()
+            self._arm_retry_timer(interval)
+            return
         try:
             fi = getattr(self, "_fault_injector", None)
             if fi is not None:
                 fi.check(getattr(self, "_fault_site_connect", "connect"))
             self.connect()
         except ConnectionUnavailableError as e:
+            if breaker is not None:
+                try:
+                    breaker.record_failure()
+                except Exception as fault:  # noqa: BLE001
+                    # injected breaker.open fault: already counted by the
+                    # injector; the backoff chain must survive it
+                    log.warning(
+                        "%s on stream '%s': breaker.open site fault: %s",
+                        type(self).__name__, self.definition.id, fault)
             with self._retry_lock:
                 self._retry_attempts += 1
                 exhausted = (
@@ -108,11 +129,7 @@ class ConnectRetryMixin:
                 "%s on stream '%s' connection failed (%s); retrying in %d ms",
                 type(self).__name__, self.definition.id, e, interval,
             )
-            t = threading.Timer(interval / 1000.0, self._retry_connect)
-            t.daemon = True
-            with self._retry_lock:
-                self._retry_timer = t
-            t.start()
+            self._arm_retry_timer(interval)
             return  # flag stays held until the timer fires
         except BaseException:
             with self._retry_lock:
@@ -124,6 +141,34 @@ class ConnectRetryMixin:
             self._retry_attempts = 0
             self.failed = False
             self._retrying = False
+        if breaker is not None and breaker.record_success():
+            # this connect CLOSED the breaker — drain anything the owner
+            # spooled while it was open (sinks override; default no-op)
+            self._on_breaker_closed()
+
+    def _on_breaker_closed(self):
+        """Hook: the circuit breaker closed after a successful connect.
+        Sinks flush their open-state spool here; sources have nothing
+        buffered (their pause path already replays in order)."""
+
+    def _arm_retry_timer(self, interval_ms: int):
+        """Arm the next backoff Timer — under ``_retry_lock`` and gated
+        on ``_shutdown``.  A concurrent ``shutdown()`` that already ran
+        ``_shutdown_retry()`` found no timer to cancel; arming one here
+        anyway would leave a zombie firing after shutdown (and, because
+        ``start()`` re-clears ``_shutdown``, able to interleave with a
+        NEW chain's state).  Checking under the same lock closes the
+        race: either the cancel sees our timer, or we see the flag."""
+        import threading
+
+        t = threading.Timer(interval_ms / 1000.0, self._retry_connect)
+        t.daemon = True
+        with self._retry_lock:
+            if self._shutdown:
+                self._retrying = False
+                return
+            self._retry_timer = t
+        t.start()
 
     def _retry_connect(self):
         with self._retry_lock:

@@ -100,6 +100,22 @@ class Sink(ConnectRetryMixin):
         # concurrently — instance state would cross their topics
         self._tls = threading.local()
         self._init_retry(options)
+        # open-breaker spool (robustness/breaker.py): batches held while
+        # the circuit is open, flushed once it closes.  The output
+        # ledger counted them at junction dispatch, so a crash-replay
+        # suppresses them and the flush never double-emits.
+        self._spool = None
+        self._spool_cap = 0
+        self._spool_lock = threading.Lock()
+
+    def attach_breaker(self, breaker, spool_cap: int = 1024):
+        """Planner hook: install the circuit breaker and its bounded
+        open-state spool (@app:limits(breaker='N'))."""
+        from collections import deque
+
+        self._breaker = breaker
+        self._spool_cap = int(spool_cap)
+        self._spool = deque(maxlen=self._spool_cap)
 
     # -- SPI ---------------------------------------------------------------
 
@@ -117,6 +133,14 @@ class Sink(ConnectRetryMixin):
 
     def shutdown(self):
         self._shutdown_retry()
+        if self._spool:
+            # ledger-counted as delivered at junction dispatch, so a
+            # replay will NOT re-emit them: the exactly-once discipline
+            # errs on at-most-once for the spool — make the loss loud
+            log.warning(
+                "sink %s on stream '%s' shutting down with %d batch(es) "
+                "still spooled behind an open breaker",
+                type(self).__name__, self.definition.id, len(self._spool))
         if self.connected:
             self.disconnect()
             # the retry thread writes `connected` under _retry_lock;
@@ -135,6 +159,18 @@ class Sink(ConnectRetryMixin):
         return events
 
     def send_batch(self, batch: EventBatch):
+        b = self._breaker
+        if b is not None:
+            if not b.allow():
+                # circuit open: hold the batch instead of burning a
+                # publish attempt per event (short-circuit is counted
+                # by the breaker)
+                self._spool_batch(batch)
+                return
+            if self._spool:
+                # breaker closed with spooled history: drain it FIRST
+                # so external observers see the original order
+                self._flush_spool()
         events = self._intercepted_events(batch)
         if not events:
             return
@@ -177,6 +213,11 @@ class Sink(ConnectRetryMixin):
         """Publish one payload; on connection failure route to
         ``on_error`` and kick off the single reconnect chain."""
         if not self.connected:
+            if self._breaker is not None:
+                # disconnected publishes count as breaker failures: once
+                # the threshold trips, later batches spool in send_batch
+                # instead of dropping through on_error one by one
+                self._breaker.record_failure()
             self.on_error(payload, ConnectionUnavailableError("not connected"))
             return
         try:
@@ -184,17 +225,86 @@ class Sink(ConnectRetryMixin):
             if fi is not None:
                 fi.check("sink.publish")
             self.publish(payload)
+            if self._breaker is not None and self._breaker.record_success():
+                # the half-open probe just succeeded through the PUBLISH
+                # path — flush whatever spooled while the circuit was open
+                self._flush_spool()
         except ConnectionUnavailableError as e:
             # the retry thread writes `connected` under _retry_lock;
             # the main-path clear takes the same lock
             with self._retry_lock:
                 self.connected = False
+            if self._breaker is not None:
+                self._breaker.record_failure()
             self.on_error(payload, e)
             self._connect_with_retry()
         except InjectedFaultError as e:
             # injected sink failure: the event routes through the same
             # @OnError contract a real publish error would use
             self.on_error(payload, e)
+
+    # -- circuit breaker ----------------------------------------------------
+
+    def _spool_batch(self, batch: EventBatch):
+        """Hold a batch while the circuit is open.  The deque is
+        bounded (attach_breaker); on overflow the OLDEST batch is
+        evicted and its events counted as spool drops — under overload
+        the freshest output survives, matching the junction's drop
+        discipline."""
+        sp = self._spool
+        stats = getattr(self.app_context, "robustness", None)
+        with self._spool_lock:
+            if len(sp) == sp.maxlen:
+                evicted = sp[0]  # appending below auto-evicts it
+                if stats is not None:
+                    stats.breaker_spool_dropped += len(evicted)
+                log.warning(
+                    "sink %s on stream '%s': open-breaker spool full "
+                    "(%d batches) — dropping oldest %d event(s)",
+                    type(self).__name__, self.definition.id, sp.maxlen,
+                    len(evicted))
+            sp.append(batch)
+        if stats is not None:
+            stats.breaker_spooled_batches += 1
+
+    def _on_breaker_closed(self):
+        """Mixin hook: a successful CONNECT closed the breaker."""
+        self._flush_spool()
+
+    def _flush_spool(self):
+        """Publish everything spooled while the circuit was open, in
+        order.  Events were already counted by the output ledger at
+        junction dispatch, so this goes straight through the publish
+        path — never back through ``SinkStreamCallback.receive`` —
+        and a replay can never double-emit them.  If the breaker
+        re-opens mid-flush the remainder stays spooled for the next
+        close; the batch in flight routes its failures through
+        ``on_error`` like any other publish."""
+        sp = self._spool
+        if not sp:
+            return
+        stats = getattr(self.app_context, "robustness", None)
+        with self._spool_lock:
+            while sp:
+                if self._breaker is not None and self._breaker.is_open():
+                    break
+                batch = sp.popleft()
+                if stats is not None:
+                    stats.breaker_flushed_batches += 1
+                events = self._intercepted_events(batch)
+                if not events:
+                    continue
+                payloads = self.mapper.map(events)
+                if len(payloads) == len(events):
+                    for e, payload in zip(events, payloads):
+                        self._tls.event = e
+                        try:
+                            self.publish_with_reconnect(payload)
+                        finally:
+                            self._tls.event = None
+                else:
+                    for payload in payloads:
+                        self.publish_with_reconnect(payload)
 
     def _on_retry_exhausted(self, e: Exception):
         """retry.max.attempts ran out: the sink is marked failed

@@ -47,6 +47,10 @@ Injection sites (strings, by convention ``layer.point``):
 ``persist.post_manifest`` crash point: revision committed, journal mark not
 ``journal.spill``     journal-segment store write (retryable)
 ``journal.spill.mid`` crash point: segment durable, journal not yet trimmed
+``replan.reseat``     crash point: replacement engines built, old not torn
+``admission.shed``    @app:limits admission controller sheds events
+``watchdog.trip``     watchdog detected a stall, before the self-heal
+``breaker.open``      a transport circuit breaker transitions to OPEN
 ====================  ====================================================
 
 Fault kinds:

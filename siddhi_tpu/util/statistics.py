@@ -225,6 +225,21 @@ class DurabilityTracker:
         return self.durability_stats.as_dict()
 
 
+class RobustnessTracker:
+    """Overload-protection counters (robustness/ RobustnessStats):
+    same thin-gauge pattern as FaultTracker — the admission controller,
+    breakers, watchdog and ladder increment their own counters, this
+    view just reads them (and the health endpoint reads the SAME
+    object, so feed and endpoint cannot disagree)."""
+
+    def __init__(self, name: str, robustness_stats):
+        self.name = name
+        self.robustness_stats = robustness_stats
+
+    def values(self) -> Dict[str, int]:
+        return self.robustness_stats.as_dict()
+
+
 class StatisticsManager:
     """Tracker registry + periodic console reporter
     (reference: util/statistics/metrics/SiddhiStatisticsManager.java:35)."""
@@ -249,6 +264,10 @@ class StatisticsManager:
         # registered ungated like the fault counters — a degraded
         # durability pipeline must stay visible at statistics level 'off'
         self.durability: Dict[str, DurabilityTracker] = {}
+        # overload-protection gauges (@app:limits, robustness/),
+        # registered ungated — shedding and breaker trips must stay
+        # visible at statistics level 'off'
+        self.robustness: Dict[str, RobustnessTracker] = {}
         # persist-path degradations (unfreezable element → in-barrier
         # pickle, incremental store forcing sync): count + last reason,
         # keyed '<app>' or '<app>.<kind>:<element>', never silent
@@ -356,6 +375,11 @@ class StatisticsManager:
                            durability_stats) -> DurabilityTracker:
         return self.durability.setdefault(
             name, DurabilityTracker(name, durability_stats))
+
+    def robustness_tracker(self, name: str,
+                           robustness_stats) -> RobustnessTracker:
+        return self.robustness.setdefault(
+            name, RobustnessTracker(name, robustness_stats))
 
     def record_persist_fallback(self, name: str, reason: str):
         """A persist degraded (element pickled in-barrier, async forced
@@ -504,6 +528,9 @@ class StatisticsManager:
         for dt in list(self.durability.values()):
             for metric, v in dt.values().items():
                 out[self._metric("Durability", dt.name, metric)] = v
+        for rt in list(self.robustness.values()):
+            for metric, v in rt.values().items():
+                out[self._metric("Robustness", rt.name, metric)] = v
         for name, n in list(self.persist_fallbacks.items()):
             out[self._metric("Durability", name, "persistFallbacks")] = n
             out[self._metric("Durability", name, "persistFallbackReason")] = (

@@ -22,10 +22,11 @@ REPO = Path(__file__).resolve().parent.parent
 def test_rule_catalog_is_complete():
     rules = all_rules()
     names = {r.name for r in rules}
-    assert len(rules) >= 8, names
+    assert len(rules) >= 9, names
     assert {"host-sync-hazard", "ingest-put-bypass", "broad-except-swallow",
             "lock-discipline", "jit-purity", "retrace-hazard",
-            "fallback-discipline", "thread-lifecycle"} <= names
+            "fallback-discipline", "thread-lifecycle",
+            "bounded-queue-discipline"} <= names
     for r in rules:
         assert r.description, f"rule {r.name} has no description"
 
