@@ -12,17 +12,41 @@ Examples::
     python -m siddhi_tpu.analysis --rules jit-purity,retrace-hazard
     python -m siddhi_tpu.analysis --baseline analysis_baseline.json
     python -m siddhi_tpu.analysis --write-baseline analysis_baseline.json
+    python -m siddhi_tpu.analysis --changed-only origin/main  # pre-push
+
+``--changed-only GITREF`` is the pre-push check: the whole package is
+still indexed (the parse cache makes that one parse per file, and the
+whole-program rules need the full call graph anyway), but only
+findings in modules that differ from ``GITREF`` are reported, and
+allowlist staleness — a whole-list property — is not judged.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
 from .framework import all_rules, get_rule, run_rules
 from .index import index_package
 from . import reporting
+
+
+def changed_rels(rel_base: Path, gitref: str):
+    """Repo-relative paths that differ from ``gitref`` (committed,
+    staged, or worktree changes) plus untracked files."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", gitref],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(
+            cmd, cwd=rel_base, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def main(argv=None) -> int:
@@ -54,6 +78,11 @@ def main(argv=None) -> int:
         "--write-baseline", default=None, metavar="FILE",
         help="write current unallowlisted findings as a baseline and "
              "exit 0")
+    parser.add_argument(
+        "--changed-only", default=None, metavar="GITREF",
+        help="report only findings in modules that differ from GITREF "
+             "(the cheap pre-push check; stale-allowlist enforcement "
+             "is skipped — staleness is a whole-package property)")
     args = parser.parse_args(argv)
 
     rules = all_rules()
@@ -81,6 +110,14 @@ def main(argv=None) -> int:
     result = run_rules(indexes, rules)
     findings = result["findings"]
     suppressed = result["suppressed"]
+
+    if args.changed_only:
+        try:
+            changed = changed_rels(rel_base, args.changed_only)
+        except (OSError, RuntimeError) as e:
+            parser.error(f"--changed-only: {e}")
+        findings = [f for f in findings
+                    if f.rel in changed and f.rule != "stale-allowlist"]
 
     if args.write_baseline:
         reporting.write_baseline(args.write_baseline, findings)

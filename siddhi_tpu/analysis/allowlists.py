@@ -139,6 +139,12 @@ ALLOWLISTS = {
         # counts, or re-routes today
     },
     "lock-discipline": {
+        # empty: every conflict the lexical pass can see is also seen —
+        # and reported once — by the flow-sensitive lockset-race rule
+        # below (the wrapper stands down on shared keys); entries live
+        # under "lockset-race" now, with the same key shape
+    },
+    "lockset-race": {
         "siddhi_tpu/core/app_runtime.py:SiddhiAppRuntime._snapshot_svc":
             "replan() clears the lazy cache from the main path, but "
             "only inside the process-lock barrier with sources paused, "
@@ -165,6 +171,14 @@ ALLOWLISTS = {
             "before thread start / after join; no compound "
             "read-modify-write on either side, and taking a lock in "
             "send() would serialize the hot fan-out path",
+    },
+    "lock-order-deadlock": {
+        # empty: the global acquisition-order graph is acyclic today
+        # (process_lock strictly outermost, component locks leaf-only)
+    },
+    "barrier-flush-completeness": {
+        # empty: StreamJunction.stop drains _queue, Sink.shutdown
+        # flushes _spool (final-barrier flush added with this rule)
     },
     "jit-purity": {
         # the cross-module helper scan reaches host-level dispatchers

@@ -25,8 +25,18 @@ Catalog (one module per rule):
 - ``bounded_queues`` — ``bounded-queue-discipline``: every deque/Queue
   in ``core/``, ``transport/`` and ``robustness/`` carries an explicit
   bound (``maxlen=``/``maxsize=``) or an allowlist justification
+- ``lockset_race`` — ``lockset-race``: flow-sensitive Eraser-style
+  cross-thread write check (per-statement must-hold locksets over the
+  CFG; subsumes the lexical lock-discipline pass)
+- ``lock_order`` — ``lock-order-deadlock``: cycles in the global
+  lock-acquisition-order graph, plus held non-reentrant re-acquires
+- ``barrier_flush`` — ``barrier-flush-completeness``: every barrier
+  method reaches a flush of every bounded queue its class owns
 """
 
+# NOTE: lockset_race MUST import (= register = run) before
+# lock_discipline — the lexical rule consults the flow rule's reported
+# keys to emit shared conflicts once (lockset wins).
 from . import (  # noqa: F401
     bounded_queues,
     broad_except,
@@ -34,7 +44,10 @@ from . import (  # noqa: F401
     host_sync,
     ingest_put,
     jit_purity,
+    lockset_race,
     lock_discipline,
+    lock_order,
+    barrier_flush,
     retrace,
     thread_lifecycle,
 )

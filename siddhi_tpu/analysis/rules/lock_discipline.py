@@ -41,6 +41,14 @@ original single-module lexical pass.
 The lexical lock check is conservative by design: disciplines the rule
 cannot see (GIL-atomic monotonic flags, caller-holds-lock contracts)
 are allowlisted per attribute with a written justification.
+
+Since the flow-sensitive ``lockset-race`` rule landed, this pass is a
+**thin compatibility wrapper**: in a full-catalog run it stands down
+entirely — the lockset rule reports the same conflicts under the same
+``<rel>:<Class.attr>`` keys with per-statement precision (its
+allowlist inherited this rule's entries verbatim) — and only
+standalone runs (``--rules lock-discipline``, fixture harnesses)
+exercise the original lexical behavior.
 """
 
 from __future__ import annotations
@@ -50,39 +58,10 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..framework import Finding, Rule, register
 from ..index import ModuleIndex
-
-_THREAD_CTORS = {"threading.Thread", "Thread"}
-_TIMER_CTORS = {"threading.Timer", "Timer"}
+from ..locksets import thread_target_of as _target_of
 
 #: a write-site record: (scope qualname, line, under_lock, rel)
 _Site = Tuple[str, int, bool, str]
-
-
-def _target_of(call: ast.Call, index: ModuleIndex):
-    """(kind, node) for a thread-launching call: kind 'method' with the
-    method name, or 'local' with the Name node of a local function."""
-    name = index.dotted(call.func)
-    target = None
-    if name in _THREAD_CTORS:
-        for kw in call.keywords:
-            if kw.arg == "target":
-                target = kw.value
-    elif name in _TIMER_CTORS:
-        if len(call.args) >= 2:
-            target = call.args[1]
-        else:
-            for kw in call.keywords:
-                if kw.arg == "function":
-                    target = kw.value
-    if target is None:
-        return None
-    if isinstance(target, ast.Attribute) and \
-            isinstance(target.value, ast.Name) and \
-            target.value.id in ("self", "cls"):
-        return ("method", target.attr)
-    if isinstance(target, ast.Name):
-        return ("local", target.id)
-    return None
 
 
 @register
@@ -109,6 +88,17 @@ class LockDisciplineRule(Rule):
 
     def finish(self) -> Iterable[Finding]:
         if self.project is None:
+            return ()
+        # compatibility-wrapper mode: when the flow-sensitive
+        # lockset-race rule ran earlier in this run (registration order
+        # guarantees it in the full catalog), this rule stands down —
+        # every conflict the lexical pass can see, the lockset pass
+        # sees with strictly better precision (same Class.attr keys),
+        # so shared findings emit once and lexical-only candidates are
+        # the flow pass's *proven-safe* set, not new signal.  Run
+        # standalone (--rules lock-discipline, fixture harnesses) the
+        # stash is absent and the full lexical behavior remains.
+        if getattr(self.project, "_lockset_keys", None) is not None:
             return ()
         for fq_class in sorted(self.project.classes):
             idx, cls = self.project.classes[fq_class]

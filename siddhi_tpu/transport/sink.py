@@ -106,7 +106,12 @@ class Sink(ConnectRetryMixin):
         # suppresses them and the flush never double-emits.
         self._spool = None
         self._spool_cap = 0
-        self._spool_lock = threading.Lock()
+        # REENTRANT: a flush publishing through a half-open breaker
+        # closes it via record_success(), and publish_with_reconnect
+        # then re-enters _flush_spool on the same thread — a plain
+        # Lock self-deadlocks on that path (the nested flush drains
+        # whatever remains and the outer loop exits on empty)
+        self._spool_lock = threading.RLock()
 
     def attach_breaker(self, breaker, spool_cap: int = 1024):
         """Planner hook: install the circuit breaker and its bounded
@@ -133,6 +138,18 @@ class Sink(ConnectRetryMixin):
 
     def shutdown(self):
         self._shutdown_retry()
+        if self._spool and self.connected and (
+                self._breaker is None or self._breaker.allow()):
+            # final barrier flush: the transport is still up and the
+            # breaker admits a delivery (closed, or open past cooldown
+            # — allow() flips it to a half-open probe and the first
+            # publish closes it), so the batches spooled during the
+            # last open window can still go out in order — shutting
+            # down without this drain strands them behind the barrier
+            # (the loss warning below then fires for events that were
+            # perfectly deliverable); a deny leaves the spool for the
+            # warning, respecting the open circuit
+            self._flush_spool()
         if self._spool:
             # ledger-counted as delivered at junction dispatch, so a
             # replay will NOT re-emit them: the exactly-once discipline

@@ -1,0 +1,406 @@
+"""Differential fixtures for the flow-sensitive concurrency rules.
+
+Each fixture plants a bug the flow-INsensitive pass provably misses
+(or a safe pattern it provably over-reports), and asserts both sides:
+
+- **released-then-write**: a write lexically inside ``with lock:`` but
+  after an explicit ``release()`` — lexical lock-discipline calls it
+  locked, lockset-race sees the empty per-statement lockset;
+- **disjoint locks**: thread and main path each hold *a* lock, just
+  not the same one — lexically locked, dynamically unordered;
+- **AB/BA deadlock**: opposite nesting orders across two methods,
+  including the interprocedural variant where the inner acquisition
+  lives in a private helper (caught only via entry-lockset seeding);
+- **barrier missing one queue flush**: a shutdown barrier that drains
+  one owned queue and only "flushes" the other in dead code after a
+  ``return`` — reachability through the CFG, not lexical presence;
+- **de-duplication**: a conflict both passes can see emits once, from
+  lockset-race (the wrapper stands down), and lock-discipline keeps
+  its full behavior when run standalone.
+"""
+
+import textwrap
+from pathlib import Path
+
+from siddhi_tpu.analysis import Allowlist, ModuleIndex, get_rule, run_rules
+
+THREADING = "import threading\n"
+
+
+def _mod(rel, src):
+    return ModuleIndex(Path(rel), rel, source=textwrap.dedent(src))
+
+
+def _run(files, rule_names, allowlists=None):
+    indexes = [_mod(rel, src) for rel, src in files.items()]
+    rules = [get_rule(n) for n in rule_names]
+    al = {n: Allowlist(n, (allowlists or {}).get(n, {}))
+          for n in rule_names}
+    res = run_rules(indexes, rules, al)
+    return res["findings"], res["suppressed"]
+
+
+# -- lockset-race ------------------------------------------------------------
+
+RELEASED_THEN_WRITE = {
+    "pkg/__init__.py": "",
+    "pkg/worker.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self._lock.release()
+                    self.count += 1
+                    self._lock.acquire()
+    """,
+}
+
+
+def test_released_then_write_race_lexical_pass_misses_it():
+    findings, _ = _run(RELEASED_THEN_WRITE, ["lock-discipline"])
+    assert findings == []   # lexically both writes sit under `with`
+
+
+def test_released_then_write_race_lockset_catches_it():
+    findings, _ = _run(RELEASED_THEN_WRITE, ["lockset-race"])
+    assert [(f.rule, f.key) for f in findings] == \
+        [("lockset-race", "pkg/worker.py:Worker.count")]
+    assert "empty lockset intersection" in findings[0].message
+
+
+DISJOINT_LOCKS = {
+    "pkg/__init__.py": "",
+    "pkg/disjoint.py": """
+        import threading
+
+        class Disjoint:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.shared = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._a_lock:
+                    self.shared = 1
+
+            def poke(self):
+                with self._b_lock:
+                    self.shared = 2
+    """,
+}
+
+
+def test_disjoint_locks_race_only_the_lockset_rule_sees():
+    lex, _ = _run(DISJOINT_LOCKS, ["lock-discipline"])
+    assert lex == []
+    flow, _ = _run(DISJOINT_LOCKS, ["lockset-race"])
+    assert [f.key for f in flow] == ["pkg/disjoint.py:Disjoint.shared"]
+
+
+SEEDED_SAFE = {
+    "pkg/__init__.py": "",
+    "pkg/seeded.py": """
+        import threading
+
+        class Seeded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self.n += 1
+                    self._bump()
+
+            def _bump(self):
+                self.n += 1
+
+            def reset(self):
+                with self._lock:
+                    self._bump()
+    """,
+}
+
+
+def test_interprocedural_seeding_clears_the_lexical_false_positive():
+    """``_bump`` writes with no lexical lock, but every call site holds
+    ``_lock`` — the seeded entry lockset proves the discipline the
+    lexical closure rule cannot."""
+    lex, _ = _run(SEEDED_SAFE, ["lock-discipline"])
+    assert [f.key for f in lex] == ["pkg/seeded.py:Seeded.n"]  # lexical FP
+    flow, _ = _run(SEEDED_SAFE, ["lockset-race"])
+    assert flow == []
+
+def test_lockset_allowlist_keys_are_lock_discipline_compatible():
+    findings, suppressed = _run(
+        RELEASED_THEN_WRITE, ["lockset-race"],
+        allowlists={"lockset-race": {
+            "pkg/worker.py:Worker.count": "fixture: sanctioned"}})
+    assert findings == []          # suppressed, and the entry not stale
+    assert [f.key for f in suppressed] == ["pkg/worker.py:Worker.count"]
+
+
+# -- de-duplication (lockset wins) -------------------------------------------
+
+PLAIN_RACE = {
+    "pkg/__init__.py": "",
+    "pkg/plain.py": """
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self.v = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.v = 1
+
+            def poke(self):
+                self.v = 2
+    """,
+}
+
+
+def test_shared_conflict_emits_once_lockset_wins():
+    findings, _ = _run(PLAIN_RACE, ["lockset-race", "lock-discipline"])
+    assert [(f.rule, f.key) for f in findings] == \
+        [("lockset-race", "pkg/plain.py:Plain.v")]
+
+
+def test_lock_discipline_standalone_keeps_lexical_behavior():
+    findings, _ = _run(PLAIN_RACE, ["lock-discipline"])
+    assert [(f.rule, f.key) for f in findings] == \
+        [("lock-discipline", "pkg/plain.py:Plain.v")]
+
+
+# -- lock-order-deadlock -----------------------------------------------------
+
+AB_BA = {
+    "pkg/__init__.py": "",
+    "pkg/pipe.py": """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._head_lock = threading.Lock()
+                self._tail_lock = threading.Lock()
+
+            def push(self):
+                with self._head_lock:
+                    with self._tail_lock:
+                        pass
+
+            def pull(self):
+                with self._tail_lock:
+                    with self._head_lock:
+                        pass
+    """,
+}
+
+
+def test_ab_ba_cycle_reported_with_both_witness_paths():
+    findings, _ = _run(AB_BA, ["lock-order-deadlock"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-order-deadlock"
+    assert f.scope.startswith("cycle:")
+    assert "Pipe._head_lock" in f.scope and "Pipe._tail_lock" in f.scope
+    # both witness paths, with their acquisition sites
+    assert "pkg.pipe.Pipe.push" in f.message
+    assert "pkg.pipe.Pipe.pull" in f.message
+
+
+INTERPROC_CYCLE = {
+    "pkg/__init__.py": "",
+    "pkg/nested.py": """
+        import threading
+
+        class Nested:
+            def __init__(self):
+                self._x_lock = threading.Lock()
+                self._y_lock = threading.Lock()
+
+            def a(self):
+                with self._x_lock:
+                    self._grab()
+
+            def _grab(self):
+                with self._y_lock:
+                    pass
+
+            def b(self):
+                with self._y_lock:
+                    with self._x_lock:
+                        pass
+    """,
+}
+
+
+def test_interprocedural_cycle_found_via_entry_seeding():
+    """The x->y edge exists only because ``_grab`` (acquiring y) is
+    always entered holding x — a fact the call-site seeding carries
+    across the function boundary."""
+    findings, _ = _run(INTERPROC_CYCLE, ["lock-order-deadlock"])
+    assert len(findings) == 1
+    assert "Nested._x_lock" in findings[0].scope
+    assert "Nested._y_lock" in findings[0].scope
+
+
+REACQUIRE = {
+    "pkg/__init__.py": "",
+    "pkg/reacq.py": """
+        import threading
+
+        class Bad:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """,
+}
+
+
+def test_nonreentrant_reacquire_flagged_rlock_not():
+    findings, _ = _run(REACQUIRE, ["lock-order-deadlock"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.scope == "self-cycle:Bad._lock"
+    assert "non-reentrant" in f.message
+
+
+def test_acyclic_nesting_is_clean():
+    findings, _ = _run({
+        "pkg/__init__.py": "",
+        "pkg/ok.py": """
+            import threading
+
+            class Ok:
+                def __init__(self):
+                    self._outer_lock = threading.Lock()
+                    self._inner_lock = threading.Lock()
+
+                def a(self):
+                    with self._outer_lock:
+                        with self._inner_lock:
+                            pass
+
+                def b(self):
+                    with self._outer_lock:
+                        with self._inner_lock:
+                            pass
+        """,
+    }, ["lock-order-deadlock"])
+    assert findings == []
+
+
+# -- barrier-flush-completeness ----------------------------------------------
+
+BARRIER_MISS = {
+    "siddhi_tpu/__init__.py": "",
+    "siddhi_tpu/core/__init__.py": "",
+    "siddhi_tpu/core/fx_pump.py": """
+        import queue
+        from collections import deque
+
+        class Pump:
+            def __init__(self):
+                self._in_queue = queue.Queue(maxsize=64)
+                self._out_spool = deque(maxlen=16)
+
+            def shutdown(self):
+                self._drain_in()
+                return
+                self._flush_out()
+
+            def _drain_in(self):
+                while True:
+                    try:
+                        self._in_queue.get_nowait()
+                    except queue.Empty:
+                        break
+
+            def _flush_out(self):
+                while self._out_spool:
+                    self._out_spool.popleft()
+    """,
+}
+
+
+def test_barrier_missing_one_queue_flush_dead_code_does_not_count():
+    """``shutdown`` drains ``_in_queue`` through a helper, but the
+    ``_flush_out`` call sits after a ``return`` — lexically present,
+    CFG-unreachable.  Exactly the spool queue is reported."""
+    findings, _ = _run(BARRIER_MISS, ["barrier-flush-completeness"])
+    assert [(f.rule, f.scope) for f in findings] == \
+        [("barrier-flush-completeness", "Pump.shutdown:_out_spool")]
+
+
+def test_barrier_flushing_every_queue_is_clean():
+    files = dict(BARRIER_MISS)
+    files["siddhi_tpu/core/fx_pump.py"] = files[
+        "siddhi_tpu/core/fx_pump.py"].replace(
+        "self._drain_in()\n                return\n",
+        "self._drain_in()\n")
+    findings, _ = _run(files, ["barrier-flush-completeness"])
+    assert findings == []
+
+
+def test_queue_with_no_barrier_at_all_is_reported():
+    findings, _ = _run({
+        "siddhi_tpu/__init__.py": "",
+        "siddhi_tpu/core/__init__.py": "",
+        "siddhi_tpu/core/fx_hoard.py": """
+            from collections import deque
+
+            class Hoard:
+                def __init__(self):
+                    self._buf = deque(maxlen=8)
+
+                def add(self, x):
+                    self._buf.append(x)
+        """,
+    }, ["barrier-flush-completeness"])
+    assert len(findings) == 1
+    assert findings[0].scope == "Hoard._buf"
+    assert "no barrier method" in findings[0].message
+
+
+def test_out_of_scope_modules_carry_no_flush_obligation():
+    files = {"pkg/__init__.py": "",
+             "pkg/free.py": BARRIER_MISS[
+                 "siddhi_tpu/core/fx_pump.py"]}
+    findings, _ = _run(files, ["barrier-flush-completeness"])
+    assert findings == []

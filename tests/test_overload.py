@@ -605,6 +605,106 @@ define stream S (k long, v double);
         finally:
             m.shutdown()
 
+    def test_shutdown_flushes_deliverable_spool(self):
+        """Regression (found by the barrier-flush-completeness rule):
+        ``Sink.shutdown`` used to warn-and-drop batches still spooled
+        behind the breaker even when the transport was up and the
+        cooldown had elapsed — the shutdown barrier never reached a
+        flush of the ``_spool`` queue it owns.  It now attempts one
+        final breaker-gated flush before declaring the loss."""
+        from siddhi_tpu.transport.broker import (
+            FunctionSubscriber,
+            InMemoryBroker,
+        )
+
+        m = SiddhiManager()
+        sub = None
+        try:
+            rt = m.create_siddhi_app_runtime("""
+@app:name('cb3')
+@app:limits(breaker='2', breaker.cooldown='40 ms')
+@sink(type='inMemory', topic='tcb3')
+define stream S (k long, v double);
+""")
+            published = []
+            sub = FunctionSubscriber("tcb3", published.append)
+            InMemoryBroker.subscribe(sub)
+            rt.start()
+            sink = rt.sinks[0]
+            assert sink.connected and sink._breaker is not None
+            # trip the breaker while connected (publish-side failures)
+            sink._breaker.record_failure()
+            sink._breaker.record_failure()
+            assert sink._breaker.is_open()
+            h = rt.get_input_handler("S")
+            for i in range(3):
+                h.send([i, float(i)], timestamp=1000 + i)
+            assert len(sink._spool) == 3 and published == []
+            time.sleep(0.08)  # cooldown elapses; no further traffic
+            rt.shutdown()
+            # the final barrier flush delivered everything, in order
+            assert [e.data[0] for e in published] == [0, 1, 2]
+            assert not sink._spool
+            rb = rt.app_context.robustness
+            assert rb.breaker_flushed_batches == 3
+        finally:
+            m.shutdown()
+            if sub is not None:
+                InMemoryBroker.unsubscribe(sub)
+
+    def test_half_open_flush_does_not_self_deadlock(self):
+        """Regression (found by the lock-order-deadlock rule's
+        reentrancy audit): flushing through a HALF-OPEN breaker closes
+        it on the first successful publish, and
+        ``publish_with_reconnect`` then re-enters ``_flush_spool`` on
+        the same thread — with a non-reentrant ``_spool_lock`` that
+        path self-deadlocked.  The lock is an RLock now; the nested
+        flush drains the remainder and the outer loop exits empty."""
+        import threading
+
+        from siddhi_tpu.transport.broker import (
+            FunctionSubscriber,
+            InMemoryBroker,
+        )
+
+        m = SiddhiManager()
+        sub = None
+        try:
+            rt = m.create_siddhi_app_runtime("""
+@app:name('cb4')
+@app:limits(breaker='2', breaker.cooldown='40 ms')
+@sink(type='inMemory', topic='tcb4')
+define stream S (k long, v double);
+""")
+            published = []
+            sub = FunctionSubscriber("tcb4", published.append)
+            InMemoryBroker.subscribe(sub)
+            rt.start()
+            sink = rt.sinks[0]
+            sink._breaker.record_failure()
+            sink._breaker.record_failure()
+            assert sink._breaker.is_open()
+            h = rt.get_input_handler("S")
+            h.send([0, 0.0], timestamp=1000)
+            h.send([1, 1.0], timestamp=1001)
+            assert len(sink._spool) == 2
+            time.sleep(0.08)  # past cooldown: next send probes half-open
+            t = threading.Thread(
+                target=lambda: h.send([2, 2.0], timestamp=1002),
+                daemon=True)
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), (
+                "send through a half-open breaker with a non-empty "
+                "spool deadlocked in the nested flush")
+            assert [e.data[0] for e in published] == [0, 1, 2]
+            assert sink._breaker.state == "closed"
+            rt.shutdown()
+        finally:
+            m.shutdown()
+            if sub is not None:
+                InMemoryBroker.unsubscribe(sub)
+
 
 class TestRetryShutdownRace:
     def test_arm_after_shutdown_is_a_gated_noop(self):
